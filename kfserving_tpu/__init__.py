@@ -1,0 +1,28 @@
+"""kfserving-tpu: a TPU-native model inference serving framework.
+
+A ground-up rebuild of the capabilities of KFServing (reference:
+kubeflow/kfserving ~v0.5, see /root/reference) designed TPU-first:
+
+- Data plane: an asyncio HTTP server implementing the standardized V1/V2
+  predict protocols (reference python/kfserving/kfserving/kfserver.py:61-87),
+  backed by a JAX/XLA execution engine with shape-bucketed jit compilation.
+- Dynamic batching: an in-process async batcher with the same observable
+  semantics as the reference Go agent batcher (pkg/batcher/handler.go) but
+  keyed to XLA-compiled shape buckets instead of raw request coalescing.
+- Multi-model serving: HBM-aware model load/unload/eviction replacing the
+  reference's disk-based agent puller (pkg/agent).
+- Control plane: declarative InferenceService-style specs, defaulting and
+  validation, a reconciler, canary traffic splitting, and a KPA-style
+  concurrency autoscaler with scale-to-zero — in-process, cluster-free.
+- Parallelism: jax.sharding Mesh over ICI for models larger than one chip
+  (tensor parallel), ring attention for long-context serving.
+"""
+
+__version__ = "0.1.0"
+
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.model.repository import ModelRepository
+from kfserving_tpu.server.app import ModelServer
+from kfserving_tpu.storage.storage import Storage
+
+__all__ = ["Model", "ModelRepository", "ModelServer", "Storage", "__version__"]

@@ -1,0 +1,4 @@
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.model.repository import ModelRepository
+
+__all__ = ["Model", "ModelRepository"]

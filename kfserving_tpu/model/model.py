@@ -1,0 +1,135 @@
+"""The serving model contract.
+
+Re-implements the reference `KFModel` contract (reference
+python/kfserving/kfserving/kfmodel.py:31-123): a model is a named object with
+`load / preprocess / predict / postprocess / explain`, and when
+`predictor_host` is set the predict/explain calls proxy over HTTP to a
+downstream predictor (that is how transformers and explainers chain to
+predictors across pods, reference kfmodel.py:24-27,88-122).
+
+Differences from the reference, by design:
+- fully async (the reference mixes sync/sync-or-async dispatch);
+- the HTTP client is aiohttp with a shared connection pool;
+- preprocess/predict/postprocess are all awaited, so a TPU-backed model can
+  yield the event loop while device execution is in flight.
+"""
+
+import json
+from typing import Any, Dict, Optional
+
+from kfserving_tpu.protocol import cloudevents
+from kfserving_tpu.protocol.errors import InferenceError
+
+# URL formats, same as reference kfmodel.py:24-27.
+PREDICTOR_URL_FORMAT = "http://{0}/v1/models/{1}:predict"
+EXPLAINER_URL_FORMAT = "http://{0}/v1/models/{1}:explain"
+PREDICTOR_V2_URL_FORMAT = "http://{0}/v2/models/{1}/infer"
+EXPLAINER_V2_URL_FORMAT = "http://{0}/v2/models/{1}/explain"
+
+
+class Model:
+    """Base model. Subclass and override load/preprocess/predict/postprocess.
+
+    Attributes mirror reference kfmodel.py:33-44: name, ready, protocol,
+    predictor_host, explainer_host, timeout.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+        self.ready = False
+        self.protocol = "v1"
+        self.predictor_host: Optional[str] = None
+        self.explainer_host: Optional[str] = None
+        # Request-level timeouts should be handled by the outer system
+        # (same rationale as reference kfmodel.py:39-42).
+        self.timeout = 600
+        self._http_session = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def load(self) -> bool:
+        """Load the model and flip ready. Override in subclasses."""
+        self.ready = True
+        return self.ready
+
+    def unload(self) -> None:
+        """Release resources (HBM, file handles). Override in subclasses."""
+        self.ready = False
+
+    # -- request path ------------------------------------------------------
+    async def preprocess(self, request: Any) -> Any:
+        """Unwrap CloudEvents payloads, else pass through.
+
+        Same semantics as reference kfmodel.py:56-88: a binary CloudEvent's
+        data is JSON-decoded when possible; a structured CloudEvent dict is
+        unwrapped to its "data" member.
+        """
+        if isinstance(request, cloudevents.CloudEvent):
+            data = request.data
+            if isinstance(data, (bytes, bytearray)):
+                try:
+                    return json.loads(data.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    return data
+            return data
+        if isinstance(request, dict):
+            if all(k in request for k in
+                   ("time", "type", "source", "id", "specversion", "data")):
+                return request["data"]
+        return request
+
+    async def postprocess(self, response: Any) -> Any:
+        return response
+
+    async def predict(self, request: Any) -> Any:
+        """Run inference, or proxy to predictor_host when configured."""
+        if not self.predictor_host:
+            raise NotImplementedError
+        if self.protocol == "v2":
+            url = PREDICTOR_V2_URL_FORMAT.format(self.predictor_host, self.name)
+        else:
+            url = PREDICTOR_URL_FORMAT.format(self.predictor_host, self.name)
+        return await self._proxy(url, request)
+
+    async def explain(self, request: Any) -> Any:
+        if not self.explainer_host:
+            raise NotImplementedError
+        if self.protocol == "v2":
+            url = EXPLAINER_V2_URL_FORMAT.format(self.explainer_host, self.name)
+        else:
+            url = EXPLAINER_URL_FORMAT.format(self.explainer_host, self.name)
+        return await self._proxy(url, request)
+
+    # -- helpers -----------------------------------------------------------
+    @property
+    def http_session(self):
+        if self._http_session is None:
+            import aiohttp
+
+            self._http_session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout))
+        return self._http_session
+
+    async def _proxy(self, url: str, request: Any) -> Any:
+        async with self.http_session.post(url, json=request) as resp:
+            body = await resp.read()
+            if resp.status != 200:
+                raise InferenceError(body.decode("utf-8", "replace"))
+            return json.loads(body)
+
+    async def close(self) -> None:
+        if self._http_session is not None:
+            await self._http_session.close()
+            self._http_session = None
+
+    # -- metadata ----------------------------------------------------------
+    def metadata(self) -> Dict[str, Any]:
+        """V2 model-metadata response object (required_api.md Model Metadata).
+
+        Subclasses with known signatures override to fill inputs/outputs.
+        """
+        return {
+            "name": self.name,
+            "platform": "kfserving_tpu",
+            "inputs": [],
+            "outputs": [],
+        }

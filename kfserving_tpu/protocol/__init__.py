@@ -1,0 +1,17 @@
+"""Standardized inference protocols (V1 and V2) and CloudEvents support."""
+
+from kfserving_tpu.protocol.errors import (
+    InferenceError,
+    InvalidInput,
+    ModelNotFound,
+    ModelNotReady,
+    ServingError,
+)
+
+__all__ = [
+    "ServingError",
+    "InvalidInput",
+    "ModelNotFound",
+    "ModelNotReady",
+    "InferenceError",
+]

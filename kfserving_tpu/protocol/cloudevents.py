@@ -1,0 +1,113 @@
+"""Minimal CloudEvents v1.0 support (binary + structured HTTP modes).
+
+The reference data plane accepts CloudEvents-wrapped predict payloads and
+echoes responses as CloudEvents (reference python/kfserving/kfserving/
+handlers/http.py:53-112, kfmodel.py:56-88) using the `cloudevents` SDK; the
+payload logger emits request/response events
+(reference pkg/logger/worker.go:81-119).  That SDK is not a dependency here;
+this module implements the small subset the serving path needs:
+
+- binary mode: attributes ride `ce-*` HTTP headers, data is the raw body;
+- structured mode: the body is a JSON envelope with a `data` member
+  (content-type application/cloudevents+json).
+"""
+
+import json
+import time
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+REQUIRED_ATTRS = ("id", "source", "specversion", "type")
+STRUCTURED_CONTENT_TYPE = "application/cloudevents+json"
+
+
+class CloudEvent:
+    def __init__(self, attributes: Dict[str, str], data: Any):
+        self.attributes = dict(attributes)
+        self.attributes.setdefault("specversion", "1.0")
+        self.attributes.setdefault("id", str(uuid.uuid4()))
+        self.data = data
+
+    def __getitem__(self, key: str) -> str:
+        return self.attributes[key]
+
+
+def is_binary(headers: Dict[str, str]) -> bool:
+    return "ce-specversion" in headers
+
+
+def is_structured(headers: Dict[str, str]) -> bool:
+    ctype = headers.get("content-type", "")
+    return ctype.startswith(STRUCTURED_CONTENT_TYPE)
+
+
+def has_ce_headers(headers: Dict[str, str]) -> bool:
+    """Binary-header sniff matching the SDK's has_binary_headers: the spec's
+    required attributes present as ce- headers."""
+    return ("ce-specversion" in headers and "ce-source" in headers
+            and "ce-type" in headers and "ce-id" in headers)
+
+
+def from_http(headers: Dict[str, str], body: bytes) -> CloudEvent:
+    """Decode either binary or structured mode from an HTTP request."""
+    if is_structured(headers):
+        envelope = json.loads(body.decode("utf-8"))
+        missing = [a for a in REQUIRED_ATTRS if a not in envelope]
+        if missing:
+            raise ValueError(f"CloudEvent missing required fields: {missing}")
+        data = envelope.get("data")
+        if data is None and "data_base64" in envelope:
+            import base64
+
+            data = base64.b64decode(envelope["data_base64"])
+        attrs = {k: v for k, v in envelope.items()
+                 if k not in ("data", "data_base64")}
+        return CloudEvent(attrs, data)
+    # binary mode
+    attrs = {k[3:]: v for k, v in headers.items() if k.startswith("ce-")}
+    missing = [a for a in REQUIRED_ATTRS if a not in attrs]
+    if missing:
+        raise ValueError(f"CloudEvent missing required fields: {missing}")
+    return CloudEvent(attrs, body)
+
+
+def ce_time_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime())
+
+
+def to_binary(event: CloudEvent) -> Tuple[Dict[str, str], bytes]:
+    headers = {f"ce-{k}": str(v) for k, v in event.attributes.items()}
+    headers["ce-time"] = ce_time_now()
+    data = event.data
+    if isinstance(data, bytes):
+        body = data
+    else:
+        body = json.dumps(data).encode("utf-8")
+        headers.setdefault("content-type", "application/json")
+    return headers, body
+
+
+def to_structured(event: CloudEvent) -> Tuple[Dict[str, str], bytes]:
+    envelope = dict(event.attributes)
+    envelope["time"] = ce_time_now()
+    data = event.data
+    if isinstance(data, bytes):
+        try:
+            envelope["data"] = json.loads(data.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            import base64
+
+            envelope["data_base64"] = base64.b64encode(data).decode("ascii")
+    else:
+        envelope["data"] = data
+    return ({"content-type": STRUCTURED_CONTENT_TYPE},
+            json.dumps(envelope).encode("utf-8"))
+
+
+def new_event(event_type: str, source: str, data: Any,
+              extensions: Optional[Dict[str, str]] = None) -> CloudEvent:
+    attrs = {"type": event_type, "source": source, "specversion": "1.0",
+             "id": str(uuid.uuid4())}
+    if extensions:
+        attrs.update(extensions)
+    return CloudEvent(attrs, data)

@@ -1,0 +1,184 @@
+"""V2 (KFServing/Triton "Predict Protocol - Version 2") inference protocol.
+
+Implements the JSON tensor format of the reference spec
+(reference docs/predict-api/v2/required_api.md, grpc_predict_v2.proto):
+
+    $inference_request = {
+      "id": $string #optional, "parameters": $parameters #optional,
+      "inputs": [ $request_input, ... ],
+      "outputs": [ $request_output, ... ] #optional
+    }
+    $request_input = {"name", "shape", "datatype", "parameters"#opt, "data"}
+
+Tensors are encoded/decoded to numpy with an explicit dtype table, including
+BF16 (served models are bfloat16 on TPU; JSON carries floats either way).
+"""
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from kfserving_tpu.protocol.errors import InvalidInput
+
+# Datatype table from the V2 spec ("Tensor Data Types" section of
+# reference docs/predict-api/v2/required_api.md).
+DTYPES_TO_NUMPY = {
+    "BOOL": np.bool_,
+    "UINT8": np.uint8,
+    "UINT16": np.uint16,
+    "UINT32": np.uint32,
+    "UINT64": np.uint64,
+    "INT8": np.int8,
+    "INT16": np.int16,
+    "INT32": np.int32,
+    "INT64": np.int64,
+    "FP16": np.float16,
+    "FP32": np.float32,
+    "FP64": np.float64,
+    "BYTES": np.object_,
+}
+
+NUMPY_TO_DTYPES = {
+    np.dtype(np.bool_): "BOOL",
+    np.dtype(np.uint8): "UINT8",
+    np.dtype(np.uint16): "UINT16",
+    np.dtype(np.uint32): "UINT32",
+    np.dtype(np.uint64): "UINT64",
+    np.dtype(np.int8): "INT8",
+    np.dtype(np.int16): "INT16",
+    np.dtype(np.int32): "INT32",
+    np.dtype(np.int64): "INT64",
+    np.dtype(np.float16): "FP16",
+    np.dtype(np.float32): "FP32",
+    np.dtype(np.float64): "FP64",
+}
+
+
+def _numpy_dtype(datatype: str):
+    if datatype == "BF16":
+        # ml_dtypes ships with jax; BF16 rides JSON as plain numbers.
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    try:
+        return DTYPES_TO_NUMPY[datatype]
+    except KeyError:
+        raise InvalidInput(f"Unsupported datatype {datatype}")
+
+
+def datatype_of(arr: np.ndarray) -> str:
+    dt = np.dtype(arr.dtype)
+    if dt.name == "bfloat16":
+        return "BF16"
+    if dt.kind in ("U", "S", "O"):
+        return "BYTES"
+    try:
+        return NUMPY_TO_DTYPES[dt]
+    except KeyError:
+        raise InvalidInput(f"Unsupported numpy dtype {dt}")
+
+
+class InferInput:
+    """One named input tensor of a V2 inference request."""
+
+    def __init__(self, name: str, shape: List[int], datatype: str,
+                 data: Any, parameters: Optional[Dict] = None):
+        self.name = name
+        self.shape = list(shape)
+        self.datatype = datatype
+        self.data = data
+        self.parameters = parameters or {}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "InferInput":
+        for field in ("name", "shape", "datatype", "data"):
+            if field not in d:
+                raise InvalidInput(f'Input tensor missing required field "{field}"')
+        if not isinstance(d["shape"], list):
+            raise InvalidInput('Input tensor "shape" must be a list')
+        return cls(d["name"], d["shape"], d["datatype"], d["data"],
+                   d.get("parameters"))
+
+    def as_numpy(self) -> np.ndarray:
+        dtype = _numpy_dtype(self.datatype)
+        if self.datatype == "BYTES":
+            arr = np.array(self.data, dtype=np.object_)
+        else:
+            arr = np.asarray(self.data, dtype=dtype)
+        try:
+            return arr.reshape(self.shape)
+        except ValueError:
+            raise InvalidInput(
+                f"Input {self.name}: data of size {arr.size} does not match "
+                f"shape {self.shape}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"name": self.name, "shape": self.shape,
+               "datatype": self.datatype, "data": self.data}
+        if self.parameters:
+            out["parameters"] = self.parameters
+        return out
+
+
+class InferRequest:
+    """A decoded V2 inference request."""
+
+    def __init__(self, inputs: List[InferInput], id: Optional[str] = None,
+                 parameters: Optional[Dict] = None,
+                 outputs: Optional[List[Dict]] = None):
+        self.inputs = inputs
+        self.id = id
+        self.parameters = parameters or {}
+        self.outputs = outputs or []
+
+    @classmethod
+    def from_dict(cls, body: Dict[str, Any]) -> "InferRequest":
+        if not isinstance(body, dict):
+            raise InvalidInput("V2 inference request must be a JSON object")
+        if "inputs" not in body or not isinstance(body["inputs"], list):
+            raise InvalidInput('Expected "inputs" to be a list')
+        inputs = [InferInput.from_dict(i) for i in body["inputs"]]
+        return cls(inputs, body.get("id"), body.get("parameters"),
+                   body.get("outputs"))
+
+    def named_numpy(self) -> Dict[str, np.ndarray]:
+        return {i.name: i.as_numpy() for i in self.inputs}
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"inputs": [i.to_dict() for i in self.inputs]}
+        if self.id is not None:
+            out["id"] = self.id
+        if self.parameters:
+            out["parameters"] = self.parameters
+        if self.outputs:
+            out["outputs"] = self.outputs
+        return out
+
+
+def tensor_to_output(name: str, arr: np.ndarray) -> Dict[str, Any]:
+    """Encode a numpy array as a V2 response output tensor."""
+    arr = np.asarray(arr)
+    datatype = datatype_of(arr)
+    if datatype == "BF16":
+        data = arr.astype(np.float32).ravel().tolist()
+    elif datatype == "BYTES":
+        data = [x.decode() if isinstance(x, bytes) else str(x)
+                for x in arr.ravel().tolist()]
+    else:
+        data = arr.ravel().tolist()
+    return {"name": name, "shape": list(arr.shape), "datatype": datatype,
+            "data": data}
+
+
+def make_response(model_name: str, outputs: Dict[str, np.ndarray],
+                  id: Optional[str] = None,
+                  model_version: Optional[str] = None) -> Dict[str, Any]:
+    resp: Dict[str, Any] = {
+        "model_name": model_name,
+        "outputs": [tensor_to_output(k, v) for k, v in outputs.items()],
+    }
+    if model_version is not None:
+        resp["model_version"] = model_version
+    if id is not None:
+        resp["id"] = id
+    return resp

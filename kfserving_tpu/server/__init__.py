@@ -1,0 +1,3 @@
+from kfserving_tpu.server.app import ModelServer
+
+__all__ = ["ModelServer"]

@@ -1,0 +1,248 @@
+"""ModelServer: the TPU-native model server with the V1/V2 route table.
+
+Route table is a superset of the reference server's
+(reference python/kfserving/kfserving/kfserver.py:61-87):
+
+    GET  /                                  liveness ("Alive")
+    GET  /v2/health/live                    V2 server live
+    GET  /v2/health/ready                   V2 server ready (all models)
+    GET  /v2                                V2 server metadata
+    GET  /v1/models  /v2/models             list models
+    GET  /v1/models/{name}                  model health
+    GET  /v2/models/{name}                  V2 model metadata
+    GET  /v2/models/{name}/status           model health (reference alias)
+    GET  /v2/models/{name}/ready            V2 model ready
+    POST /v1/models/{name}:predict          V1 predict
+    POST /v2/models/{name}/infer            V2 infer
+    POST /v1/models/{name}:explain          V1 explain
+    POST /v2/models/{name}/explain          V2 explain
+    POST /v2/repository/models/{name}/load  load (model repository ext.)
+    POST /v2/repository/models/{name}/unload
+    GET  /v2/repository/index               repository index
+    GET  /metrics                           Prometheus metrics
+
+Unlike the reference (tornado, forked workers, kfserver.py:89-108) this is a
+single-process asyncio server: the TPU chip is owned by one runtime, requests
+interleave on the event loop, and parallelism comes from batched XLA
+execution rather than process forking.
+"""
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+import time
+from typing import Any, Dict, List, Optional
+
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.model.repository import ModelRepository
+from kfserving_tpu.protocol import cloudevents
+from kfserving_tpu.protocol.errors import ServingError
+from kfserving_tpu.server.dataplane import DataPlane
+from kfserving_tpu.server.http import HTTPServer, Request, Response, Router
+from kfserving_tpu.server.metrics import Metrics
+
+logger = logging.getLogger("kfserving_tpu.server")
+
+DEFAULT_HTTP_PORT = 8080
+
+# Same CLI surface as the reference parent parser (kfserver.py:34-43) so
+# per-framework __main__ modules inherit it.
+parser = argparse.ArgumentParser(add_help=False)
+parser.add_argument("--http_port", default=DEFAULT_HTTP_PORT, type=int,
+                    help="The HTTP port listened to by the model server.")
+parser.add_argument("--workers", default=1, type=int,
+                    help="Unused; kept for reference CLI compatibility "
+                         "(single process owns the TPU).")
+parser.add_argument("--max_latency_ms", default=5.0, type=float,
+                    help="Dynamic batcher flush deadline in milliseconds.")
+parser.add_argument("--max_batch_size", default=32, type=int,
+                    help="Dynamic batcher max batch size.")
+
+
+def _json(data: Any, status: int = 200) -> Response:
+    return Response(json.dumps(data).encode("utf-8"), status=status)
+
+
+def _error(e: ServingError) -> Response:
+    return _json({"error": e.reason}, status=e.status_code)
+
+
+class ModelServer:
+    def __init__(self, http_port: int = DEFAULT_HTTP_PORT,
+                 registered_models: Optional[ModelRepository] = None,
+                 enable_docs: bool = True):
+        self.repository = registered_models or ModelRepository()
+        self.dataplane = DataPlane(self.repository)
+        self.http_port = http_port
+        self.metrics = Metrics()
+        self.router = Router()
+        self._register_routes()
+        self.http_server = HTTPServer(self.router)
+        self.request_hooks = []  # agent logger taps in here
+
+    # -- routes ------------------------------------------------------------
+    def _register_routes(self):
+        r = self.router
+        r.add("GET", "/", self._live)
+        r.add("GET", "/v2/health/live", self._live)
+        r.add("GET", "/v2/health/ready", self._server_ready)
+        r.add("GET", "/v2", self._server_metadata)
+        r.add("GET", "/v1/models", self._list_models)
+        r.add("GET", "/v2/models", self._list_models)
+        r.add("GET", "/v1/models/{name}", self._model_health)
+        r.add("GET", "/v2/models/{name}/status", self._model_health)
+        r.add("GET", "/v2/models/{name}/ready", self._model_ready)
+        r.add("GET", "/v2/models/{name}", self._model_metadata)
+        r.add("POST", "/v1/models/{name}:predict", self._predict_v1)
+        r.add("POST", "/v2/models/{name}/infer", self._infer_v2)
+        r.add("POST", "/v1/models/{name}:explain", self._explain)
+        r.add("POST", "/v2/models/{name}/explain", self._explain)
+        r.add("POST", "/v2/repository/models/{name}/load", self._load)
+        r.add("POST", "/v2/repository/models/{name}/unload", self._unload)
+        r.add("GET", "/v2/repository/index", self._repository_index)
+        r.add("GET", "/metrics", self._metrics)
+
+    # -- handlers ----------------------------------------------------------
+    async def _live(self, req: Request) -> Response:
+        return Response(b"Alive", content_type="text/plain")
+
+    async def _server_ready(self, req: Request) -> Response:
+        ready = self.dataplane.server_ready()
+        return _json({"ready": ready}, status=200 if ready else 503)
+
+    async def _server_metadata(self, req: Request) -> Response:
+        return _json(self.dataplane.server_metadata())
+
+    async def _list_models(self, req: Request) -> Response:
+        return _json(self.dataplane.list_models())
+
+    async def _model_health(self, req: Request) -> Response:
+        name = req.path_params["name"]
+        try:
+            model = self.dataplane.model_ready(name)
+        except ServingError as e:
+            return _error(e)
+        return _json({"name": model.name, "ready": model.ready})
+
+    async def _model_ready(self, req: Request) -> Response:
+        try:
+            self.dataplane.model_ready(req.path_params["name"])
+        except ServingError as e:
+            return _error(e)
+        return Response(b"", status=200)
+
+    async def _model_metadata(self, req: Request) -> Response:
+        try:
+            return _json(self.dataplane.model_metadata(req.path_params["name"]))
+        except ServingError as e:
+            return _error(e)
+
+    async def _predict_v1(self, req: Request) -> Response:
+        return await self._inference(req, "predict", self.dataplane.infer)
+
+    async def _infer_v2(self, req: Request) -> Response:
+        return await self._inference(req, "infer", self.dataplane.infer)
+
+    async def _explain(self, req: Request) -> Response:
+        return await self._inference(req, "explain", self.dataplane.explain)
+
+    async def _inference(self, req: Request, verb: str, op) -> Response:
+        name = req.path_params["name"]
+        start = time.perf_counter()
+        status = 200
+        try:
+            body = self.dataplane.decode_body(req.headers, req.body)
+            response = await op(name, body)
+            resp = self._encode_response(req, body, response)
+        except ServingError as e:
+            status = e.status_code
+            resp = _error(e)
+        except Exception as e:
+            logger.exception("%s failed for model %s", verb, name)
+            status = 500
+            resp = _json({"error": str(e)}, status=500)
+        latency_ms = (time.perf_counter() - start) * 1000.0
+        self.metrics.observe_request(name, verb, status, latency_ms)
+        for hook in self.request_hooks:
+            try:
+                hook(name, verb, req, resp, latency_ms)
+            except Exception:
+                logger.exception("request hook failed")
+        return resp
+
+    def _encode_response(self, req: Request, body: Any, response: Any
+                         ) -> Response:
+        """Echo CloudEvents framing when the request was a CloudEvent
+        (reference handlers/http.py:96-109)."""
+        if isinstance(body, cloudevents.CloudEvent):
+            event = cloudevents.CloudEvent(body.attributes, response)
+            if cloudevents.is_structured(req.headers):
+                headers, payload = cloudevents.to_structured(event)
+            else:
+                headers, payload = cloudevents.to_binary(event)
+            return Response(payload, headers=headers)
+        return _json(response)
+
+    async def _load(self, req: Request) -> Response:
+        name = req.path_params["name"]
+        try:
+            await self.dataplane.load(name)
+        except ServingError as e:
+            return _error(e)
+        return _json({"name": name, "load": True})
+
+    async def _unload(self, req: Request) -> Response:
+        name = req.path_params["name"]
+        try:
+            await self.dataplane.unload(name)
+        except ServingError as e:
+            return _error(e)
+        return _json({"name": name, "unload": True})
+
+    async def _repository_index(self, req: Request) -> Response:
+        return _json(self.dataplane.repository_index())
+
+    async def _metrics(self, req: Request) -> Response:
+        return Response(self.metrics.render().encode("utf-8"),
+                        content_type="text/plain; version=0.0.4")
+
+    # -- lifecycle ---------------------------------------------------------
+    def register_model(self, model: Model) -> None:
+        if not model.name:
+            raise ValueError(
+                "Failed to register model, model.name must be provided.")
+        self.repository.update(model)
+        logger.info("Registering model: %s", model.name)
+
+    async def start_async(self, models: List[Model],
+                          host: str = "0.0.0.0") -> None:
+        for model in models:
+            self.register_model(model)
+        await self.http_server.start(host, self.http_port)
+        self.http_port = self.http_server.port
+
+    async def stop_async(self) -> None:
+        for model in self.repository.get_models():
+            close = getattr(model, "close", None)
+            if close is not None:
+                await close()
+        await self.http_server.stop()
+
+    def start(self, models: List[Model]) -> None:
+        """Blocking entrypoint, reference kfserver.py:89-108 equivalent."""
+        async def _main():
+            await self.start_async(models)
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                try:
+                    loop.add_signal_handler(sig, stop.set)
+                except NotImplementedError:
+                    pass
+            await stop.wait()
+            await self.stop_async()
+
+        logging.basicConfig(level=logging.INFO)
+        asyncio.run(_main())

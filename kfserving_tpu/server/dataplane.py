@@ -1,0 +1,130 @@
+"""Protocol-agnostic data-plane operations over a ModelRepository.
+
+This is the glue between HTTP handlers and models, the analogue of the
+reference's handler bodies (reference python/kfserving/kfserving/handlers/
+http.py:53-112 and kfserver.py:118-196), factored so gRPC or in-process
+callers reuse the same path.
+"""
+
+import json
+from typing import Any, Dict, List
+
+from kfserving_tpu import __version__ as SERVER_VERSION
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.model.repository import ModelRepository, maybe_await
+from kfserving_tpu.protocol import cloudevents, v1
+from kfserving_tpu.protocol.errors import (
+    InvalidInput,
+    ModelNotFound,
+    ModelNotReady,
+)
+from kfserving_tpu.protocol.v2 import InferRequest
+
+SERVER_NAME = "kfserving-tpu"
+
+
+class DataPlane:
+    def __init__(self, repository: ModelRepository):
+        self.repository = repository
+
+    # -- health / metadata -------------------------------------------------
+    def live(self) -> bool:
+        return True
+
+    def server_ready(self) -> bool:
+        """V2 "server ready": all registered models ready (required_api.md)."""
+        return all(m.ready for m in self.repository.get_models())
+
+    def model_ready(self, name: str) -> Model:
+        model = self.repository.get_model(name)
+        if model is None:
+            raise ModelNotFound(name)
+        if not model.ready:
+            raise ModelNotReady(name)
+        return model
+
+    def list_models(self) -> List[str]:
+        return [m.name for m in self.repository.get_models()]
+
+    def server_metadata(self) -> Dict[str, Any]:
+        return {
+            "name": SERVER_NAME,
+            "version": SERVER_VERSION,
+            "extensions": ["model_repository"],
+        }
+
+    def model_metadata(self, name: str) -> Dict[str, Any]:
+        model = self.repository.get_model(name)
+        if model is None:
+            raise ModelNotFound(name)
+        return model.metadata()
+
+    # -- inference ---------------------------------------------------------
+    async def get_model(self, name: str) -> Model:
+        """Fetch a model, lazily loading on first use like the reference
+        (handlers/http.py:32-41)."""
+        model = self.repository.get_model(name)
+        if model is None:
+            raise ModelNotFound(name)
+        if not model.ready:
+            await maybe_await(model.load())
+        return model
+
+    def decode_body(self, headers: Dict[str, str], body: bytes) -> Any:
+        """Decode a request body: CloudEvent (binary or structured) or JSON."""
+        if cloudevents.has_ce_headers(headers) or cloudevents.is_structured(headers):
+            try:
+                return cloudevents.from_http(headers, body)
+            except ValueError as e:
+                raise InvalidInput(f"Cloud Event Exceptions: {e}")
+        try:
+            return json.loads(body) if body else {}
+        except ValueError as e:
+            raise InvalidInput(f"Unrecognized request format: {e}")
+
+    async def infer(self, name: str, body: Any) -> Any:
+        model = await self.get_model(name)
+        request = await model.preprocess(body)
+        request = self.validate(request)
+        response = await maybe_await(model.predict(request))
+        return await model.postprocess(response)
+
+    async def explain(self, name: str, body: Any) -> Any:
+        model = await self.get_model(name)
+        request = await model.preprocess(body)
+        request = self.validate(request)
+        response = await maybe_await(model.explain(request))
+        return await model.postprocess(response)
+
+    def validate(self, request: Any) -> Any:
+        if isinstance(request, dict) and "inputs" in request and isinstance(
+                request.get("inputs"), list) and request["inputs"] and isinstance(
+                request["inputs"][0], dict) and "datatype" in request["inputs"][0]:
+            # Looks like a V2 tensor request; structural validation happens
+            # in InferRequest.from_dict on the engine side.
+            return request
+        if isinstance(request, dict):
+            return v1.validate_request(request)
+        return request
+
+    # -- repository --------------------------------------------------------
+    async def load(self, name: str) -> None:
+        try:
+            ok = await self.repository.load(name)
+        except Exception as e:
+            raise ModelNotReady(name, f"Error type: {type(e)} error msg: {e}")
+        if not ok or not self.repository.is_model_ready(name):
+            raise ModelNotReady(name)
+
+    async def unload(self, name: str) -> None:
+        try:
+            await self.repository.unload(name)
+        except KeyError:
+            raise ModelNotFound(name)
+
+    def repository_index(self) -> List[Dict[str, Any]]:
+        """V2 repository index extension (Triton-style)."""
+        return [
+            {"name": m.name, "state": "READY" if m.ready else "UNAVAILABLE"}
+            for m in self.repository.get_models()
+        ]

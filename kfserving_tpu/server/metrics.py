@@ -1,0 +1,86 @@
+"""Prometheus-text-format metrics for the model server.
+
+The reference delegates request metrics to the Knative queue-proxy
+(reference test/benchmark/README.md:5-12) and exposes controller metrics on
+:8080 (reference cmd/manager/main.go:60-61).  The TPU server is its own
+sidecar-free process, so it exposes request counts/latency histograms and
+engine gauges (batch sizes, compile cache, HBM) directly on /metrics.
+"""
+
+import bisect
+import time
+from typing import Dict, List, Tuple
+
+LATENCY_BUCKETS_MS = [0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
+                      5000, 10000]
+
+
+class Histogram:
+    __slots__ = ("buckets", "counts", "total", "sum")
+
+    def __init__(self, buckets: List[float] = LATENCY_BUCKETS_MS):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, value)] += 1
+        self.total += 1
+        self.sum += value
+
+
+class Metrics:
+    def __init__(self):
+        self.request_count: Dict[Tuple[str, str, int], int] = {}
+        self.latency: Dict[Tuple[str, str], Histogram] = {}
+        self.gauges: Dict[str, float] = {}
+        self.start_time = time.time()
+
+    def observe_request(self, model: str, verb: str, status: int,
+                        latency_ms: float) -> None:
+        key = (model, verb, status)
+        self.request_count[key] = self.request_count.get(key, 0) + 1
+        hkey = (model, verb)
+        if hkey not in self.latency:
+            self.latency[hkey] = Histogram()
+        self.latency[hkey].observe(latency_ms)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = value
+
+    def render(self) -> str:
+        lines = [
+            "# HELP kfserving_tpu_request_total Total requests by model/verb/status",
+            "# TYPE kfserving_tpu_request_total counter",
+        ]
+        for (model, verb, status), count in sorted(self.request_count.items()):
+            lines.append(
+                f'kfserving_tpu_request_total{{model="{model}",verb="{verb}",'
+                f'status="{status}"}} {count}')
+        lines += [
+            "# HELP kfserving_tpu_request_latency_ms Request latency histogram",
+            "# TYPE kfserving_tpu_request_latency_ms histogram",
+        ]
+        for (model, verb), hist in sorted(self.latency.items()):
+            cumulative = 0
+            for bound, count in zip(hist.buckets, hist.counts):
+                cumulative += count
+                lines.append(
+                    f'kfserving_tpu_request_latency_ms_bucket{{model="{model}",'
+                    f'verb="{verb}",le="{bound}"}} {cumulative}')
+            lines.append(
+                f'kfserving_tpu_request_latency_ms_bucket{{model="{model}",'
+                f'verb="{verb}",le="+Inf"}} {hist.total}')
+            lines.append(
+                f'kfserving_tpu_request_latency_ms_sum{{model="{model}",'
+                f'verb="{verb}"}} {hist.sum}')
+            lines.append(
+                f'kfserving_tpu_request_latency_ms_count{{model="{model}",'
+                f'verb="{verb}"}} {hist.total}')
+        for name, value in sorted(self.gauges.items()):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {value}")
+        lines.append(
+            f"kfserving_tpu_uptime_seconds {time.time() - self.start_time}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,3 @@
+from kfserving_tpu.storage.storage import Storage
+
+__all__ = ["Storage"]

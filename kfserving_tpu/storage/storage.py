@@ -1,0 +1,237 @@
+"""Model artifact storage: `Storage.download(uri, out_dir)` dispatch matrix.
+
+Re-implements the reference storage layer (reference python/kfserving/
+kfserving/storage.py:42-283) with the same URI scheme dispatch:
+
+- `gs://`      Google Cloud Storage (anonymous fallback, storage.py:104-134)
+- `s3://`      S3-compatible (env-configured endpoint, storage.py:82-101)
+- `azure://`   (https://<account>.blob.core.windows.net/..., storage.py:137-204)
+- `file://`    local symlink (storage.py:206-225)
+- `http(s)://` download, unpacking zip/tar/tgz (storage.py:227-271)
+- `pvc://`     mounted volume path
+- local path   passthrough
+- `mms://`     multi-model passthrough marker (storage.py:69-72)
+
+Cloud SDKs are optional: providers raise a clear error when the client
+library is absent (this environment is hermetic).  Downloads are idempotent
+via `SUCCESS.<sha256(uri)>` marker files, the same scheme the reference Go
+agent uses to skip completed pulls across restarts
+(reference pkg/agent/downloader.go:42-75).
+"""
+
+import glob
+import gzip
+import hashlib
+import json
+import logging
+import os
+import re
+import shutil
+import tarfile
+import tempfile
+import zipfile
+from typing import Optional
+from urllib.parse import urlparse
+from urllib.request import Request as UrlRequest
+from urllib.request import urlopen
+
+logger = logging.getLogger("kfserving_tpu.storage")
+
+_GCS_PREFIX = "gs://"
+_S3_PREFIX = "s3://"
+_AZURE_BLOB_RE = r"https://(.+?)\.blob\.core\.windows\.net/(.+)"
+_LOCAL_PREFIX = "file://"
+_PVC_PREFIX = "pvc://"
+_MMS_PREFIX = "mms://"
+_HTTP_PREFIX = ("http://", "https://")
+
+_ARCHIVE_SUFFIXES = (".tar", ".tgz", ".tar.gz", ".zip", ".gz")
+
+
+def _success_marker(uri: str, out_dir: str) -> str:
+    digest = hashlib.sha256(uri.encode("utf-8")).hexdigest()
+    return os.path.join(out_dir, f"SUCCESS.{digest}")
+
+
+class Storage:
+    """Static download dispatcher, reference storage.py:42 equivalent."""
+
+    @staticmethod
+    def download(uri: str, out_dir: Optional[str] = None) -> str:
+        logger.info("Copying contents of %s to local", uri)
+        if uri.startswith(_MMS_PREFIX):
+            # Multi-model passthrough: artifacts are pulled per-TrainedModel
+            # by the agent (reference storage.py:69-72).
+            return uri
+        is_local = uri.startswith(_LOCAL_PREFIX) or os.path.exists(uri)
+        if out_dir is None:
+            if is_local:
+                return Storage._download_local(uri, None)
+            out_dir = tempfile.mkdtemp()
+        os.makedirs(out_dir, exist_ok=True)
+
+        marker = _success_marker(uri, out_dir)
+        if os.path.exists(marker) and not is_local:
+            logger.info("Found %s, skipping download of %s", marker, uri)
+            return out_dir
+
+        if uri.startswith(_GCS_PREFIX):
+            Storage._download_gcs(uri, out_dir)
+        elif uri.startswith(_S3_PREFIX):
+            Storage._download_s3(uri, out_dir)
+        elif re.search(_AZURE_BLOB_RE, uri):
+            Storage._download_azure(uri, out_dir)
+        elif uri.startswith(_PVC_PREFIX):
+            return Storage._download_local(
+                "file:///" + uri[len(_PVC_PREFIX):], out_dir)
+        elif is_local:
+            return Storage._download_local(uri, out_dir)
+        elif uri.startswith(_HTTP_PREFIX):
+            Storage._download_from_uri(uri, out_dir)
+        else:
+            raise Exception(
+                "Cannot recognize storage type for " + uri +
+                "\n'%s', '%s', '%s', and '%s' are the current available "
+                "storage type." % (_GCS_PREFIX, _S3_PREFIX, _LOCAL_PREFIX,
+                                   "https://"))
+        with open(marker, "w") as f:
+            f.write(uri)
+        logger.info("Successfully copied %s to %s", uri, out_dir)
+        return out_dir
+
+    # -- local -------------------------------------------------------------
+    @staticmethod
+    def _download_local(uri: str, out_dir: Optional[str]) -> str:
+        """Symlink local artifacts into out_dir (reference storage.py:206-225)."""
+        local_path = uri[len(_LOCAL_PREFIX):] if uri.startswith(_LOCAL_PREFIX) else uri
+        if not os.path.exists(local_path):
+            raise RuntimeError("Local path %s does not exist." % uri)
+        if out_dir is None:
+            return local_path
+        if os.path.isdir(local_path):
+            local_path = os.path.join(local_path, "*")
+        matched = glob.glob(local_path)
+        if not matched:
+            raise RuntimeError("Local path %s does not exist." % uri)
+        for src in matched:
+            _, tail = os.path.split(src)
+            dest_path = os.path.join(out_dir, tail)
+            if src != dest_path and not os.path.exists(dest_path):
+                os.symlink(src, dest_path)
+        return out_dir
+
+    # -- http --------------------------------------------------------------
+    @staticmethod
+    def _download_from_uri(uri: str, out_dir: str) -> str:
+        """HTTP(S) download with archive extraction (reference storage.py:227-271)."""
+        parsed = urlparse(uri)
+        filename = os.path.basename(parsed.path)
+        if not filename:
+            raise ValueError("No filename contained in URI: %s" % uri)
+        mimetype, encoding = _guess_type(filename)
+        local_path = os.path.join(out_dir, filename)
+        req = UrlRequest(uri, headers={"User-Agent": "kfserving-tpu/0.1"})
+        with urlopen(req) as response:
+            if response.status != 200:
+                raise RuntimeError(
+                    "URI: %s returned a %s response code." % (uri, response.status))
+            if encoding == "gzip" and mimetype != "application/x-tar":
+                # plain .gz file: decompress to the stem name
+                stem = filename[:-3]
+                with open(os.path.join(out_dir, stem), "wb") as out:
+                    shutil.copyfileobj(gzip.GzipFile(fileobj=response), out)
+                return out_dir
+            with open(local_path, "wb") as out:
+                shutil.copyfileobj(response, out)
+        if mimetype == "application/zip":
+            with zipfile.ZipFile(local_path, "r") as zf:
+                zf.extractall(out_dir)
+            os.remove(local_path)
+        elif mimetype == "application/x-tar":
+            with tarfile.open(local_path, "r") as tf:
+                tf.extractall(out_dir)  # noqa: S202 - trusted model artifact
+            os.remove(local_path)
+        return out_dir
+
+    # -- cloud providers (optional SDKs) ------------------------------------
+    @staticmethod
+    def _download_gcs(uri: str, out_dir: str) -> None:
+        try:
+            from google.auth import exceptions
+            from google.cloud import storage as gcs
+        except ImportError:
+            raise RuntimeError(
+                "google-cloud-storage is not installed; cannot download %s" % uri)
+        try:
+            client = gcs.Client()
+        except exceptions.DefaultCredentialsError:
+            client = gcs.Client.create_anonymous_client()
+        bucket_name, _, prefix = uri[len(_GCS_PREFIX):].partition("/")
+        bucket = client.bucket(bucket_name, user_project=None)
+        for blob in bucket.list_blobs(prefix=prefix):
+            name = blob.name.replace(prefix, "", 1).lstrip("/")
+            if not name:
+                name = os.path.basename(prefix)
+            dest = os.path.join(out_dir, name)
+            os.makedirs(os.path.dirname(dest) or out_dir, exist_ok=True)
+            if not blob.name.endswith("/"):
+                blob.download_to_filename(dest)
+
+    @staticmethod
+    def _download_s3(uri: str, out_dir: str) -> None:
+        """S3 via Minio client configured from env, reference storage.py:82-101,
+        273-282 (S3_ENDPOINT/AWS_* variables)."""
+        try:
+            from minio import Minio
+        except ImportError:
+            raise RuntimeError("minio is not installed; cannot download %s" % uri)
+        endpoint = os.getenv("AWS_ENDPOINT_URL",
+                             os.getenv("S3_ENDPOINT", "s3.amazonaws.com"))
+        # Accept 1/0/true/false in any case (reference storage.py compares
+        # against "0"; k8s users commonly set "False").
+        use_ssl = os.getenv("S3_USE_HTTPS", "true").strip().lower() not in (
+            "0", "false", "no")
+        endpoint = re.sub(r"^https?://", "", endpoint)
+        client = Minio(endpoint,
+                       access_key=os.getenv("AWS_ACCESS_KEY_ID", ""),
+                       secret_key=os.getenv("AWS_SECRET_ACCESS_KEY", ""),
+                       region=os.getenv("AWS_REGION", ""),
+                       secure=use_ssl)
+        bucket_name, _, prefix = uri[len(_S3_PREFIX):].partition("/")
+        for obj in client.list_objects(bucket_name, prefix=prefix,
+                                       recursive=True):
+            name = obj.object_name.replace(prefix, "", 1).lstrip("/")
+            dest = os.path.join(out_dir, name or os.path.basename(prefix))
+            os.makedirs(os.path.dirname(dest) or out_dir, exist_ok=True)
+            client.fget_object(bucket_name, obj.object_name, dest)
+
+    @staticmethod
+    def _download_azure(uri: str, out_dir: str) -> None:
+        try:
+            from azure.storage.blob import BlobServiceClient
+        except ImportError:
+            raise RuntimeError(
+                "azure-storage-blob is not installed; cannot download %s" % uri)
+        match = re.search(_AZURE_BLOB_RE, uri)
+        account_url = f"https://{match.group(1)}.blob.core.windows.net"
+        container, _, prefix = match.group(2).partition("/")
+        client = BlobServiceClient(account_url)
+        container_client = client.get_container_client(container)
+        for blob in container_client.list_blobs(name_starts_with=prefix):
+            name = blob.name.replace(prefix, "", 1).lstrip("/")
+            dest = os.path.join(out_dir, name or os.path.basename(prefix))
+            os.makedirs(os.path.dirname(dest) or out_dir, exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(container_client.download_blob(blob.name).readall())
+
+
+def _guess_type(filename: str):
+    if filename.endswith(".tar.gz") or filename.endswith(".tgz"):
+        return "application/x-tar", "gzip"
+    if filename.endswith(".tar"):
+        return "application/x-tar", None
+    if filename.endswith(".zip"):
+        return "application/zip", None
+    if filename.endswith(".gz"):
+        return None, "gzip"
+    return None, None

@@ -1,0 +1,42 @@
+"""Test configuration: hermetic CPU backend with 8 virtual devices.
+
+Mirrors the reference test strategy (SURVEY.md §4): control-plane and
+data-plane logic runs without real infrastructure.  Multi-chip sharding
+tests use an 8-device virtual CPU mesh
+(xla_force_host_platform_device_count), the TPU analogue of envtest.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "asyncio: run the test inside a fresh asyncio event loop")
+    config.addinivalue_line(
+        "markers", "tpu: requires real TPU hardware (skipped on CPU backend)")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Run `async def` tests in a fresh event loop (no pytest-asyncio in the
+    hermetic environment)."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {name: pyfuncitem.funcargs[name]
+                  for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(func(**kwargs))
+        return True
+    return None

@@ -1,0 +1,118 @@
+"""Protocol codec tests: V1 validation, V2 tensor round-trips, CloudEvents."""
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.protocol import cloudevents, v1, v2
+from kfserving_tpu.protocol.errors import InvalidInput
+
+
+class TestV1:
+    def test_get_instances(self):
+        assert v1.get_instances({"instances": [1, 2]}) == [1, 2]
+        assert v1.get_instances({"inputs": [3]}) == [3]
+
+    def test_rejects_non_list(self):
+        with pytest.raises(InvalidInput):
+            v1.get_instances({"instances": "x"})
+        with pytest.raises(InvalidInput):
+            v1.get_instances({"inputs": 5})
+
+    def test_rejects_missing(self):
+        with pytest.raises(InvalidInput):
+            v1.get_instances({"other": []})
+
+    def test_response(self):
+        assert v1.make_response([1]) == {"predictions": [1]}
+
+
+class TestV2:
+    def test_round_trip_fp32(self):
+        req = v2.InferRequest.from_dict({
+            "id": "1",
+            "inputs": [{"name": "x", "shape": [2, 2], "datatype": "FP32",
+                        "data": [1.0, 2.0, 3.0, 4.0]}],
+        })
+        arr = req.inputs[0].as_numpy()
+        assert arr.shape == (2, 2) and arr.dtype == np.float32
+        out = v2.tensor_to_output("y", arr)
+        assert out["shape"] == [2, 2]
+        assert out["datatype"] == "FP32"
+        assert out["data"] == [1.0, 2.0, 3.0, 4.0]
+
+    def test_nested_data(self):
+        req = v2.InferRequest.from_dict({
+            "inputs": [{"name": "x", "shape": [2, 2], "datatype": "INT64",
+                        "data": [[1, 2], [3, 4]]}],
+        })
+        arr = req.inputs[0].as_numpy()
+        assert arr.tolist() == [[1, 2], [3, 4]]
+
+    def test_shape_mismatch(self):
+        req = v2.InferRequest.from_dict({
+            "inputs": [{"name": "x", "shape": [3], "datatype": "FP32",
+                        "data": [1.0, 2.0]}],
+        })
+        with pytest.raises(InvalidInput):
+            req.inputs[0].as_numpy()
+
+    def test_bad_datatype(self):
+        req = v2.InferRequest.from_dict({
+            "inputs": [{"name": "x", "shape": [1], "datatype": "FP128",
+                        "data": [1.0]}],
+        })
+        with pytest.raises(InvalidInput):
+            req.inputs[0].as_numpy()
+
+    def test_missing_fields(self):
+        with pytest.raises(InvalidInput):
+            v2.InferRequest.from_dict({"inputs": [{"name": "x"}]})
+        with pytest.raises(InvalidInput):
+            v2.InferRequest.from_dict({})
+
+    def test_bf16_encoding(self):
+        import ml_dtypes
+
+        arr = np.array([1.5, 2.5], dtype=ml_dtypes.bfloat16)
+        out = v2.tensor_to_output("y", arr)
+        assert out["datatype"] == "BF16"
+        assert out["data"] == [1.5, 2.5]
+        back = v2.InferInput("y", out["shape"], "BF16", out["data"]).as_numpy()
+        assert back.dtype == ml_dtypes.bfloat16
+
+    def test_make_response(self):
+        resp = v2.make_response("m", {"out": np.zeros((1, 2), np.float32)},
+                                id="7")
+        assert resp["model_name"] == "m"
+        assert resp["id"] == "7"
+        assert resp["outputs"][0]["shape"] == [1, 2]
+
+
+class TestCloudEvents:
+    def test_binary_round_trip(self):
+        headers = {"ce-specversion": "1.0", "ce-id": "1",
+                   "ce-source": "urn:x", "ce-type": "t"}
+        ev = cloudevents.from_http(headers, b'{"a": 1}')
+        assert ev["source"] == "urn:x"
+        out_headers, body = cloudevents.to_binary(
+            cloudevents.CloudEvent(ev.attributes, {"b": 2}))
+        assert out_headers["ce-id"] == "1"
+        assert b'"b": 2' in body
+
+    def test_structured_round_trip(self):
+        import json
+
+        envelope = {"specversion": "1.0", "id": "1", "source": "urn:x",
+                    "type": "t", "data": {"a": 1}}
+        ev = cloudevents.from_http(
+            {"content-type": "application/cloudevents+json"},
+            json.dumps(envelope).encode())
+        assert ev.data == {"a": 1}
+        headers, body = cloudevents.to_structured(ev)
+        assert headers["content-type"].startswith(
+            "application/cloudevents+json")
+        assert json.loads(body)["data"] == {"a": 1}
+
+    def test_missing_required(self):
+        with pytest.raises(ValueError):
+            cloudevents.from_http({"ce-specversion": "1.0"}, b"")

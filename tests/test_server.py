@@ -1,0 +1,248 @@
+"""Data-plane server tests, modeled on the reference suite
+(reference python/kfserving/test/test_server.py:31-314): a dummy model,
+the full route table, error paths, and CloudEvents binary/structured modes.
+"""
+
+import asyncio
+import json
+from contextlib import asynccontextmanager
+
+from kfserving_tpu import Model
+from tests.utils import http_json, http_request, running_server
+
+
+class DummyModel(Model):
+    def __init__(self, name="TestModel"):
+        super().__init__(name)
+
+    def load(self):
+        self.ready = True
+        return self.ready
+
+    async def predict(self, request):
+        return {"predictions": request["instances"]}
+
+    async def explain(self, request):
+        return {"predictions": [[1, 2]]}
+
+
+@asynccontextmanager
+async def serve():
+    model = DummyModel()
+    model.load()
+    async with running_server([model]) as server:
+        yield server
+
+
+async def test_liveness():
+    async with serve() as server:
+        status, _, body = await http_request(server.http_port, "GET", "/")
+        assert status == 200 and body == b"Alive"
+        status, _, _ = await http_request(server.http_port, "GET",
+                                          "/v2/health/live")
+        assert status == 200
+
+
+async def test_list_models():
+    async with serve() as server:
+        status, body = await http_json(server.http_port, "GET", "/v1/models")
+        assert status == 200 and body == ["TestModel"]
+        status, body = await http_json(server.http_port, "GET", "/v2/models")
+        assert status == 200 and body == ["TestModel"]
+
+
+async def test_model_health():
+    async with serve() as server:
+        status, body = await http_json(server.http_port, "GET",
+                                       "/v1/models/TestModel")
+        assert status == 200 and body == {"name": "TestModel", "ready": True}
+        status, _ = await http_json(server.http_port, "GET",
+                                    "/v2/models/TestModel/status")
+        assert status == 200
+        status, _ = await http_json(server.http_port, "GET",
+                                    "/v1/models/Missing")
+        assert status == 404
+
+
+async def test_predict_v1():
+    async with serve() as server:
+        status, body = await http_json(
+            server.http_port, "POST", "/v1/models/TestModel:predict",
+            {"instances": [[1, 2]]})
+        assert status == 200
+        assert body == {"predictions": [[1, 2]]}
+
+
+async def test_infer_v2_routes_to_predict():
+    async with serve() as server:
+        status, body = await http_json(
+            server.http_port, "POST", "/v2/models/TestModel/infer",
+            {"instances": [[1, 2]]})
+        assert status == 200
+        assert body == {"predictions": [[1, 2]]}
+
+
+async def test_explain():
+    async with serve() as server:
+        status, body = await http_json(
+            server.http_port, "POST", "/v1/models/TestModel:explain",
+            {"instances": [[1, 2]]})
+        assert status == 200
+        assert body == {"predictions": [[1, 2]]}
+
+
+async def test_predict_unknown_model_404():
+    async with serve() as server:
+        status, body = await http_json(
+            server.http_port, "POST", "/v1/models/Nope:predict",
+            {"instances": [[1]]})
+        assert status == 404
+        assert "does not exist" in body["error"]
+
+
+async def test_predict_malformed_json_400():
+    async with serve() as server:
+        status, _, body = await http_request(
+            server.http_port, "POST", "/v1/models/TestModel:predict",
+            b"not json")
+        assert status == 400
+        assert b"Unrecognized request format" in body
+
+
+async def test_predict_instances_not_list_400():
+    async with serve() as server:
+        status, body = await http_json(
+            server.http_port, "POST", "/v1/models/TestModel:predict",
+            {"instances": "nope"})
+        assert status == 400
+        assert "to be a list" in body["error"]
+
+
+async def test_server_metadata():
+    async with serve() as server:
+        status, body = await http_json(server.http_port, "GET", "/v2")
+        assert status == 200
+        assert body["name"] == "kfserving-tpu"
+        assert "model_repository" in body["extensions"]
+
+
+async def test_load_unload():
+    async with serve() as server:
+        status, body = await http_json(
+            server.http_port, "POST", "/v2/repository/models/TestModel/load")
+        assert status == 200 and body == {"name": "TestModel", "load": True}
+        status, body = await http_json(
+            server.http_port, "POST",
+            "/v2/repository/models/TestModel/unload")
+        assert status == 200 and body == {"name": "TestModel", "unload": True}
+        status, body = await http_json(server.http_port, "GET", "/v1/models")
+        assert body == []
+        # unload of a gone model → 404 (reference kfserver.py:183-189)
+        status, _ = await http_json(
+            server.http_port, "POST",
+            "/v2/repository/models/TestModel/unload")
+        assert status == 404
+
+
+async def test_repository_index():
+    async with serve() as server:
+        status, body = await http_json(server.http_port, "GET",
+                                       "/v2/repository/index")
+        assert status == 200
+        assert body == [{"name": "TestModel", "state": "READY"}]
+
+
+async def test_metrics_endpoint():
+    async with serve() as server:
+        await http_json(server.http_port, "POST",
+                        "/v1/models/TestModel:predict", {"instances": [[1]]})
+        status, _, body = await http_request(server.http_port, "GET",
+                                             "/metrics")
+        assert status == 200
+        assert b"kfserving_tpu_request_total" in body
+
+
+async def test_cloudevents_binary():
+    """Binary CE request → response carries ce- headers."""
+    async with serve() as server:
+        payload = json.dumps({"instances": [[1, 2]]}).encode()
+        headers = {
+            "ce-specversion": "1.0",
+            "ce-id": "abc-123",
+            "ce-source": "urn:test",
+            "ce-type": "org.test.request",
+            "content-type": "application/json",
+        }
+        status, resp_headers, body = await http_request(
+            server.http_port, "POST", "/v1/models/TestModel:predict",
+            payload, headers)
+        assert status == 200
+        assert resp_headers["ce-specversion"] == "1.0"
+        assert resp_headers["ce-id"] == "abc-123"
+        assert "ce-time" in resp_headers
+        assert json.loads(body) == {"predictions": [[1, 2]]}
+
+
+async def test_cloudevents_structured():
+    async with serve() as server:
+        envelope = {
+            "specversion": "1.0", "id": "x", "source": "urn:test",
+            "type": "org.test.request", "time": "2026-01-01T00:00:00Z",
+            "data": {"instances": [[3, 4]]},
+        }
+        status, resp_headers, body = await http_request(
+            server.http_port, "POST", "/v1/models/TestModel:predict",
+            json.dumps(envelope).encode(),
+            {"content-type": "application/cloudevents+json"})
+        assert status == 200
+        out = json.loads(body)
+        assert out["data"] == {"predictions": [[3, 4]]}
+        assert out["id"] == "x"
+
+
+async def test_keepalive_multiple_requests():
+    """Two requests on one connection (keep-alive ordering)."""
+    async with serve() as server:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", server.http_port)
+        payload = json.dumps({"instances": [[1]]}).encode()
+        req = (f"POST /v1/models/TestModel:predict HTTP/1.1\r\n"
+               f"host: x\r\ncontent-length: {len(payload)}\r\n\r\n"
+               ).encode() + payload
+        writer.write(req + req)
+        await writer.drain()
+        data = b""
+        while data.count(b"HTTP/1.1 200") < 2:
+            chunk = await reader.read(4096)
+            assert chunk, f"connection closed early: {data!r}"
+            data += chunk
+        writer.close()
+
+
+async def test_chunked_request_body():
+    async with serve() as server:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", server.http_port)
+        payload = json.dumps({"instances": [[9]]}).encode()
+        head = ("POST /v1/models/TestModel:predict HTTP/1.1\r\n"
+                "host: x\r\ntransfer-encoding: chunked\r\n"
+                "connection: close\r\n\r\n").encode()
+        chunked = b"%x\r\n%s\r\n0\r\n\r\n" % (len(payload), payload)
+        writer.write(head + chunked)
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        assert b"HTTP/1.1 200" in raw
+        assert b'[[9]]' in raw
+
+
+async def test_not_ready_model_lazy_loads():
+    async with serve() as server:
+        model = DummyModel("lazy")
+        server.register_model(model)  # never load()ed
+        status, body = await http_json(
+            server.http_port, "POST", "/v1/models/lazy:predict",
+            {"instances": [[5]]})
+        # lazy load on first request, reference handlers/http.py:32-41
+        assert status == 200
+        assert body == {"predictions": [[5]]}

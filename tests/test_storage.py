@@ -1,0 +1,149 @@
+"""Storage matrix tests (reference python/kfserving/test/test_storage.py)."""
+
+import os
+import tarfile
+import zipfile
+
+import pytest
+
+from kfserving_tpu.storage import Storage
+
+
+def test_local_passthrough(tmp_path):
+    src = tmp_path / "model"
+    src.mkdir()
+    (src / "weights.bin").write_bytes(b"abc")
+    out = Storage.download(str(src))
+    assert out == str(src)
+
+
+def test_local_symlink_into_out_dir(tmp_path):
+    src = tmp_path / "model"
+    src.mkdir()
+    (src / "weights.bin").write_bytes(b"abc")
+    out_dir = tmp_path / "out"
+    out = Storage.download(str(src), str(out_dir))
+    assert (out_dir / "weights.bin").read_bytes() == b"abc"
+    assert out == str(out_dir)
+
+
+def test_file_uri(tmp_path):
+    src = tmp_path / "m"
+    src.mkdir()
+    (src / "f.txt").write_text("hi")
+    out_dir = tmp_path / "o"
+    Storage.download(f"file://{src}", str(out_dir))
+    assert (out_dir / "f.txt").read_text() == "hi"
+
+
+def test_missing_local_path_raises(tmp_path):
+    # A nonexistent bare path is not recognized as any storage type, same as
+    # the reference dispatch (storage.py:42-79).
+    with pytest.raises(Exception, match="Cannot recognize storage type"):
+        Storage.download(str(tmp_path / "nope" / "missing"))
+    with pytest.raises(RuntimeError, match="does not exist"):
+        Storage.download(f"file://{tmp_path}/nope/missing")
+
+
+def test_unknown_scheme_raises(tmp_path):
+    with pytest.raises(Exception, match="Cannot recognize storage type"):
+        Storage.download("weird://bucket/path", str(tmp_path))
+
+
+def test_mms_passthrough():
+    assert Storage.download("mms://whatever") == "mms://whatever"
+
+
+def test_http_download_with_zip(tmp_path, monkeypatch):
+    """HTTP download path with archive extraction, served by a local file
+    fixture via a stub opener (no egress in the environment)."""
+    archive = tmp_path / "model.zip"
+    with zipfile.ZipFile(archive, "w") as zf:
+        zf.writestr("model.joblib", "MODELBYTES")
+
+    class FakeResponse:
+        status = 200
+
+        def __init__(self, path):
+            self._f = open(path, "rb")
+
+        def read(self, *a):
+            return self._f.read(*a)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            self._f.close()
+
+    from kfserving_tpu.storage import storage as storage_mod
+
+    monkeypatch.setattr(storage_mod, "urlopen",
+                        lambda req: FakeResponse(archive))
+    out_dir = tmp_path / "out"
+    Storage.download("http://example.com/model.zip", str(out_dir))
+    assert (out_dir / "model.joblib").read_text() == "MODELBYTES"
+    assert not (out_dir / "model.zip").exists()
+
+
+def test_http_download_tar(tmp_path, monkeypatch):
+    inner = tmp_path / "model.txt"
+    inner.write_text("T")
+    archive = tmp_path / "model.tar"
+    with tarfile.open(archive, "w") as tf:
+        tf.add(inner, arcname="model.txt")
+
+    class FakeResponse:
+        status = 200
+
+        def __init__(self, path):
+            self._f = open(path, "rb")
+
+        def read(self, *a):
+            return self._f.read(*a)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            self._f.close()
+
+    from kfserving_tpu.storage import storage as storage_mod
+
+    monkeypatch.setattr(storage_mod, "urlopen",
+                        lambda req: FakeResponse(archive))
+    out_dir = tmp_path / "out"
+    Storage.download("http://example.com/model.tar", str(out_dir))
+    assert (out_dir / "model.txt").read_text() == "T"
+
+
+def test_idempotent_success_marker(tmp_path, monkeypatch):
+    """Second download of the same URI is skipped via SUCCESS.<sha> marker
+    (reference pkg/agent/downloader.go:42-75 behavior)."""
+    calls = []
+
+    class FakeResponse:
+        status = 200
+
+        def __init__(self):
+            calls.append(1)
+
+        def read(self, *a):
+            return b""
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            pass
+
+    from kfserving_tpu.storage import storage as storage_mod
+
+    monkeypatch.setattr(storage_mod, "urlopen",
+                        lambda req: FakeResponse())
+    out_dir = tmp_path / "out"
+    Storage.download("http://example.com/weights.bin", str(out_dir))
+    Storage.download("http://example.com/weights.bin", str(out_dir))
+    assert len(calls) == 1
+    markers = [f for f in os.listdir(out_dir) if f.startswith("SUCCESS.")]
+    assert len(markers) == 1
