@@ -1,0 +1,3 @@
+from kfserving_tpu.batching.batcher import BatchResult, DynamicBatcher
+
+__all__ = ["DynamicBatcher", "BatchResult"]

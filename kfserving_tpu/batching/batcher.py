@@ -1,0 +1,160 @@
+"""In-process dynamic request batcher, shape-bucket aware.
+
+Re-implements the observable semantics of the reference Go agent batcher
+(reference pkg/batcher/handler.go):
+
+- requests accumulate until `max_batch_size` instances are queued or the
+  oldest request has waited `max_latency_ms` (reference handler.go:176-183,
+  defaults 32 / 5000ms at handler.go:32-36);
+- each caller gets back exactly its own predictions, scattered by index
+  (reference handler.go:138-150);
+- a batch result whose prediction count mismatches the instance count is an
+  error: "size of prediction is not equal to the size of instances"
+  (reference handler.go:129-137);
+- every flushed batch is tagged with a fresh batch id (reference
+  handler.go:107).
+
+Differences, by design (SURVEY.md §7.3):
+
+- **In-process asyncio**, not an HTTP-hairpin sidecar.  The reference POSTs
+  the merged batch back through `httptest.NewRecorder` into the next handler
+  (handler.go:98-105) — a serialization round-trip per batch.  Here the
+  batcher awaits the model's batch callable directly.
+- **Event-driven flush.**  The reference polls every 100µs
+  (handler.go:33,171); we schedule a per-batch deadline timer and flush
+  immediately on size, so flush latency is not quantized.
+- **Shape bucketing.**  A `key_fn` partitions requests into independent
+  batches (e.g. by padded sequence-length bucket) so one XLA-compiled shape
+  serves each batch — the TPU-native concern the reference never had.
+"""
+
+import asyncio
+import logging
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Dict, Hashable, List, Optional
+
+logger = logging.getLogger("kfserving_tpu.batcher")
+
+DEFAULT_MAX_BATCH_SIZE = 32   # reference handler.go:34
+DEFAULT_MAX_LATENCY_MS = 5000  # reference handler.go:35
+
+
+class BatchSizeMismatch(Exception):
+    def __init__(self):
+        super().__init__("size of prediction is not equal to the size of instances")
+
+
+@dataclass
+class BatchResult:
+    predictions: List[Any]
+    batch_id: str
+
+
+@dataclass
+class _Pending:
+    instances: List[Any] = field(default_factory=list)
+    waiters: List = field(default_factory=list)  # (start, count, future)
+    timer: Optional[asyncio.TimerHandle] = None
+
+
+BatchHandler = Callable[[List[Any]], Awaitable[List[Any]]]
+
+
+class DynamicBatcher:
+    """Coalesce per-request instance lists into batched handler calls.
+
+    handler: async callable mapping a list of instances to a same-length list
+    of predictions (the whole batch in one call — on the TPU path this is a
+    single padded jit invocation).
+    key_fn: optional shape-bucket key; requests with different keys never
+    share a batch.  The handler receives (instances, key) when key_fn is set.
+    """
+
+    def __init__(self, handler: BatchHandler,
+                 max_batch_size: int = DEFAULT_MAX_BATCH_SIZE,
+                 max_latency_ms: float = DEFAULT_MAX_LATENCY_MS,
+                 key_fn: Optional[Callable[[Any], Hashable]] = None):
+        if max_batch_size <= 0:
+            max_batch_size = DEFAULT_MAX_BATCH_SIZE
+        if max_latency_ms <= 0:
+            max_latency_ms = DEFAULT_MAX_LATENCY_MS
+        self.handler = handler
+        self.max_batch_size = max_batch_size
+        self.max_latency_ms = max_latency_ms
+        self.key_fn = key_fn
+        self._pending: Dict[Hashable, _Pending] = {}
+        # Telemetry for the metrics endpoint / bucket tuning.
+        self.batches_flushed = 0
+        self.instances_batched = 0
+        self.last_batch_size = 0
+
+    async def submit(self, instances: List[Any]) -> BatchResult:
+        """Enqueue one request's instances; resolves with its own predictions."""
+        if not instances:
+            raise ValueError("no instances in the request")
+        key = self.key_fn(instances[0]) if self.key_fn else None
+        loop = asyncio.get_running_loop()
+        pending = self._pending.get(key)
+        if pending is None:
+            pending = _Pending()
+            self._pending[key] = pending
+            pending.timer = loop.call_later(
+                self.max_latency_ms / 1000.0, self._flush_by_timer, key)
+        start = len(pending.instances)
+        pending.instances.extend(instances)
+        future = loop.create_future()
+        pending.waiters.append((start, len(instances), future))
+        if len(pending.instances) >= self.max_batch_size:
+            self._begin_flush(key)
+        return await future
+
+    def _flush_by_timer(self, key: Hashable):
+        if key in self._pending and self._pending[key].instances:
+            self._begin_flush(key)
+
+    def _begin_flush(self, key: Hashable):
+        pending = self._pending.pop(key, None)
+        if pending is None:
+            return
+        if pending.timer is not None:
+            pending.timer.cancel()
+        asyncio.ensure_future(self._run_batch(key, pending))
+
+    async def _run_batch(self, key: Hashable, pending: _Pending):
+        batch_id = str(uuid.uuid4())
+        try:
+            if self.key_fn is not None:
+                predictions = await self.handler(pending.instances, key)
+            else:
+                predictions = await self.handler(pending.instances)
+            if len(predictions) != len(pending.instances):
+                raise BatchSizeMismatch()
+        except Exception as e:
+            for _, _, future in pending.waiters:
+                if not future.done():
+                    future.set_exception(
+                        e if len(pending.waiters) == 1 else _clone_exc(e))
+            return
+        self.batches_flushed += 1
+        self.instances_batched += len(pending.instances)
+        self.last_batch_size = len(pending.instances)
+        for start, count, future in pending.waiters:
+            if not future.done():
+                future.set_result(BatchResult(
+                    predictions[start:start + count], batch_id))
+
+    async def flush(self):
+        """Force-flush all pending batches (shutdown/drain path)."""
+        keys = list(self._pending.keys())
+        for key in keys:
+            self._begin_flush(key)
+        # yield so the flush tasks run
+        await asyncio.sleep(0)
+
+
+def _clone_exc(e: Exception) -> Exception:
+    try:
+        return type(e)(*e.args)
+    except Exception:
+        return RuntimeError(str(e))

@@ -1,0 +1,4 @@
+from kfserving_tpu.engine.buckets import BucketPolicy
+from kfserving_tpu.engine.jax_engine import JaxEngine
+
+__all__ = ["JaxEngine", "BucketPolicy"]

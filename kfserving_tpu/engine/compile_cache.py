@@ -1,0 +1,37 @@
+"""Persistent XLA compilation cache + warmup helpers.
+
+TPU cold start = pod start + model download + XLA compile.  The reference
+leans on the Knative activator for scale-from-zero buffering (reference
+test/benchmark/README.md:14-17); the TPU-native mitigation is a persistent
+compilation cache on disk so restarts skip recompiles (SURVEY.md §5.3), plus
+engine warmup tied into the readiness probe.
+"""
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("kfserving_tpu.compile_cache")
+
+DEFAULT_CACHE_DIR = os.path.expanduser("~/.cache/kfserving_tpu/xla")
+
+_initialized = False
+
+
+def enable(cache_dir: Optional[str] = None,
+           min_compile_time_secs: float = 0.5) -> str:
+    """Enable the JAX persistent compilation cache (idempotent)."""
+    global _initialized
+    cache_dir = cache_dir or os.environ.get(
+        "KFSERVING_TPU_COMPILE_CACHE", DEFAULT_CACHE_DIR)
+    if _initialized:
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_time_secs)
+    _initialized = True
+    logger.info("persistent XLA compile cache at %s", cache_dir)
+    return cache_dir

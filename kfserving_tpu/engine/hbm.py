@@ -1,0 +1,154 @@
+"""HBM accounting and eviction for multi-model serving.
+
+The reference's multi-model story is disk-based: the agent puller downloads
+artifacts and POSTs load/unload to the server (reference pkg/agent/
+puller.go:120-183), and the shard strategy is a stub that always returns
+shard 0 (reference pkg/controller/v1alpha1/trainedmodel/sharding/memory/
+strategy.go:29-39) with a declared-memory field on the TrainedModel spec
+(reference pkg/apis/serving/v1alpha1/trained_model.go:68-69).
+
+On TPU "loaded" means *resident in HBM*, which is the scarce resource.  This
+module makes the Memory field real (SURVEY.md §7 hard parts): an accountant
+tracks declared/measured bytes per model against the device budget, and an
+LRU policy picks eviction victims when a load would overflow.
+"""
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger("kfserving_tpu.hbm")
+
+
+def device_hbm_bytes(device=None) -> Optional[int]:
+    """Total HBM of the serving device, when the backend reports it."""
+    import jax
+
+    device = device or jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if stats:
+        return stats.get("bytes_limit")
+    return None
+
+
+def device_hbm_in_use(device=None) -> Optional[int]:
+    import jax
+
+    device = device or jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if stats:
+        return stats.get("bytes_in_use")
+    return None
+
+
+class InsufficientHBM(Exception):
+    pass
+
+
+@dataclass
+class Residency:
+    name: str
+    bytes: int
+    loaded_at: float
+    last_used: float
+
+
+class HBMManager:
+    """Bin-packing accountant for model residency on one device/mesh.
+
+    budget_bytes: capacity to pack into (defaults to 90% of reported HBM, or
+    a conservative 12 GiB if the backend doesn't report — v5e has 16 GiB).
+    evict_cb: called with a model name when the manager decides to evict; the
+    callback must actually free the model (engine.close()).
+    """
+
+    DEFAULT_BUDGET = 12 * 1024**3
+
+    def __init__(self, budget_bytes: Optional[int] = None,
+                 evict_cb: Optional[Callable[[str], None]] = None,
+                 headroom: float = 0.10):
+        if budget_bytes is None:
+            total = device_hbm_bytes()
+            budget_bytes = (int(total * (1 - headroom)) if total
+                            else self.DEFAULT_BUDGET)
+        self.budget_bytes = budget_bytes
+        self.evict_cb = evict_cb
+        self._resident: "OrderedDict[str, Residency]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @property
+    def used_bytes(self) -> int:
+        return sum(r.bytes for r in self._resident.values())
+
+    @property
+    def free_bytes(self) -> int:
+        return self.budget_bytes - self.used_bytes
+
+    def resident_models(self) -> List[str]:
+        return list(self._resident.keys())
+
+    def can_fit(self, nbytes: int) -> bool:
+        return nbytes <= self.free_bytes
+
+    def admit(self, name: str, nbytes: int, evict: bool = True) -> List[str]:
+        """Account for a model of `nbytes` being loaded.
+
+        Returns the list of models evicted to make room.  Raises
+        InsufficientHBM if the model can never fit (bigger than budget) or
+        eviction is disabled and there is no room.
+        """
+        with self._lock:
+            if nbytes > self.budget_bytes:
+                raise InsufficientHBM(
+                    f"model {name} needs {nbytes} bytes; budget is "
+                    f"{self.budget_bytes}")
+            evicted = []
+            while nbytes > self.budget_bytes - sum(
+                    r.bytes for r in self._resident.values()):
+                if not evict:
+                    raise InsufficientHBM(
+                        f"model {name} needs {nbytes} bytes; only "
+                        f"{self.free_bytes} free and eviction disabled")
+                victim = self._pick_victim(exclude=name)
+                if victim is None:
+                    raise InsufficientHBM(
+                        f"model {name} needs {nbytes} bytes; nothing left "
+                        f"to evict")
+                self._resident.pop(victim)
+                evicted.append(victim)
+            now = time.time()
+            self._resident[name] = Residency(name, nbytes, now, now)
+        for victim in evicted:
+            logger.info("evicting model %s to fit %s", victim, name)
+            if self.evict_cb:
+                self.evict_cb(victim)
+        return evicted
+
+    def _pick_victim(self, exclude: str) -> Optional[str]:
+        for name, res in self._resident.items():  # OrderedDict = LRU order
+            if name != exclude:
+                return name
+        return None
+
+    def touch(self, name: str) -> None:
+        """Mark a model as recently used (moves it to MRU position)."""
+        with self._lock:
+            res = self._resident.get(name)
+            if res is not None:
+                res.last_used = time.time()
+                self._resident.move_to_end(name)
+
+    def release(self, name: str) -> None:
+        with self._lock:
+            self._resident.pop(name, None)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "budget_bytes": self.budget_bytes,
+            "used_bytes": self.used_bytes,
+            "free_bytes": self.free_bytes,
+            "resident_models": len(self._resident),
+        }

@@ -1,0 +1,181 @@
+"""JaxEngine: the TPU execution runtime behind a served model.
+
+The reference has no counterpart — it delegates accelerator execution to
+third-party servers (TFServing/Triton; SURVEY.md §7.2).  This engine is the
+new native heart:
+
+- one jit-compiled executable per (batch-bucket, extra dynamic dims) shape,
+  compiled against params already resident in HBM;
+- requests are padded up to the nearest bucket and sliced back after;
+- execution runs in a worker thread so the asyncio serving loop never blocks
+  on device latency (`jax.block_until_ready` happens off-loop);
+- optional sharded execution: params placed with a NamedSharding over a
+  device mesh make every bucketed executable an SPMD program over ICI
+  (tensor parallelism for models larger than one chip);
+- warmup() pre-compiles all buckets so readiness gating can include compile
+  time (SURVEY.md §5.3 cold-start mitigation), complementing the persistent
+  XLA compilation cache (engine/compile_cache.py).
+"""
+
+import asyncio
+import concurrent.futures
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kfserving_tpu.engine.buckets import BucketPolicy
+
+logger = logging.getLogger("kfserving_tpu.engine")
+
+
+class JaxEngine:
+    """Bucketed, padded, jit-compiled batch execution of `apply_fn(params, x)`.
+
+    apply_fn: a traceable function of (params, batch_array) or
+        (params, dict_of_batch_arrays) returning an array / pytree whose
+        leading axis is the batch dimension.
+    params: model parameters (pytree of jax arrays), already device_put
+        (possibly with NamedSharding for multi-chip).
+    batch_buckets: BucketPolicy for the leading batch dimension.
+    seq_buckets: optional BucketPolicy for axis 1 (sequence length) — used by
+        text models; images have static trailing dims.
+    """
+
+    def __init__(self, apply_fn: Callable, params: Any,
+                 batch_buckets: Optional[BucketPolicy] = None,
+                 seq_buckets: Optional[BucketPolicy] = None,
+                 dtype: Optional[Any] = None,
+                 pad_value: float = 0.0,
+                 donate_inputs: bool = False):
+        import jax
+
+        self._jax = jax
+        self.params = params
+        self.batch_buckets = batch_buckets or BucketPolicy.pow2(32)
+        self.seq_buckets = seq_buckets
+        self.dtype = dtype
+        self.pad_value = pad_value
+        # jax.jit caches one executable per padded shape signature; the
+        # bucket policies bound how many signatures can exist.
+        donate = (1,) if donate_inputs else ()
+        self._jitted = jax.jit(apply_fn, donate_argnums=donate)
+        # Single worker thread: TPU execution is serialized per device anyway,
+        # and one thread keeps the dispatch queue ordered.
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="jax-engine")
+        # Telemetry
+        self.compile_count = 0
+        self.execute_count = 0
+        self.last_execute_ms = 0.0
+        self.padded_waste_total = 0.0
+
+    # -- shape plumbing ------------------------------------------------------
+    def _pad_to_bucket(self, arr: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Pad leading (and optionally seq) dims to bucket sizes."""
+        n = arr.shape[0]
+        b = self.batch_buckets.fit(n)
+        if b is None:
+            raise ValueError(
+                f"batch of {n} exceeds the largest compiled bucket "
+                f"{self.batch_buckets.max}")
+        pad = [(0, b - n)] + [(0, 0)] * (arr.ndim - 1)
+        if self.seq_buckets is not None and arr.ndim >= 2:
+            s = self.seq_buckets.fit(arr.shape[1])
+            if s is None:
+                raise ValueError(
+                    f"sequence length {arr.shape[1]} exceeds the largest "
+                    f"bucket {self.seq_buckets.max}")
+            pad[1] = (0, s - arr.shape[1])
+        if any(p[1] for p in pad):
+            arr = np.pad(arr, pad, constant_values=self.pad_value)
+        return arr, n
+
+    def _prepare(self, inputs: Any) -> Tuple[Any, int]:
+        if isinstance(inputs, dict):
+            padded = {}
+            n = None
+            for k, v in inputs.items():
+                arr = np.asarray(v)
+                if self.dtype is not None and np.issubdtype(
+                        arr.dtype, np.floating):
+                    arr = arr.astype(self.dtype)
+                p, n_k = self._pad_to_bucket(arr)
+                padded[k] = p
+                if n is None:
+                    n = n_k
+                elif n != n_k:
+                    raise ValueError("inconsistent batch sizes across inputs")
+            return padded, int(n)
+        arr = np.asarray(inputs)
+        if self.dtype is not None and np.issubdtype(arr.dtype, np.floating):
+            arr = arr.astype(self.dtype)
+        return self._pad_to_bucket(arr)
+
+    # -- execution -----------------------------------------------------------
+    def _execute_sync(self, inputs: Any) -> Any:
+        padded, n = self._prepare(inputs)
+        start = time.perf_counter()
+        out = self._jitted(self.params, padded)
+        out = self._jax.block_until_ready(out)
+        self.last_execute_ms = (time.perf_counter() - start) * 1000.0
+        self.execute_count += 1
+        bucket = (padded[next(iter(padded))] if isinstance(padded, dict)
+                  else padded).shape[0]
+        self.padded_waste_total += (bucket - n) / bucket
+        # Slice back to the true batch size on host.
+        return self._jax.tree.map(lambda a: np.asarray(a)[:n], out)
+
+    async def predict(self, inputs: Any) -> Any:
+        """Async batch predict: pads, executes on device off-loop, unpads."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._executor, self._execute_sync, inputs)
+
+    def predict_sync(self, inputs: Any) -> Any:
+        return self._execute_sync(inputs)
+
+    # -- lifecycle -----------------------------------------------------------
+    def warmup(self, example: Any, buckets: Optional[List[int]] = None) -> float:
+        """Pre-compile executables for all batch buckets (and the example's
+        seq bucket).  Returns total compile seconds.  `example` is a single
+        instance (no batch dim) as array or dict of arrays."""
+        start = time.perf_counter()
+        for b in (buckets or self.batch_buckets.buckets):
+            if isinstance(example, dict):
+                batch = {k: np.stack([np.asarray(v)] * b) for k, v in
+                         example.items()}
+            else:
+                batch = np.stack([np.asarray(example)] * b)
+            self._execute_sync(batch)
+            self.compile_count += 1
+        dt = time.perf_counter() - start
+        logger.info("warmup compiled %d buckets in %.1fs",
+                    len(buckets or self.batch_buckets.buckets), dt)
+        return dt
+
+    def param_bytes(self) -> int:
+        """Total parameter bytes (HBM residency of this model's weights)."""
+        leaves = self._jax.tree.leaves(self.params)
+        return sum(getattr(x, "nbytes", 0) for x in leaves)
+
+    def close(self):
+        """Release device references so HBM can be reclaimed."""
+        for leaf in self._jax.tree.leaves(self.params):
+            if hasattr(leaf, "delete"):
+                try:
+                    leaf.delete()
+                except Exception:  # already deleted / cpu array
+                    pass
+        self.params = None
+        self._executor.shutdown(wait=False)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "execute_count": self.execute_count,
+            "compile_count": self.compile_count,
+            "last_execute_ms": self.last_execute_ms,
+            "avg_pad_waste": (self.padded_waste_total / self.execute_count
+                              if self.execute_count else 0.0),
+        }

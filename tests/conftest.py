@@ -8,13 +8,20 @@ tests use an 8-device virtual CPU mesh
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Forced (not setdefault): the harness presets JAX_PLATFORMS to the TPU
+# platform and pre-imports jax via a sitecustomize, so we must both set the
+# env (for subprocesses) and update jax.config (for this process).  Tests
+# are hermetic on CPU — the real chip is for bench.py.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402

