@@ -41,8 +41,9 @@ DEFAULT_MAX_LATENCY_MS = 5000  # reference handler.go:35
 
 
 class BatchSizeMismatch(Exception):
-    def __init__(self):
-        super().__init__("size of prediction is not equal to the size of instances")
+    def __init__(self, message: str = "size of prediction is not equal to "
+                 "the size of instances"):
+        super().__init__(message)
 
 
 @dataclass
@@ -84,6 +85,9 @@ class DynamicBatcher:
         self.max_latency_ms = max_latency_ms
         self.key_fn = key_fn
         self._pending: Dict[Hashable, _Pending] = {}
+        # Strong refs to in-flight batch tasks: the event loop holds only
+        # weak refs, so an unreferenced task can be GC'd mid-batch.
+        self._tasks: set = set()
         # Telemetry for the metrics endpoint / bucket tuning.
         self.batches_flushed = 0
         self.instances_batched = 0
@@ -119,7 +123,9 @@ class DynamicBatcher:
             return
         if pending.timer is not None:
             pending.timer.cancel()
-        asyncio.ensure_future(self._run_batch(key, pending))
+        task = asyncio.ensure_future(self._run_batch(key, pending))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
 
     async def _run_batch(self, key: Hashable, pending: _Pending):
         batch_id = str(uuid.uuid4())
@@ -145,16 +151,20 @@ class DynamicBatcher:
                     predictions[start:start + count], batch_id))
 
     async def flush(self):
-        """Force-flush all pending batches (shutdown/drain path)."""
-        keys = list(self._pending.keys())
-        for key in keys:
+        """Force-flush all pending batches and drain in-flight ones
+        (shutdown path): returns only once every spawned batch task has
+        completed and all waiter futures are resolved."""
+        for key in list(self._pending.keys()):
             self._begin_flush(key)
-        # yield so the flush tasks run
-        await asyncio.sleep(0)
+        while self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
 
 
 def _clone_exc(e: Exception) -> Exception:
+    """Best-effort per-waiter copy of a batch failure; falls back to the
+    shared instance (type preservation matters more than isolation — HTTP
+    status mapping dispatches on the exception class)."""
     try:
         return type(e)(*e.args)
     except Exception:
-        return RuntimeError(str(e))
+        return e

@@ -15,23 +15,31 @@ logger = logging.getLogger("kfserving_tpu.compile_cache")
 
 DEFAULT_CACHE_DIR = os.path.expanduser("~/.cache/kfserving_tpu/xla")
 
-_initialized = False
+_active_dir: Optional[str] = None
 
 
 def enable(cache_dir: Optional[str] = None,
            min_compile_time_secs: float = 0.5) -> str:
-    """Enable the JAX persistent compilation cache (idempotent)."""
-    global _initialized
+    """Enable the JAX persistent compilation cache.
+
+    Idempotent for the same directory; a later call with a *different*
+    directory re-points the cache (and says so) rather than silently
+    returning an inactive path.
+    """
+    global _active_dir
     cache_dir = cache_dir or os.environ.get(
         "KFSERVING_TPU_COMPILE_CACHE", DEFAULT_CACHE_DIR)
-    if _initialized:
+    if _active_dir == cache_dir:
         return cache_dir
+    if _active_dir is not None:
+        logger.warning("re-pointing XLA compile cache %s -> %s",
+                       _active_dir, cache_dir)
     os.makedirs(cache_dir, exist_ok=True)
     import jax
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_time_secs)
-    _initialized = True
+    _active_dir = cache_dir
     logger.info("persistent XLA compile cache at %s", cache_dir)
     return cache_dir

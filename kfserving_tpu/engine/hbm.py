@@ -105,6 +105,9 @@ class HBMManager:
                 raise InsufficientHBM(
                     f"model {name} needs {nbytes} bytes; budget is "
                     f"{self.budget_bytes}")
+            # A reload replaces the old residency: drop it from the books
+            # first so it neither double-counts nor blocks eviction math.
+            self._resident.pop(name, None)
             evicted = []
             while nbytes > self.budget_bytes - sum(
                     r.bytes for r in self._resident.values()):

@@ -1,0 +1,28 @@
+"""Flagship TPU model zoo.
+
+The reference serves models as opaque artifacts executed by third-party
+servers (TFServing/Triton/torchserve — reference
+pkg/apis/serving/v1beta1/predictor.go:33-59); it ships no model code.  The
+TPU-native build instead ships first-party Flax implementations of the
+BASELINE.json benchmark configs so the jaxserver predictor runtime has real
+compiled graphs to serve:
+
+- resnet:   ResNet-50 v1.5 image classifier (flagship bench config #2)
+- bert:     BERT-base fill-mask (seq-len bucketed batching, config #3)
+- vit:      ViT-B/16 image classifier (config #5)
+- mlp:      small MLPs for multi-model hot-swap serving (config #4)
+
+All models follow the same convention: a `flax.linen.Module` plus a
+`create_<name>()` helper returning `(module, example_input)` so the engine,
+graft entry, and tests share one construction path.  Compute dtype defaults
+to bfloat16 on TPU (MXU-native) with float32 params.
+"""
+
+from kfserving_tpu.models.registry import (  # noqa: F401
+    ModelSpec,
+    apply_fn_for,
+    create_model,
+    init_params,
+    list_models,
+    register_model,
+)

@@ -1,0 +1,142 @@
+"""BERT encoder with an MLM head — the seq-len-bucketed serving config.
+
+BASELINE.json config #3: "jaxserver BERT-base fill-mask (seq-len bucketed
+batching)".  First-party Flax implementation (the reference ships no model
+code, SURVEY.md §2.2).
+
+TPU notes:
+- attention dispatches through kfserving_tpu.ops.dot_product_attention, so
+  long-sequence buckets hit the Pallas flash kernel;
+- seq-len is a compile-time shape: the engine's seq BucketPolicy pads token
+  batches to bucket boundaries (multiples of 128 — MXU/VPU lane friendly);
+- padding tokens are masked via attention_mask, so bucket padding never
+  leaks into real logits;
+- MLM head ties the embedding matrix (standard BERT weight tying) — one
+  fewer [vocab, hidden] tensor in HBM.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kfserving_tpu.ops import dot_product_attention
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_layers=12, num_heads=12, intermediate_size=3072,
+                 max_position=512, type_vocab_size=2,
+                 layer_norm_eps=1e-12, dtype=jnp.bfloat16):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_position = max_position
+        self.type_vocab_size = type_vocab_size
+        self.layer_norm_eps = layer_norm_eps
+        self.dtype = dtype
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        def proj(name):
+            return nn.DenseGeneral(
+                (cfg.num_heads, head_dim), dtype=cfg.dtype, name=name)
+
+        q = proj("query")(hidden)          # [B, L, H, D]
+        k = proj("key")(hidden)
+        v = proj("value")(hidden)
+        # mask [B, L] -> [B, 1, 1, L] broadcast over heads and query pos.
+        attn_mask = None
+        if mask is not None:
+            attn_mask = mask[:, None, None, :].astype(bool)
+        out = dot_product_attention(q, k, v, mask=attn_mask)
+        out = nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out")(out)
+        return out
+
+
+class BertLayer(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, mask):
+        cfg = self.config
+        attn = BertSelfAttention(cfg, name="attention")(hidden, mask)
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                              name="attention_norm")(hidden + attn)
+        mlp = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                       name="intermediate")(hidden)
+        mlp = nn.gelu(mlp, approximate=True)
+        mlp = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(mlp)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                            name="output_norm")(hidden + mlp)
+
+
+class BertForMaskedLM(nn.Module):
+    """Token ids -> MLM logits.  Inputs: input_ids [B, L] int32, optional
+    attention_mask [B, L] (1 = real token)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask: Optional[Any] = None,
+                 token_type_ids: Optional[Any] = None):
+        cfg = self.config
+        B, L = input_ids.shape
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                         dtype=cfg.dtype, name="word_embeddings")
+        hidden = embed(input_ids)
+        positions = jnp.arange(L)[None, :]
+        hidden += nn.Embed(cfg.max_position, cfg.hidden_size, dtype=cfg.dtype,
+                           name="position_embeddings")(positions)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        hidden += nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                           dtype=cfg.dtype,
+                           name="token_type_embeddings")(token_type_ids)
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                              name="embeddings_norm")(hidden)
+        for i in range(cfg.num_layers):
+            hidden = BertLayer(cfg, name=f"layer_{i}")(hidden, attention_mask)
+        # MLM head: transform + tied-embedding decoder.
+        hidden = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                          name="mlm_transform")(hidden)
+        hidden = nn.gelu(hidden, approximate=True)
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                              name="mlm_norm")(hidden)
+        logits = embed.attend(hidden.astype(embed.embedding.dtype))
+        logits += self.param("mlm_bias", nn.initializers.zeros,
+                             (cfg.vocab_size,), jnp.float32)
+        return logits.astype(jnp.float32)
+
+
+def bert_base(**overrides):
+    return BertConfig(**overrides)
+
+
+def bert_tiny(**overrides):
+    """4-layer/128-wide config for hermetic CPU tests."""
+    defaults = dict(vocab_size=1024, hidden_size=128, num_layers=4,
+                    num_heads=4, intermediate_size=512, max_position=512)
+    defaults.update(overrides)
+    return BertConfig(**defaults)
+
+
+def create_bert(config: Optional[BertConfig] = None, seq_len: int = 128):
+    """Returns (module, example_inputs dict)."""
+    cfg = config or bert_base()
+    module = BertForMaskedLM(cfg)
+    example = {
+        "input_ids": jnp.zeros((1, seq_len), jnp.int32),
+        "attention_mask": jnp.ones((1, seq_len), jnp.int32),
+    }
+    return module, example

@@ -1,0 +1,36 @@
+"""Small MLPs for multi-model serving.
+
+BASELINE.json config #4: "multi-model serving: 8 small Flax MLPs hot-swapped
+via pkg/agent on one chip".  These are the TrainedModel-equivalent payloads:
+cheap to load/unload, with a declared HBM footprint that exercises the
+HBM-aware sharding strategy (control plane) and the engine's eviction
+accounting (engine/hbm.py) — the reference's `Memory` field made real
+(reference pkg/apis/serving/v1alpha1/trained_model.go:68-69).
+"""
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int]
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = x.reshape(x.shape[0], -1)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def create_mlp(input_dim: int = 64, features: Sequence[int] = (256, 256),
+               num_classes: int = 10):
+    module = MLP(features=tuple(features), num_classes=num_classes)
+    example = jnp.zeros((1, input_dim), jnp.float32)
+    return module, example

@@ -1,0 +1,81 @@
+"""Architecture registry: name -> (module, example_input) factories.
+
+Plays the role of the reference's per-framework predictor dispatch
+(reference pkg/apis/serving/v1beta1/predictor.go:33-59 picks a server image
+by framework name): here the "framework" is an architecture string in the
+model's config, and the factory yields a Flax module the JaxEngine can
+compile.  Registration is open — user models plug in with
+`register_model("myarch", factory)` exactly like custom predictors do in the
+reference (predictor_custom.go).
+"""
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+
+
+class ModelSpec(NamedTuple):
+    module: Any                # flax.linen.Module
+    example: Any               # single-instance example input (batch dim 1)
+
+
+_REGISTRY: Dict[str, Callable[..., Tuple[Any, Any]]] = {}
+
+
+def register_model(name: str, factory: Callable[..., Tuple[Any, Any]]):
+    _REGISTRY[name] = factory
+
+
+def list_models():
+    return sorted(_REGISTRY)
+
+
+def create_model(name: str, **kwargs) -> ModelSpec:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown architecture {name!r}; known: {list_models()}")
+    module, example = _REGISTRY[name](**kwargs)
+    return ModelSpec(module, example)
+
+
+def init_params(spec: ModelSpec, seed: int = 0):
+    """Initialize variables for a ModelSpec (random weights — serving tests
+    and benchmarks measure compute, not accuracy)."""
+    rng = jax.random.PRNGKey(seed)
+    example = spec.example
+    if isinstance(example, dict):
+        return spec.module.init(rng, **example)
+    return spec.module.init(rng, example)
+
+
+def apply_fn_for(spec: ModelSpec) -> Callable:
+    """A (variables, batch) -> output function in the JaxEngine calling
+    convention (engine/jax_engine.py:34-44): dict inputs are splatted as
+    kwargs, array inputs positionally."""
+    module = spec.module
+    if isinstance(spec.example, dict):
+        def apply(variables, batch):
+            return module.apply(variables, **batch)
+    else:
+        def apply(variables, batch):
+            return module.apply(variables, batch)
+    return apply
+
+
+def _register_builtins():
+    from kfserving_tpu.models import bert, mlp, resnet, vit
+
+    register_model("resnet50", resnet.create_resnet50)
+    register_model("bert", lambda **kw: bert.create_bert(**kw))
+    register_model(
+        "bert_tiny",
+        lambda seq_len=128, **kw: bert.create_bert(
+            bert.bert_tiny(**kw), seq_len=seq_len))
+    register_model("vit_b16", lambda **kw: vit.create_vit(
+        vit.vit_b16(**kw)))
+    register_model("vit_tiny", lambda **kw: vit.create_vit(
+        vit.vit_tiny(**kw)))
+    register_model("mlp", mlp.create_mlp)
+
+
+_register_builtins()

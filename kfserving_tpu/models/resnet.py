@@ -1,0 +1,100 @@
+"""ResNet v1.5 in Flax — the flagship image-classification predictor.
+
+BASELINE.json config #2: "jaxserver ResNet-50 image classify (dynamic batch,
+v5e-1)".  The reference has no model code (it serves opaque artifacts,
+SURVEY.md §2.2); this is a first-party TPU-native implementation.
+
+TPU notes:
+- NHWC layout: XLA's TPU conv emitter wants channels-last; the MXU tiles
+  the implicit GEMMs of the convolutions.
+- bfloat16 compute / float32 params ("mixed precision" without a loss
+  scale — inference only needs the cast on the way in).
+- BatchNorm folded to inference mode (use_running_average=True) so the whole
+  forward pass is a pure function of (params, batch_stats, x) and fuses.
+"""
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut (v1.5: stride
+    on the 3x3, which is what torchvision/TF reference models converged on)."""
+
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # Zero-init the last BN scale: identity-at-init residual branches
+        # (standard ResNet trick; keeps early logits sane for warmup probes).
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="conv_proj")(
+                    residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """ResNet v1.5.  stage_sizes [3,4,6,3] == ResNet-50."""
+
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       padding="SAME")
+        norm = partial(nn.BatchNorm, use_running_average=True,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    self.num_filters * 2 ** i, strides=strides,
+                    conv=conv, norm=norm, act=act)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # Head in float32: logits feed softmax/argmax on host.
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2])   # (uses bottleneck too;
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])   # serving zoo, not a
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])  # training repro)
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])
+
+
+def create_resnet50(num_classes: int = 1000, image_size: int = 224,
+                    dtype: Any = jnp.bfloat16):
+    """Returns (module, example_input[1, H, W, 3])."""
+    module = ResNet50(num_classes=num_classes, dtype=dtype)
+    example = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    return module, example

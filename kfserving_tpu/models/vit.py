@@ -1,0 +1,106 @@
+"""ViT-B/16 in Flax — the transformer->predictor chain config.
+
+BASELINE.json config #5: "transformer->predictor chain: pre-process pod +
+jaxserver ViT-B/16 on v5e-4".  The v5e-4 part matters: ViT-B is the model
+used to exercise within-replica tensor parallelism (kfserving_tpu.parallel),
+so its MLP/attention dims are chosen to shard cleanly over a tp axis.
+
+Patch embedding is a conv with stride=patch (one MXU GEMM over unfolded
+patches under XLA); encoder blocks share the ops.dot_product_attention
+dispatch with BERT.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kfserving_tpu.ops import dot_product_attention
+
+
+class ViTConfig:
+    def __init__(self, image_size=224, patch_size=16, hidden_size=768,
+                 num_layers=12, num_heads=12, intermediate_size=3072,
+                 num_classes=1000, dtype=jnp.bfloat16):
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.num_classes = num_classes
+        self.dtype = dtype
+
+
+class EncoderBlock(nn.Module):
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        head_dim = cfg.hidden_size // cfg.num_heads
+        y = nn.LayerNorm(dtype=cfg.dtype, name="norm1")(x)
+        q = nn.DenseGeneral((cfg.num_heads, head_dim), dtype=cfg.dtype,
+                            name="query")(y)
+        k = nn.DenseGeneral((cfg.num_heads, head_dim), dtype=cfg.dtype,
+                            name="key")(y)
+        v = nn.DenseGeneral((cfg.num_heads, head_dim), dtype=cfg.dtype,
+                            name="value")(y)
+        attn = dot_product_attention(q, k, v)
+        attn = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1),
+                               dtype=cfg.dtype, name="out")(attn)
+        x = x + attn
+        y = nn.LayerNorm(dtype=cfg.dtype, name="norm2")(x)
+        y = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype, name="mlp_in")(y)
+        y = nn.gelu(y, approximate=True)
+        y = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    """Images [B, H, W, 3] float -> class logits [B, num_classes]."""
+
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.config
+        x = images.astype(cfg.dtype)
+        p = cfg.patch_size
+        x = nn.Conv(cfg.hidden_size, (p, p), strides=(p, p),
+                    padding="VALID", dtype=cfg.dtype, name="patch_embed")(x)
+        B, h, w, c = x.shape
+        x = x.reshape(B, h * w, c)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.hidden_size), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.tile(cls.astype(cfg.dtype), (B, 1, 1)), x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(0.02),
+                         (1, h * w + 1, cfg.hidden_size), jnp.float32)
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="final_norm")(x)
+        # Classify from the CLS token, head in float32.
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0])
+
+
+def vit_b16(**overrides):
+    return ViTConfig(**overrides)
+
+
+def vit_tiny(**overrides):
+    defaults = dict(image_size=32, patch_size=8, hidden_size=64,
+                    num_layers=2, num_heads=4, intermediate_size=128,
+                    num_classes=10)
+    defaults.update(overrides)
+    return ViTConfig(**defaults)
+
+
+def create_vit(config: Optional[ViTConfig] = None):
+    cfg = config or vit_b16()
+    module = ViT(cfg)
+    example = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
+    return module, example

@@ -1,0 +1,10 @@
+"""TPU hot-op library.
+
+The compute path of the serving runtime: attention (with a Pallas
+flash-attention kernel on TPU and a pure-XLA fallback elsewhere), and
+quantized/fused primitives used by the model zoo.  The reference delegates
+all accelerator execution to third-party servers (SURVEY.md §2.2) so none of
+this has a counterpart — it is the TPU-native heart.
+"""
+
+from kfserving_tpu.ops.attention import dot_product_attention  # noqa: F401

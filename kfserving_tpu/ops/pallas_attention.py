@@ -1,0 +1,130 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Canonical online-softmax formulation (Dao et al.) tiled for the TPU memory
+hierarchy: the grid walks (batch*heads, q_blocks, k_blocks) with the k axis
+innermost and sequential, keeping the running max / normalizer / output
+accumulator for one q tile resident in VMEM scratch.  The [L, L] score
+matrix never exists in HBM, which is the whole point — at the serving
+sequence lengths BASELINE.json config #3 targets the score tensor is what
+turns attention HBM-bandwidth-bound.
+
+Layout contract matches kfserving_tpu.ops.attention: [B, L, H, D] in, same
+out.  D must be a multiple of 128 (lane width); L a multiple of the block
+size (the engine's seq-bucket policy guarantees this — buckets are chosen
+from multiples of 128, engine/buckets.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
+                  m_scratch, l_scratch, acc_scratch,
+                  *, causal: bool, scale: float, block_q: int, block_k: int):
+    q_idx = pl.program_id(1)
+    k_idx = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, _NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    def _run_block():
+        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
+        s = jax.lax.dot_general(                          # [bq, bk]
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+        m_prev = m_scratch[:]                             # [bq, 1]
+        l_prev = l_scratch[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)        # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                            # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)                   # [bq, 1]
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scratch[:] = acc_scratch[:] * alpha + pv
+        m_scratch[:] = m_new
+        l_scratch[:] = l_new
+
+    if causal:
+        # Skip fully-masked k blocks above the diagonal.
+        @pl.when(k_idx * block_k <= q_idx * block_q + (block_q - 1))
+        def _():
+            _run_block()
+    else:
+        _run_block()
+
+    @pl.when(k_idx == num_k - 1)
+    def _finalize():
+        # l is positive: row 0 of k always contributes for causal (q >= 0).
+        o_ref[0] = (acc_scratch[:] / l_scratch[:]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Fused attention over [B, L, H, D]; returns [B, L, H, D]."""
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    block_q = min(block_q, Lq)
+    block_k = min(block_k, Lk)
+    if Lq % block_q or Lk % block_k:
+        raise ValueError(
+            f"seq lens ({Lq}, {Lk}) must be multiples of blocks "
+            f"({block_q}, {block_k})")
+    scale = 1.0 / D ** 0.5
+
+    # Fold heads into the grid's first axis: BHLD views with one (b,h) slab
+    # per program keeps BlockSpecs 3-D and index maps trivial.
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, Lq, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
+
+    grid = (B * H, Lq // block_q, Lk // block_k)
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qt, kt, vt)
+    return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)

@@ -8,6 +8,7 @@ import random
 import pytest
 
 from kfserving_tpu.batching import DynamicBatcher
+from kfserving_tpu.batching.batcher import BatchSizeMismatch
 
 
 async def echo_handler(instances):
@@ -105,13 +106,17 @@ async def test_scatter_property_random():
     b = DynamicBatcher(handler, max_batch_size=16, max_latency_ms=20)
     rng = random.Random(42)
 
+    total = 0
+
     async def one_request(req_id):
+        nonlocal total
         payload = [(req_id, k) for k in range(rng.randint(1, 5))]
+        total += len(payload)
         result = await b.submit(payload)
         assert result.predictions == [("out", p) for p in payload]
 
     await asyncio.gather(*[one_request(i) for i in range(50)])
-    assert b.instances_batched == sum(1 for _ in [])*0 + b.instances_batched
+    assert b.instances_batched == total
     assert b.batches_flushed >= 1
 
 
@@ -142,3 +147,30 @@ async def test_drain_flush():
     await b.flush()
     result = await asyncio.wait_for(task, timeout=1.0)
     assert result.predictions == [1]
+
+
+async def test_flush_drains_in_flight_batches():
+    """flush() must resolve every waiter before returning (shutdown drain)."""
+    async def slow_handler(instances):
+        await asyncio.sleep(0.05)
+        return instances
+
+    b = DynamicBatcher(slow_handler, max_batch_size=100, max_latency_ms=10_000)
+    fut = asyncio.ensure_future(b.submit([1, 2, 3]))
+    await asyncio.sleep(0)  # let submit enqueue
+    await b.flush()
+    assert fut.done()
+    assert fut.result().predictions == [1, 2, 3]
+
+
+async def test_mismatch_type_preserved_across_waiters():
+    """Every waiter sees BatchSizeMismatch, not a degraded RuntimeError."""
+    async def bad_handler(instances):
+        return instances[:-1]
+
+    b = DynamicBatcher(bad_handler, max_batch_size=4, max_latency_ms=10)
+    results = await asyncio.gather(
+        b.submit([1, 2]), b.submit([3, 4]), return_exceptions=True)
+    assert len(results) == 2
+    for r in results:
+        assert isinstance(r, BatchSizeMismatch), r

@@ -141,3 +141,17 @@ class TestHBMManager:
         m.admit("a", 80)
         m.release("a")
         assert m.used_bytes == 0
+
+
+def test_hbm_readmit_replaces_old_entry():
+    """Re-admitting a resident model replaces its accounting entry instead of
+    double-counting it or spuriously evicting others."""
+    from kfserving_tpu.engine.hbm import HBMManager
+
+    m = HBMManager(budget_bytes=100)
+    m.admit("a", 60)
+    evicted = m.admit("a", 60)  # reload: must fit by replacing itself
+    assert evicted == []
+    assert m.used_bytes == 60
+    m.admit("b", 40)
+    assert sorted(m.resident_models()) == ["a", "b"]

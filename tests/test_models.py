@@ -1,0 +1,168 @@
+"""Model zoo tests — tiny configs on the hermetic CPU backend.
+
+Mirrors the reference per-server strategy (SURVEY.md §4: "each server ships a
+local example model and asserts predictions", e.g. reference
+python/sklearnserver/sklearnserver/test_model.py): every architecture builds,
+initializes, and produces sane logits; the registry round-trips; attention
+fallback matches a hand-rolled reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfserving_tpu.models import create_model, init_params, list_models
+from kfserving_tpu.models.registry import apply_fn_for
+from kfserving_tpu.models.resnet import ResNet
+from kfserving_tpu.ops.attention import _xla_attention, dot_product_attention
+
+
+def _run(name, batch=2, **kwargs):
+    spec = create_model(name, **kwargs)
+    variables = init_params(spec, seed=0)
+    apply = apply_fn_for(spec)
+    if isinstance(spec.example, dict):
+        batch_in = {k: np.concatenate([np.asarray(v)] * batch)
+                    for k, v in spec.example.items()}
+    else:
+        batch_in = np.concatenate([np.asarray(spec.example)] * batch)
+    out = jax.jit(apply)(variables, batch_in)
+    return np.asarray(out)
+
+
+def test_registry_lists_builtins():
+    names = list_models()
+    for required in ("resnet50", "bert", "vit_b16", "mlp"):
+        assert required in names
+
+
+def test_resnet_tiny_forward():
+    # Small ResNet (stage_sizes [1,1]) keeps CPU test time low while
+    # exercising the bottleneck/projection/stride paths.
+    module = ResNet(stage_sizes=[1, 1], num_classes=7, num_filters=8,
+                    dtype=jnp.float32)
+    x = np.random.default_rng(0).normal(size=(2, 32, 32, 3)).astype("float32")
+    variables = module.init(jax.random.PRNGKey(0), x)
+    out = jax.jit(module.apply)(variables, x)
+    assert out.shape == (2, 7)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_mlp_forward():
+    out = _run("mlp", batch=3, input_dim=16, features=(32,), num_classes=5)
+    assert out.shape == (3, 5)
+    assert np.isfinite(out).all()
+
+
+def test_bert_tiny_forward_shapes():
+    out = _run("bert_tiny", batch=2, seq_len=16)
+    assert out.shape == (2, 16, 1024)  # [B, L, vocab]
+    assert np.isfinite(out).all()
+
+
+def test_bert_mask_blocks_padding():
+    """Padding tokens must not change real-token logits (bucket padding
+    correctness — the engine pads seq to bucket boundaries)."""
+    spec = create_model("bert_tiny", seq_len=8)
+    variables = init_params(spec)
+    apply = apply_fn_for(spec)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 1000, size=(1, 8)).astype("int32")
+    mask = np.ones((1, 8), "int32")
+    mask[0, 6:] = 0
+    out1 = np.asarray(jax.jit(apply)(
+        variables, {"input_ids": ids, "attention_mask": mask}))
+    ids2 = ids.copy()
+    ids2[0, 6:] = 999  # change only masked positions
+    out2 = np.asarray(jax.jit(apply)(
+        variables, {"input_ids": ids2, "attention_mask": mask}))
+    np.testing.assert_allclose(out1[0, :6], out2[0, :6], atol=2e-5)
+
+
+def test_vit_tiny_forward():
+    out = _run("vit_tiny", batch=2)
+    assert out.shape == (2, 10)
+    assert np.isfinite(out).all()
+
+
+def test_attention_matches_naive():
+    rng = np.random.default_rng(2)
+    q = rng.normal(size=(2, 8, 2, 4)).astype("float32")
+    k = rng.normal(size=(2, 8, 2, 4)).astype("float32")
+    v = rng.normal(size=(2, 8, 2, 4)).astype("float32")
+    out = np.asarray(dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    # Hand-rolled reference
+    scores = np.einsum("bqhd,bkhd->bhqk", q / 2.0, k)
+    w = np.exp(scores - scores.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    expect = np.einsum("bhqk,bkhd->bqhd", w, v)
+    np.testing.assert_allclose(out, expect, atol=1e-5)
+
+
+def test_attention_causal():
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(1, 6, 1, 4)).astype("float32"))
+    k, v = q, q
+    out = dot_product_attention(q, k, v, causal=True)
+    # position 0 attends only to itself -> output == v[0]
+    np.testing.assert_allclose(
+        np.asarray(out)[0, 0, 0], np.asarray(v)[0, 0, 0], atol=1e-5)
+
+
+def test_flash_kernel_interpret_mode_matches_xla():
+    """Run the Pallas flash kernel in interpreter mode on CPU and compare
+    against the XLA fallback (numerics parity of the online softmax)."""
+    from jax.experimental import pallas as pl  # noqa: F401
+    import functools
+    from kfserving_tpu.ops import pallas_attention as pa
+
+    rng = np.random.default_rng(4)
+    B, L, H, D = 1, 256, 2, 128
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    k = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    v = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+
+    # Monkeypatch pallas_call into interpret mode for this test.
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        out = pa.flash_attention.__wrapped__(q, k, v, causal=False,
+                                             block_q=128, block_k=128)
+    finally:
+        pl.pallas_call = orig
+    expect = _xla_attention(q, k, v, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=2e-3, rtol=2e-3)
+
+
+def test_flash_kernel_interpret_mode_causal():
+    from jax.experimental import pallas as pl  # noqa: F401
+    import functools
+    from kfserving_tpu.ops import pallas_attention as pa
+
+    rng = np.random.default_rng(5)
+    B, L, H, D = 1, 256, 1, 128
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    k = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    v = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    causal_mask = jnp.tril(jnp.ones((L, L), bool))[None, None]
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        out = pa.flash_attention.__wrapped__(q, k, v, causal=True,
+                                             block_q=128, block_k=128)
+    finally:
+        pl.pallas_call = orig
+    expect = _xla_attention(q, k, v, causal_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.tpu
+def test_flash_kernel_on_tpu():
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.normal(size=(1, 512, 4, 128)).astype("float32"))
+    out = dot_product_attention(q, q, q)
+    assert np.isfinite(np.asarray(out)).all()
