@@ -105,36 +105,34 @@ class HBMManager:
                 raise InsufficientHBM(
                     f"model {name} needs {nbytes} bytes; budget is "
                     f"{self.budget_bytes}")
-            # A reload replaces the old residency: drop it from the books
-            # first so it neither double-counts nor blocks eviction math.
-            self._resident.pop(name, None)
-            evicted = []
+            # Plan admission against a scratch copy so a failed admit leaves
+            # the books untouched (nothing is physically evicted until the
+            # plan commits — evict_cb runs only on success).  A reload of
+            # `name` replaces its old entry rather than double-counting it.
+            plan = OrderedDict(
+                (k, v) for k, v in self._resident.items() if k != name)
+            victims: List[str] = []
             while nbytes > self.budget_bytes - sum(
-                    r.bytes for r in self._resident.values()):
+                    r.bytes for r in plan.values()):
                 if not evict:
                     raise InsufficientHBM(
                         f"model {name} needs {nbytes} bytes; only "
                         f"{self.free_bytes} free and eviction disabled")
-                victim = self._pick_victim(exclude=name)
+                victim = next(iter(plan), None)  # LRU order
                 if victim is None:
                     raise InsufficientHBM(
-                        f"model {name} needs {nbytes} bytes; nothing left "
-                        f"to evict")
-                self._resident.pop(victim)
-                evicted.append(victim)
+                        f"model {name} needs {nbytes} bytes; nothing "
+                        f"left to evict")
+                plan.pop(victim)
+                victims.append(victim)
             now = time.time()
-            self._resident[name] = Residency(name, nbytes, now, now)
-        for victim in evicted:
+            plan[name] = Residency(name, nbytes, now, now)
+            self._resident = plan
+        for victim in victims:
             logger.info("evicting model %s to fit %s", victim, name)
             if self.evict_cb:
                 self.evict_cb(victim)
-        return evicted
-
-    def _pick_victim(self, exclude: str) -> Optional[str]:
-        for name, res in self._resident.items():  # OrderedDict = LRU order
-            if name != exclude:
-                return name
-        return None
+        return victims
 
     def touch(self, name: str) -> None:
         """Mark a model as recently used (moves it to MRU position)."""

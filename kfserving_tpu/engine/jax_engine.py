@@ -48,7 +48,8 @@ class JaxEngine:
                  seq_buckets: Optional[BucketPolicy] = None,
                  dtype: Optional[Any] = None,
                  pad_value: float = 0.0,
-                 donate_inputs: bool = False):
+                 donate_inputs: bool = False,
+                 pipeline_depth: int = 2):
         import jax
 
         self._jax = jax
@@ -61,11 +62,17 @@ class JaxEngine:
         # bucket policies bound how many signatures can exist.
         donate = (1,) if donate_inputs else ()
         self._jitted = jax.jit(apply_fn, donate_argnums=donate)
-        # Single worker thread: TPU execution is serialized per device anyway,
-        # and one thread keeps the dispatch queue ordered.
+        # pipeline_depth worker threads: device execution is serialized per
+        # chip, but the host->HBM transfer of batch N+1 overlaps the compute
+        # and result fetch of batch N (transfers dominate when the chip sits
+        # across a PCIe/tunnel hop).  Depth 2 = classic double buffering.
         self._executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="jax-engine")
-        # Telemetry
+            max_workers=max(1, pipeline_depth),
+            thread_name_prefix="jax-engine")
+        # Telemetry (lock: _execute_sync runs on pipeline_depth threads)
+        import threading
+
+        self._stats_lock = threading.Lock()
         self.compile_count = 0
         self.execute_count = 0
         self.last_execute_ms = 0.0
@@ -119,11 +126,12 @@ class JaxEngine:
         start = time.perf_counter()
         out = self._jitted(self.params, padded)
         out = self._jax.block_until_ready(out)
-        self.last_execute_ms = (time.perf_counter() - start) * 1000.0
-        self.execute_count += 1
         bucket = (padded[next(iter(padded))] if isinstance(padded, dict)
                   else padded).shape[0]
-        self.padded_waste_total += (bucket - n) / bucket
+        with self._stats_lock:
+            self.last_execute_ms = (time.perf_counter() - start) * 1000.0
+            self.execute_count += 1
+            self.padded_waste_total += (bucket - n) / bucket
         # Slice back to the true batch size on host.
         return self._jax.tree.map(lambda a: np.asarray(a)[:n], out)
 

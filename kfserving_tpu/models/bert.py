@@ -27,7 +27,8 @@ class BertConfig:
     def __init__(self, vocab_size=30522, hidden_size=768,
                  num_layers=12, num_heads=12, intermediate_size=3072,
                  max_position=512, type_vocab_size=2,
-                 layer_norm_eps=1e-12, dtype=jnp.bfloat16):
+                 layer_norm_eps=1e-12, dtype=jnp.bfloat16,
+                 attn_fn=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -37,6 +38,11 @@ class BertConfig:
         self.type_vocab_size = type_vocab_size
         self.layer_norm_eps = layer_norm_eps
         self.dtype = dtype
+        # Pluggable attention impl (q, k, v, mask) -> out, mask being the
+        # broadcastable [B, 1, 1, L] key-padding mask (or None).  Defaults
+        # to ops.dot_product_attention; the sequence-parallel serving
+        # config injects parallel.ring_attention_sharded(mesh).
+        self.attn_fn = attn_fn
 
 
 class BertSelfAttention(nn.Module):
@@ -58,7 +64,10 @@ class BertSelfAttention(nn.Module):
         attn_mask = None
         if mask is not None:
             attn_mask = mask[:, None, None, :].astype(bool)
-        out = dot_product_attention(q, k, v, mask=attn_mask)
+        if cfg.attn_fn is not None:
+            out = cfg.attn_fn(q, k, v, attn_mask)
+        else:
+            out = dot_product_attention(q, k, v, mask=attn_mask)
         out = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out")(out)
         return out
@@ -140,3 +149,14 @@ def create_bert(config: Optional[BertConfig] = None, seq_len: int = 128):
         "attention_mask": jnp.ones((1, seq_len), jnp.int32),
     }
     return module, example
+
+
+def _create_bert_base(**kw):
+    """Registry factory: 'bert'."""
+    seq_len = kw.pop("seq_len", 128)
+    return create_bert(bert_base(**kw) if kw else None, seq_len=seq_len)
+
+
+def _create_bert_tiny(seq_len=128, **kw):
+    """Registry factory: 'bert_tiny'."""
+    return create_bert(bert_tiny(**kw), seq_len=seq_len)

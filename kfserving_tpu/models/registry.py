@@ -9,9 +9,7 @@ compile.  Registration is open — user models plug in with
 reference (predictor_custom.go).
 """
 
-from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
-
-import jax
+from typing import Any, Callable, Dict, NamedTuple, Tuple
 
 
 class ModelSpec(NamedTuple):
@@ -21,26 +19,51 @@ class ModelSpec(NamedTuple):
 
 _REGISTRY: Dict[str, Callable[..., Tuple[Any, Any]]] = {}
 
+# Built-ins resolve lazily (module_path, builder_name) so importing the
+# registry — e.g. control-plane code listing architectures — doesn't pay
+# jax/flax initialization.  The model modules import on first create_model.
+_LAZY_BUILTINS: Dict[str, Tuple[str, str]] = {
+    "resnet50": ("kfserving_tpu.models.resnet", "create_resnet50"),
+    "bert": ("kfserving_tpu.models.bert", "_create_bert_base"),
+    "bert_tiny": ("kfserving_tpu.models.bert", "_create_bert_tiny"),
+    "vit_b16": ("kfserving_tpu.models.vit", "_create_vit_b16"),
+    "vit_tiny": ("kfserving_tpu.models.vit", "_create_vit_tiny"),
+    "mlp": ("kfserving_tpu.models.mlp", "create_mlp"),
+}
+
 
 def register_model(name: str, factory: Callable[..., Tuple[Any, Any]]):
     _REGISTRY[name] = factory
 
 
 def list_models():
-    return sorted(_REGISTRY)
+    return sorted(set(_REGISTRY) | set(_LAZY_BUILTINS))
+
+
+def _resolve(name: str) -> Callable[..., Tuple[Any, Any]]:
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    if name in _LAZY_BUILTINS:
+        import importlib
+
+        module_path, attr = _LAZY_BUILTINS[name]
+        factory = getattr(importlib.import_module(module_path), attr)
+        _REGISTRY[name] = factory
+        return factory
+    raise KeyError(
+        f"unknown architecture {name!r}; known: {list_models()}")
 
 
 def create_model(name: str, **kwargs) -> ModelSpec:
-    if name not in _REGISTRY:
-        raise KeyError(
-            f"unknown architecture {name!r}; known: {list_models()}")
-    module, example = _REGISTRY[name](**kwargs)
+    module, example = _resolve(name)(**kwargs)
     return ModelSpec(module, example)
 
 
 def init_params(spec: ModelSpec, seed: int = 0):
     """Initialize variables for a ModelSpec (random weights — serving tests
     and benchmarks measure compute, not accuracy)."""
+    import jax
+
     rng = jax.random.PRNGKey(seed)
     example = spec.example
     if isinstance(example, dict):
@@ -62,20 +85,3 @@ def apply_fn_for(spec: ModelSpec) -> Callable:
     return apply
 
 
-def _register_builtins():
-    from kfserving_tpu.models import bert, mlp, resnet, vit
-
-    register_model("resnet50", resnet.create_resnet50)
-    register_model("bert", lambda **kw: bert.create_bert(**kw))
-    register_model(
-        "bert_tiny",
-        lambda seq_len=128, **kw: bert.create_bert(
-            bert.bert_tiny(**kw), seq_len=seq_len))
-    register_model("vit_b16", lambda **kw: vit.create_vit(
-        vit.vit_b16(**kw)))
-    register_model("vit_tiny", lambda **kw: vit.create_vit(
-        vit.vit_tiny(**kw)))
-    register_model("mlp", mlp.create_mlp)
-
-
-_register_builtins()

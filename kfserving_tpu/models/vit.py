@@ -104,3 +104,13 @@ def create_vit(config: Optional[ViTConfig] = None):
     module = ViT(cfg)
     example = jnp.zeros((1, cfg.image_size, cfg.image_size, 3), jnp.float32)
     return module, example
+
+
+def _create_vit_b16(**kw):
+    """Registry factory: 'vit_b16'."""
+    return create_vit(vit_b16(**kw))
+
+
+def _create_vit_tiny(**kw):
+    """Registry factory: 'vit_tiny'."""
+    return create_vit(vit_tiny(**kw))

@@ -69,13 +69,19 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Attention over [batch, len, heads, head_dim] tensors.
 
     mask: optional broadcastable boolean [B, H, Lq, Lk] (True = attend).
-    causal: apply a causal mask (decoder serving); mutually exclusive with
-        an explicit mask in the flash path.
+    causal: apply a causal mask (decoder serving).  Composes with an
+        explicit mask (logical AND); the flash kernel path requires the
+        causal-only case.
     """
-    if causal and mask is None:
+    if causal:
         L = q.shape[1]
-        mask = jnp.tril(jnp.ones((L, L), jnp.bool_))[None, None, :, :]
-    if _flash_eligible(q, mask if not causal else None):
+        causal_mask = jnp.tril(
+            jnp.ones((L, q.shape[1]), jnp.bool_))[None, None, :, :]
+        mask = causal_mask if mask is None else (mask & causal_mask)
+        flash_ok = mask is causal_mask  # no extra mask was merged in
+    else:
+        flash_ok = mask is None
+    if flash_ok and _flash_eligible(q, None):
         try:
             from kfserving_tpu.ops.pallas_attention import flash_attention
 

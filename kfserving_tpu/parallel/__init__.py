@@ -1,0 +1,33 @@
+"""Multi-chip parallelism: device meshes, sharding rules, ring attention.
+
+The reference scales only at replica granularity (Knative KPA over
+`minReplicas/maxReplicas`, reference
+pkg/controller/v1beta1/inferenceservice/reconcilers/knative/
+ksvc_reconciler.go:70-83) and never touches model internals — SURVEY.md §2.3
+and §5.7 audit this.  The TPU-native build adds the within-replica dimension
+the reference could not have: a replica is an ICI-connected device mesh, and
+one served model is an SPMD program over it.
+
+- mesh.py:     mesh construction over dp/tp/sp axes (ICI within a replica,
+               DCN between replicas — replicas stay plain HTTP peers exactly
+               like the reference's).
+- sharding.py: parameter/activation PartitionSpec rules for the model zoo
+               (Megatron-style tensor parallelism for transformer blocks)
+               and `shard_params` placement helpers.
+- ring_attention.py: sequence-parallel attention via `shard_map` +
+               `ppermute` — K/V blocks rotate around the ring while each
+               device keeps an online-softmax accumulator, so attention over
+               sequences longer than one chip's HBM rides ICI.
+"""
+
+from kfserving_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    single_device_mesh,
+)
+from kfserving_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    replicate_params,
+    shard_params,
+    transformer_rules,
+)

@@ -1,0 +1,9 @@
+"""Per-framework predictors on the Model SDK.
+
+The reference ships one server package per framework
+(python/{sklearnserver,xgbserver,lgbserver,pmmlserver,pytorchserver},
+SURVEY.md §2.2); here each is a Model subclass plus a repository and a
+`python -m kfserving_tpu.predictors.<name>` entrypoint.  The TPU-native
+predictor is `jaxserver` — the replacement for the reference's
+pytorchserver and the reason this framework exists.
+"""

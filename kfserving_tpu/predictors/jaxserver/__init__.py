@@ -1,0 +1,7 @@
+from kfserving_tpu.predictors.jax_model import (  # noqa: F401
+    JaxModel,
+    JaxModelConfig,
+)
+from kfserving_tpu.predictors.jaxserver.repository import (  # noqa: F401
+    JaxModelRepository,
+)

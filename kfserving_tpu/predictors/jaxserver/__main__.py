@@ -1,0 +1,39 @@
+"""jaxserver entrypoint: `python -m kfserving_tpu.predictors.jaxserver`.
+
+Args mirror the reference model-server convention (`--model_name
+--model_dir --http_port [--workers]`, reference
+pkg/apis/serving/v1beta1/predictor_sklearn.go:77-96 builds exactly these)
+plus the TPU batching knobs.
+"""
+
+import argparse
+import logging
+
+from kfserving_tpu.engine.compile_cache import enable as enable_compile_cache
+from kfserving_tpu.predictors.jax_model import JaxModel
+from kfserving_tpu.predictors.jaxserver.repository import JaxModelRepository
+from kfserving_tpu.server.app import ModelServer, parser as server_parser
+
+logging.basicConfig(level=logging.INFO)
+
+parser = argparse.ArgumentParser(parents=[server_parser])
+parser.add_argument("--model_name", default="model",
+                    help="name under which the model is served")
+parser.add_argument("--model_dir", required=True,
+                    help="model artifact URI (local path, gs://, s3://...)")
+parser.add_argument("--multi_model", action="store_true",
+                    help="treat model_dir as a repository of models loaded "
+                         "on demand via /v2/repository/models/{name}/load")
+args, _ = parser.parse_known_args()
+
+if __name__ == "__main__":
+    enable_compile_cache()
+    if args.multi_model:
+        repo = JaxModelRepository(models_dir=args.model_dir)
+        server = ModelServer(http_port=args.http_port,
+                             registered_models=repo)
+        server.start([])
+    else:
+        model = JaxModel(args.model_name, args.model_dir)
+        model.load()
+        ModelServer(http_port=args.http_port).start([model])

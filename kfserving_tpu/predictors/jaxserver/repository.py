@@ -1,0 +1,50 @@
+"""Multi-model repository for JAX models.
+
+Maps the reference's per-framework model repositories (e.g.
+python/sklearnserver/sklearnserver/sklearn_model_repository.py) to the TPU
+predictor, with the addition that loads/unloads go through one shared
+HBMManager: "loaded" on TPU means resident in HBM, so admission can evict
+LRU models (SURVEY.md §7 hard parts — the reference's disk-based
+load/unload in pkg/agent/puller.go:120-183 had no such constraint).
+"""
+
+import os
+from typing import Optional
+
+from kfserving_tpu.engine.hbm import HBMManager
+from kfserving_tpu.model.repository import MODEL_MOUNT_DIRS, ModelRepository
+from kfserving_tpu.predictors.jax_model import JaxModel
+
+
+class JaxModelRepository(ModelRepository):
+    def __init__(self, models_dir: str = MODEL_MOUNT_DIRS,
+                 hbm: Optional[HBMManager] = None):
+        super().__init__(models_dir)
+        self.hbm = hbm or HBMManager()
+        # The repository owns eviction: accounting decides *who*, the
+        # repository performs the unload that actually frees HBM.
+        self.hbm.evict_cb = self._evict
+
+    def _evict(self, name: str) -> None:
+        model = self.get_model(name)
+        if model is not None:
+            model.unload()
+
+    async def load(self, name: str) -> bool:
+        """Load <models_dir>/<name> as a JaxModel (agent puller load path:
+        POST /v2/repository/models/{name}/load after download)."""
+        model = self.get_model(name)
+        if model is None:
+            model_dir = os.path.join(self.models_dir, name)
+            if not os.path.isdir(model_dir):
+                return False
+            model = JaxModel(name, model_dir, hbm=self.hbm)
+            self.update(model)
+        return bool(await _to_thread(model.load))
+
+
+async def _to_thread(fn):
+    """Model loading compiles on-device; keep it off the serving loop."""
+    import asyncio
+
+    return await asyncio.get_running_loop().run_in_executor(None, fn)

@@ -155,3 +155,18 @@ def test_hbm_readmit_replaces_old_entry():
     assert m.used_bytes == 60
     m.admit("b", 40)
     assert sorted(m.resident_models()) == ["a", "b"]
+
+
+def test_hbm_failed_admit_restores_books():
+    """A failed admit must leave accounting untouched (no phantom free)."""
+    import pytest
+
+    from kfserving_tpu.engine.hbm import HBMManager, InsufficientHBM
+
+    m = HBMManager(budget_bytes=100)
+    m.admit("a", 60)
+    m.admit("b", 30)
+    with pytest.raises(InsufficientHBM):
+        m.admit("a", 80, evict=False)
+    assert m.used_bytes == 90
+    assert sorted(m.resident_models()) == ["a", "b"]

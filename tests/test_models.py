@@ -166,3 +166,17 @@ def test_flash_kernel_on_tpu():
     q = jnp.asarray(rng.normal(size=(1, 512, 4, 128)).astype("float32"))
     out = dot_product_attention(q, q, q)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_attention_causal_composes_with_padding_mask():
+    """causal=True plus an explicit mask must apply BOTH."""
+    rng = np.random.default_rng(8)
+    q = jnp.asarray(rng.normal(size=(1, 6, 1, 4)).astype("float32"))
+    pad = np.ones((1, 1, 1, 6), bool)
+    pad[..., 4:] = False
+    out = dot_product_attention(q, q, q, mask=jnp.asarray(pad), causal=True)
+    causal = np.tril(np.ones((6, 6), bool))[None, None]
+    both = jnp.asarray(causal & pad)
+    expect = _xla_attention(q, q, q, both)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=1e-5)

@@ -1,0 +1,166 @@
+"""Parallel-layer tests on the virtual 8-device CPU mesh (conftest.py sets
+xla_force_host_platform_device_count=8 — the TPU analogue of envtest,
+SURVEY.md §4 takeaway)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfserving_tpu.models import create_model, init_params
+from kfserving_tpu.models.registry import apply_fn_for
+from kfserving_tpu.parallel import (
+    MeshConfig,
+    build_mesh,
+    shard_params,
+    single_device_mesh,
+)
+from kfserving_tpu.parallel.ring_attention import ring_attention
+from kfserving_tpu.parallel.sharding import describe, param_specs, shard_batch
+from kfserving_tpu.ops.attention import _xla_attention
+
+
+def test_mesh_shapes():
+    mesh = build_mesh(dp=2, tp=4)
+    assert mesh.shape == {"dp": 2, "sp": 1, "tp": 4}
+    assert mesh.devices.size == 8
+
+
+def test_mesh_too_many_devices():
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        build_mesh(dp=4, tp=4)
+
+
+def test_single_device_mesh():
+    mesh = single_device_mesh()
+    assert mesh.devices.size == 1
+
+
+def test_transformer_param_specs_cover_bert():
+    spec = create_model("bert_tiny", seq_len=16)
+    variables = init_params(spec)
+    desc = describe(variables["params"])
+    qkv = [v for k, v in desc.items() if "/query/kernel" in k]
+    assert qkv and all(v == "PartitionSpec(None, 'tp', None)" for v in qkv)
+    mlp_down = [v for k, v in desc.items() if "/output/kernel" in k]
+    assert mlp_down and all(v == "PartitionSpec('tp', None)" for v in mlp_down)
+    norms = [v for k, v in desc.items() if "norm/scale" in k]
+    assert norms and all(v == "PartitionSpec()" for v in norms)
+
+
+def test_tp_sharded_bert_matches_replicated():
+    """Tensor-parallel execution must be numerically equivalent (up to
+    reduction order) to single-device execution."""
+    mesh = build_mesh(dp=1, tp=4)
+    spec = create_model("bert_tiny", seq_len=16, dtype=jnp.float32)
+    variables = init_params(spec)
+    apply = apply_fn_for(spec)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 1000, size=(2, 16)).astype("int32")
+    batch = {"input_ids": ids,
+             "attention_mask": np.ones((2, 16), "int32")}
+
+    expect = np.asarray(jax.jit(apply)(variables, batch))
+
+    with mesh:
+        sharded_vars = {"params": shard_params(variables["params"], mesh)}
+        out = np.asarray(jax.jit(apply)(sharded_vars, batch))
+    np.testing.assert_allclose(out, expect, atol=1e-4, rtol=1e-4)
+
+
+def test_dp_sharded_batch_matches():
+    mesh = build_mesh(dp=4, tp=1)
+    spec = create_model("mlp", input_dim=8, features=(16,), num_classes=3)
+    variables = init_params(spec)
+    apply = apply_fn_for(spec)
+    x = np.random.default_rng(1).normal(size=(8, 8)).astype("float32")
+    expect = np.asarray(jax.jit(apply)(variables, x))
+    with mesh:
+        x_sharded = shard_batch(jnp.asarray(x), mesh)
+        out = np.asarray(jax.jit(apply)(variables, x_sharded))
+    np.testing.assert_allclose(out, expect, atol=1e-5)
+
+
+def test_ring_attention_matches_full():
+    """Ring attention over sp=4 must equal full attention."""
+    mesh = build_mesh(MeshConfig(dp=2, sp=4, tp=1))
+    rng = np.random.default_rng(2)
+    B, L, H, D = 2, 32, 2, 8  # L sharded 4-way -> 8 per device
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    k = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    v = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    out = ring_attention(q, k, v, mesh)
+    expect = _xla_attention(q, k, v, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_causal_matches():
+    mesh = build_mesh(MeshConfig(dp=1, sp=4, tp=1))
+    rng = np.random.default_rng(3)
+    B, L, H, D = 1, 16, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    k = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    v = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    out = ring_attention(q, k, v, mesh, causal=True)
+    mask = jnp.tril(jnp.ones((L, L), bool))[None, None]
+    expect = _xla_attention(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_inside_jit():
+    mesh = build_mesh(MeshConfig(dp=1, sp=8, tp=1))
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(1, 64, 2, 8)).astype("float32"))
+
+    @jax.jit
+    def fn(q):
+        return ring_attention(q, q, q, mesh)
+
+    out = fn(q)
+    expect = _xla_attention(q, q, q, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_param_specs_tree_structure_matches():
+    spec = create_model("vit_tiny")
+    variables = init_params(spec)
+    specs = param_specs(variables["params"])
+    assert (jax.tree_util.tree_structure(specs)
+            == jax.tree_util.tree_structure(variables["params"]))
+
+
+def test_ring_attention_with_padding_mask():
+    """K/V padding mask rotates with the blocks: masked keys never attend."""
+    mesh = build_mesh(MeshConfig(dp=1, sp=4, tp=1))
+    rng = np.random.default_rng(6)
+    B, L, H, D = 1, 16, 2, 8
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    k = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    v = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    kv_mask = np.ones((B, L), bool)
+    kv_mask[0, 10:] = False  # mask spans the last two ring blocks
+    out = ring_attention(q, k, v, mesh, kv_mask=jnp.asarray(kv_mask))
+    full_mask = jnp.asarray(kv_mask)[:, None, None, :]
+    expect = _xla_attention(q, k, v, full_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_attention_via_bert_attn_fn():
+    """The zoo's pluggable-attention contract: BertSelfAttention passes the
+    [B,1,1,L] broadcast mask; ring attention must honor it."""
+    from kfserving_tpu.parallel.ring_attention import ring_attention_sharded
+
+    mesh = build_mesh(MeshConfig(dp=1, sp=4, tp=1))
+    attn = ring_attention_sharded(mesh)
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.normal(size=(1, 8, 2, 8)).astype("float32"))
+    mask4d = np.ones((1, 1, 1, 8), bool)
+    mask4d[..., 6:] = False
+    out = attn(q, q, q, jnp.asarray(mask4d))
+    expect = _xla_attention(q, q, q, jnp.asarray(mask4d))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               atol=1e-5, rtol=1e-5)
