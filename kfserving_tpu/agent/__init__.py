@@ -1,0 +1,24 @@
+"""Agent capabilities, in-process.
+
+The reference runs these in a Go sidecar binary (reference cmd/agent/
+main.go:289-323 chains proxy -> batcher -> logger; pkg/agent runs the
+model puller): payload logging, model pulling for multi-model serving, and
+readiness probing.  The TPU build runs them as asyncio tasks inside the
+model server process — no HTTP hairpin between sidecar and server
+(SURVEY.md §7.3-7.4), which also lets the puller hand models straight to
+the HBM-aware repository instead of POSTing localhost.
+
+- logger.py:     CloudEvents request/response tee with a bounded worker
+                 pool (reference pkg/logger: 5 workers, queue 100).
+- downloader.py: idempotent artifact download with SUCCESS.<sha> markers
+                 (reference pkg/agent/downloader.go:42-75).
+- watcher.py:    model-config file watcher with kubelet ..data symlink-swap
+                 semantics (reference pkg/agent/watcher.go:79-170).
+- puller.py:     per-model serialized load/unload pipeline (reference
+                 pkg/agent/puller.go:62-183).
+"""
+
+from kfserving_tpu.agent.downloader import Downloader  # noqa: F401
+from kfserving_tpu.agent.logger import LogMode, RequestLogger  # noqa: F401
+from kfserving_tpu.agent.puller import Puller  # noqa: F401
+from kfserving_tpu.agent.watcher import ModelConfigWatcher  # noqa: F401

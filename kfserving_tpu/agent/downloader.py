@@ -1,0 +1,65 @@
+"""Idempotent model artifact download.
+
+Reference semantics (pkg/agent/downloader.go:42-75): each successful
+download of (model, spec) drops a `SUCCESS.<sha256(spec)>` marker inside the
+model dir; a restart that finds the marker skips the pull entirely, and a
+changed spec (different storageUri/version) hashes differently so it
+re-downloads.  Stale markers from previous specs are removed on success.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+from typing import Optional
+
+from kfserving_tpu.storage import Storage
+
+logger = logging.getLogger("kfserving_tpu.agent.downloader")
+
+SUCCESS_PREFIX = "SUCCESS"
+
+
+def spec_digest(spec: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(spec, sort_keys=True).encode()).hexdigest()
+
+
+class Downloader:
+    def __init__(self, model_dir: str):
+        self.model_dir = model_dir
+        os.makedirs(model_dir, exist_ok=True)
+
+    def model_path(self, model_name: str) -> str:
+        return os.path.join(self.model_dir, model_name)
+
+    def _marker(self, model_name: str, digest: str) -> str:
+        return os.path.join(self.model_path(model_name),
+                            f"{SUCCESS_PREFIX}.{digest}")
+
+    def is_downloaded(self, model_name: str, spec: dict) -> bool:
+        return os.path.exists(self._marker(model_name, spec_digest(spec)))
+
+    def download(self, model_name: str, spec: dict) -> Optional[str]:
+        """Download spec["storageUri"] into <model_dir>/<model_name>.
+        Returns the local path, or None when already current."""
+        digest = spec_digest(spec)
+        target = self.model_path(model_name)
+        marker = self._marker(model_name, digest)
+        if os.path.exists(marker):
+            logger.info("model %s already downloaded (marker %s)",
+                        model_name, os.path.basename(marker))
+            return None
+        # A changed spec invalidates the previous artifact wholesale: remove
+        # the dir so partial/stale files can't mix generations (the
+        # reference keeps per-file hashes; whole-dir replace is simpler and
+        # safe because serving reads only after load()).
+        if os.path.isdir(target):
+            shutil.rmtree(target)
+        os.makedirs(target, exist_ok=True)
+        Storage.download(spec["storageUri"], target)
+        with open(marker, "w") as f:
+            f.write(digest)
+        logger.info("downloaded %s from %s", model_name, spec["storageUri"])
+        return target

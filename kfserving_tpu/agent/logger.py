@@ -1,0 +1,167 @@
+"""Payload logger: tee request/response bodies as CloudEvents to a sink.
+
+Reference semantics (pkg/logger/):
+- event types `org.kubeflow.serving.inference.request` / `.response`
+  (reference logger/worker.go:29-42);
+- CE extensions inferenceservicename / namespace / endpoint / component
+  (reference logger/worker.go:97-113);
+- a dispatcher with a bounded queue (100) and a fixed worker pool (5)
+  (reference logger/dispatcher.go:25-48);
+- log modes all | request | response (reference
+  pkg/apis/serving/v1beta1/inference_service.go:56-64).
+
+In-process: the logger attaches to ModelServer.request_hooks, so the tee
+happens after the response is computed with zero extra serialization of the
+hot path; drops (queue full) increment a counter instead of blocking
+serving — same backpressure decision as the reference's buffered channel.
+"""
+
+import asyncio
+import json
+import logging
+import uuid
+from enum import Enum
+from typing import Optional
+
+logger = logging.getLogger("kfserving_tpu.agent.logger")
+
+CE_TYPE_REQUEST = "org.kubeflow.serving.inference.request"
+CE_TYPE_RESPONSE = "org.kubeflow.serving.inference.response"
+DEFAULT_WORKERS = 5   # reference dispatcher.go:25
+QUEUE_SIZE = 100      # reference dispatcher.go:30
+
+
+class LogMode(str, Enum):
+    all = "all"
+    request = "request"
+    response = "response"
+
+
+class RequestLogger:
+    """Async CloudEvents tee.  Call start() inside a running loop; attach()
+    wires it into a ModelServer."""
+
+    def __init__(self, log_url: str, source_uri: str = "",
+                 log_mode: LogMode = LogMode.all,
+                 inference_service: str = "", namespace: str = "",
+                 endpoint: str = "", component: str = "predictor",
+                 workers: int = DEFAULT_WORKERS,
+                 queue_size: int = QUEUE_SIZE):
+        self.log_url = log_url
+        self.source_uri = source_uri
+        self.log_mode = LogMode(log_mode)
+        self.inference_service = inference_service
+        self.namespace = namespace
+        self.endpoint = endpoint
+        self.component = component
+        self.workers = workers
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=queue_size)
+        self.dropped = 0
+        self.sent = 0
+        self.failed = 0
+        self._tasks = []
+        self._session = None
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self):
+        import aiohttp
+
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=30))
+        self._tasks = [asyncio.create_task(self._worker())
+                       for _ in range(self.workers)]
+
+    async def stop(self):
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    # -- hot path ----------------------------------------------------------
+    def log(self, model: str, verb: str, kind: str, payload: bytes,
+            request_id: Optional[str] = None, status: int = 200):
+        """Enqueue one event; never blocks the serving path."""
+        if self.log_mode == LogMode.request and kind != "request":
+            return
+        if self.log_mode == LogMode.response and kind != "response":
+            return
+        event = {
+            "specversion": "1.0",
+            "id": request_id or str(uuid.uuid4()),
+            "type": (CE_TYPE_REQUEST if kind == "request"
+                     else CE_TYPE_RESPONSE),
+            "source": self.source_uri or f"http://localhost/models/{model}",
+            "datacontenttype": "application/json",
+            "inferenceservicename": self.inference_service,
+            "namespace": self.namespace,
+            "endpoint": self.endpoint,
+            "component": self.component,
+            "model": model,
+            "verb": verb,
+            "status": str(status),
+        }
+        try:
+            self.queue.put_nowait((event, payload))
+        except asyncio.QueueFull:
+            self.dropped += 1
+
+    def attach(self, server) -> None:
+        """Hook into a ModelServer: tees both directions per request with a
+        shared CE id (reference pairs request/response by id,
+        logger/handler.go:85-124)."""
+        def hook(name, verb, req, resp, latency_ms):
+            rid = str(uuid.uuid4())
+            self.log(name, verb, "request", req.body, request_id=rid,
+                     status=resp.status)
+            self.log(name, verb, "response", resp.body, request_id=rid,
+                     status=resp.status)
+
+        server.request_hooks.append(hook)
+
+    # -- workers -----------------------------------------------------------
+    async def _worker(self):
+        while True:
+            event, payload = await self.queue.get()
+            try:
+                await self._send(event, payload)
+                self.sent += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                self.failed += 1
+                logger.warning("log sink send failed: %s", e)
+            finally:
+                self.queue.task_done()
+
+    async def _send(self, event: dict, payload: bytes):
+        # Binary CloudEvents encoding: attributes -> ce- headers.
+        headers = {"content-type": event["datacontenttype"]}
+        for key in ("specversion", "id", "type", "source",
+                    "inferenceservicename", "namespace", "endpoint",
+                    "component", "model", "verb", "status"):
+            if event.get(key):
+                headers[f"ce-{key}"] = event[key]
+        if isinstance(payload, str):
+            payload = payload.encode("utf-8")
+        async with self._session.post(
+                self.log_url, data=payload or b"", headers=headers) as resp:
+            if resp.status >= 400:
+                raise RuntimeError(f"sink returned {resp.status}")
+
+    def stats(self) -> dict:
+        return {"sent": self.sent, "failed": self.failed,
+                "dropped": self.dropped, "queued": self.queue.qsize()}
+
+
+def structured_event(event: dict, payload: bytes) -> dict:
+    """Structured-mode encoding helper (tests / alternative sinks)."""
+    data: object = payload
+    try:
+        data = json.loads(payload)
+    except Exception:
+        if isinstance(payload, (bytes, bytearray)):
+            data = payload.decode("utf-8", "replace")
+    return {**event, "data": data}

@@ -1,0 +1,100 @@
+"""Model puller: watcher events -> download -> repository load/unload.
+
+Reference semantics (pkg/agent/puller.go:62-183): one event channel in, a
+per-model goroutine so ops on the *same* model serialize while different
+models pull in parallel; completed ops retire the per-model channel when
+drained.  The in-process version keeps the same shape with per-model
+asyncio queues and hands loaded artifacts straight to the ModelRepository
+(the reference POSTs localhost:8080/v2/repository/models/{m}/load|unload,
+puller.go:137-176 — same observable contract, minus the HTTP hop).
+"""
+
+import asyncio
+import logging
+from typing import Dict, Optional
+
+from kfserving_tpu.agent.downloader import Downloader
+
+logger = logging.getLogger("kfserving_tpu.agent.puller")
+
+
+class Puller:
+    def __init__(self, repository, downloader: Downloader,
+                 events: Optional[asyncio.Queue] = None):
+        self.repository = repository
+        self.downloader = downloader
+        self.events: asyncio.Queue = events or asyncio.Queue()
+        self._per_model: Dict[str, asyncio.Queue] = {}
+        self._workers: Dict[str, asyncio.Task] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.ops_ok = 0
+        self.ops_failed = 0
+
+    async def start(self):
+        self._task = asyncio.create_task(self._dispatch())
+
+    async def stop(self):
+        tasks = list(self._workers.values())
+        if self._task is not None:
+            tasks.append(self._task)
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        self._workers.clear()
+        self._per_model.clear()
+        self._task = None
+
+    async def _dispatch(self):
+        """Fan events out to per-model workers (ops on one model serialize,
+        different models proceed concurrently — puller.go:83-94)."""
+        while True:
+            op, name, spec = await self.events.get()
+            q = self._per_model.get(name)
+            if q is None:
+                q = asyncio.Queue()
+                self._per_model[name] = q
+                self._workers[name] = asyncio.create_task(
+                    self._model_worker(name, q))
+            await q.put((op, spec))
+            self.events.task_done()
+
+    async def _model_worker(self, name: str, q: asyncio.Queue):
+        while True:
+            op, spec = await q.get()
+            try:
+                if op == "load":
+                    await self._load(name, spec)
+                elif op == "unload":
+                    await self._unload(name)
+                self.ops_ok += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.ops_failed += 1
+                logger.exception("%s of model %s failed", op, name)
+            finally:
+                q.task_done()
+                if q.empty():
+                    # Retire the idle worker (reference drains and deletes
+                    # the channel, puller.go:120-134); a later event simply
+                    # spawns a fresh one.
+                    self._per_model.pop(name, None)
+                    self._workers.pop(name, None)
+                    return
+
+    async def _load(self, name: str, spec: dict):
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, self.downloader.download, name, spec)
+        ok = await self.repository.load(name)
+        if not ok:
+            raise RuntimeError(f"repository refused to load {name}")
+        logger.info("model %s loaded", name)
+
+    async def _unload(self, name: str):
+        await self.repository.unload(name)
+        logger.info("model %s unloaded", name)
+
+    def stats(self) -> dict:
+        return {"ops_ok": self.ops_ok, "ops_failed": self.ops_failed,
+                "active_models": len(self._workers)}

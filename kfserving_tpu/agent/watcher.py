@@ -1,0 +1,130 @@
+"""Model-config watcher: config file changes -> load/unload events.
+
+Reference semantics (pkg/agent/watcher.go:79-170): watch the mounted
+ConfigMap volume for kubelet's atomic `..data` symlink swap, reparse
+`models.json` ([{modelName, modelSpec:{storageUri, framework, memory}}] —
+pkg/modelconfig/configmap.go:34-51), diff against the in-memory view, and
+emit per-model ops: Add (new), Remove (gone), and re-Add for changed specs
+(the reference marks those ShouldDownload, watcher.go:150-165).
+
+In-process: no inotify dependency — an asyncio poll loop hashes the
+resolved config content.  Poll interval 1s matches kubelet's sync
+granularity well enough for serving (the reference's fsnotify is also
+bounded by kubelet's update cadence, not the notification hop).
+"""
+
+import asyncio
+import hashlib
+import json
+import logging
+import os
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger("kfserving_tpu.agent.watcher")
+
+MODEL_CONFIG_FILE = "models.json"
+
+
+def parse_model_config(raw: bytes) -> Dict[str, dict]:
+    """models.json -> {name: spec}.  Invalid entries are skipped with a
+    warning (one bad model must not take down the others)."""
+    try:
+        entries = json.loads(raw or b"[]")
+    except ValueError as e:
+        raise ValueError(f"invalid model config: {e}")
+    out: Dict[str, dict] = {}
+    for entry in entries if isinstance(entries, list) else []:
+        name = entry.get("modelName")
+        spec = entry.get("modelSpec")
+        if not name or not isinstance(spec, dict) or \
+                "storageUri" not in spec:
+            logger.warning("skipping invalid model config entry: %r", entry)
+            continue
+        out[name] = spec
+    return out
+
+
+def diff_configs(old: Dict[str, dict], new: Dict[str, dict]
+                 ) -> Tuple[Dict[str, dict], Dict[str, dict], list]:
+    """Returns (added_or_changed, unchanged, removed_names)."""
+    added = {n: s for n, s in new.items()
+             if n not in old or old[n] != s}
+    unchanged = {n: s for n, s in new.items()
+                 if n in old and old[n] == s}
+    removed = [n for n in old if n not in new]
+    return added, unchanged, removed
+
+
+class ModelConfigWatcher:
+    """Polls a model-config path and pushes ("load"|"unload", name, spec)
+    events onto `events` (consumed by the Puller)."""
+
+    def __init__(self, config_path: str,
+                 events: Optional[asyncio.Queue] = None,
+                 poll_interval: float = 1.0):
+        self.config_path = config_path
+        self.events: asyncio.Queue = events or asyncio.Queue()
+        self.poll_interval = poll_interval
+        self.current: Dict[str, dict] = {}
+        self._digest: Optional[str] = None
+        self._task: Optional[asyncio.Task] = None
+
+    def _resolve(self) -> str:
+        """ConfigMap volumes present the file through a `..data` symlink
+        dir; accept either the file itself or a directory containing it."""
+        path = self.config_path
+        if os.path.isdir(path):
+            path = os.path.join(path, MODEL_CONFIG_FILE)
+        return path
+
+    def _read(self) -> Optional[bytes]:
+        try:
+            with open(self._resolve(), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    async def sync(self) -> bool:
+        """One reconcile pass; returns True if events were emitted."""
+        raw = self._read()
+        if raw is None:
+            return False
+        digest = hashlib.sha256(raw).hexdigest()
+        if digest == self._digest:
+            return False
+        try:
+            new = parse_model_config(raw)
+        except ValueError as e:
+            logger.error("%s", e)
+            return False
+        added, _, removed = diff_configs(self.current, new)
+        for name in removed:
+            await self.events.put(("unload", name, self.current[name]))
+        for name, spec in added.items():
+            await self.events.put(("load", name, spec))
+        self.current = new
+        self._digest = digest
+        if added or removed:
+            logger.info("model config sync: +%d -%d",
+                        len(added), len(removed))
+        return bool(added or removed)
+
+    async def start(self):
+        self._task = asyncio.create_task(self._loop())
+
+    async def _loop(self):
+        while True:
+            try:
+                await self.sync()
+            except Exception:
+                logger.exception("model config sync failed")
+            await asyncio.sleep(self.poll_interval)
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

@@ -114,10 +114,12 @@ class HBMManager:
             victims: List[str] = []
             while nbytes > self.budget_bytes - sum(
                     r.bytes for r in plan.values()):
+                plan_free = self.budget_bytes - sum(
+                    r.bytes for r in plan.values())
                 if not evict:
                     raise InsufficientHBM(
                         f"model {name} needs {nbytes} bytes; only "
-                        f"{self.free_bytes} free and eviction disabled")
+                        f"{plan_free} free and eviction disabled")
                 victim = next(iter(plan), None)  # LRU order
                 if victim is None:
                     raise InsufficientHBM(

@@ -168,8 +168,16 @@ class JaxEngine:
         leaves = self._jax.tree.leaves(self.params)
         return sum(getattr(x, "nbytes", 0) for x in leaves)
 
-    def close(self):
-        """Release device references so HBM can be reclaimed."""
+    def close(self, wait: bool = True):
+        """Release device references so HBM can be reclaimed.
+
+        wait=True (default) quiesces first: in-flight executions on the
+        worker threads finish before param buffers are deleted, so a
+        concurrent predict never dereferences freed HBM.  Executions
+        submitted after close() fail fast with RuntimeError (executor shut
+        down) instead of touching deleted buffers.
+        """
+        self._executor.shutdown(wait=wait)
         for leaf in self._jax.tree.leaves(self.params):
             if hasattr(leaf, "delete"):
                 try:
@@ -177,7 +185,6 @@ class JaxEngine:
                 except Exception:  # already deleted / cpu array
                     pass
         self.params = None
-        self._executor.shutdown(wait=False)
 
     def stats(self) -> Dict[str, float]:
         return {

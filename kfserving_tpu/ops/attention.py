@@ -74,9 +74,11 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         causal-only case.
     """
     if causal:
-        L = q.shape[1]
+        Lq, Lk = q.shape[1], k.shape[1]
+        # KV-cache decode has Lq < Lk: query i sits at absolute position
+        # (Lk - Lq + i), so the allowed region is a shifted triangle.
         causal_mask = jnp.tril(
-            jnp.ones((L, q.shape[1]), jnp.bool_))[None, None, :, :]
+            jnp.ones((Lq, Lk), jnp.bool_), k=Lk - Lq)[None, None, :, :]
         mask = causal_mask if mask is None else (mask & causal_mask)
         flash_ok = mask is causal_mask  # no extra mask was merged in
     else:

@@ -106,12 +106,7 @@ class JaxModel(Model):
 
     # -- lifecycle ---------------------------------------------------------
     def load(self) -> bool:
-        import jax.numpy as jnp
-
-        from kfserving_tpu.models import (
-            apply_fn_for, create_model, init_params)
-        from kfserving_tpu.parallel import build_mesh, shard_params
-        from kfserving_tpu.parallel.mesh import MeshConfig
+        from kfserving_tpu.models import create_model, init_params
 
         self._local_dir = Storage.download(self.model_dir)
         cfg = self.config
@@ -121,6 +116,36 @@ class JaxModel(Model):
             self.config = cfg
 
         spec = create_model(cfg.architecture, **cfg.arch_kwargs)
+
+        # HBM admission BEFORE any device allocation: size the params with
+        # eval_shape (no buffers), admit/evict against the budget, and only
+        # then materialize.  A failed admit leaves the device untouched.
+        if self.hbm is not None:
+            import jax
+
+            abstract = jax.eval_shape(lambda: init_params(spec, seed=0))
+            nbytes = sum(
+                int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree.leaves(abstract))
+            self.hbm.admit(self.name, nbytes)
+
+        try:
+            return self._load_admitted(spec, cfg)
+        except Exception:
+            if self.hbm is not None:
+                self.hbm.release(self.name)
+            if self.engine is not None:
+                self.engine.close()
+                self.engine = None
+            raise
+
+    def _load_admitted(self, spec, cfg) -> bool:
+        import jax.numpy as jnp
+
+        from kfserving_tpu.models import apply_fn_for, init_params
+        from kfserving_tpu.parallel import build_mesh, shard_params
+        from kfserving_tpu.parallel.mesh import MeshConfig
+
         variables = init_params(spec, seed=0)
         ckpt_path = os.path.join(self._local_dir, CHECKPOINT_NAME)
         if os.path.exists(ckpt_path):
@@ -151,10 +176,7 @@ class JaxModel(Model):
             x = batch
             if not isinstance(x, dict) and scale is not None:
                 x = x.astype(jnp.bfloat16) * scale
-            if isinstance(x, dict):
-                out = base_apply(v, x)
-            else:
-                out = base_apply(v, x)
+            out = base_apply(v, x)
             if output_mode == "argmax":
                 return jnp.argmax(out, axis=-1).astype(jnp.int32)
             if output_mode == "topk":
@@ -171,9 +193,6 @@ class JaxModel(Model):
             serve_fn, variables,
             batch_buckets=BucketPolicy.pow2(cfg.max_batch_size),
             seq_buckets=seq_buckets)
-
-        if self.hbm is not None:
-            self.hbm.admit(self.name, self.engine.param_bytes())
 
         if cfg.warmup:
             example = self._example_instance(spec)

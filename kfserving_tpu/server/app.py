@@ -81,6 +81,9 @@ class ModelServer:
         self._register_routes()
         self.http_server = HTTPServer(self.router)
         self.request_hooks = []  # agent logger taps in here
+        # Agent-style background services (logger, watcher, puller): objects
+        # with async start()/stop(), run for the server's lifetime.
+        self.services = []
 
     # -- routes ------------------------------------------------------------
     def _register_routes(self):
@@ -220,6 +223,8 @@ class ModelServer:
                           host: str = "0.0.0.0") -> None:
         for model in models:
             self.register_model(model)
+        for service in self.services:
+            await service.start()
         await self.http_server.start(host, self.http_port)
         self.http_port = self.http_server.port
 
@@ -228,6 +233,8 @@ class ModelServer:
             close = getattr(model, "close", None)
             if close is not None:
                 await close()
+        for service in reversed(self.services):
+            await service.stop()
         await self.http_server.stop()
 
     def start(self, models: List[Model]) -> None:

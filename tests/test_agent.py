@@ -1,0 +1,353 @@
+"""Agent subsystem tests: logger tee, config watcher diffing, idempotent
+downloader, puller pipeline — mirroring the reference's
+pkg/{logger,agent} test strategy (SURVEY.md §4: in-process HTTP fakes and
+interface-mocked storage)."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from kfserving_tpu.agent import (
+    Downloader,
+    LogMode,
+    ModelConfigWatcher,
+    Puller,
+    RequestLogger,
+)
+from kfserving_tpu.agent.downloader import spec_digest
+from kfserving_tpu.agent.watcher import diff_configs, parse_model_config
+
+
+# ---------------------------------------------------------------- logger --
+class _Sink:
+    """In-process CloudEvents sink (reference uses a fake next-handler /
+    message-dumper, pkg/logger/handler_test.go)."""
+
+    def __init__(self):
+        self.received = []
+        self.runner = None
+        self.url = None
+
+    async def start(self):
+        from aiohttp import web
+
+        async def handle(request):
+            self.received.append({
+                "headers": dict(request.headers),
+                "body": await request.read(),
+            })
+            return web.Response(text="ok")
+
+        app = web.Application()
+        app.router.add_post("/", handle)
+        self.runner = web.AppRunner(app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}/"
+
+    async def stop(self):
+        await self.runner.cleanup()
+
+
+async def test_logger_tees_request_and_response_events():
+    sink = _Sink()
+    await sink.start()
+    try:
+        lg = RequestLogger(sink.url, inference_service="isvc1",
+                           namespace="ns", endpoint="default")
+        await lg.start()
+        lg.log("m", "predict", "request", b'{"instances": [1]}',
+               request_id="rid-1")
+        lg.log("m", "predict", "response", b'{"predictions": [2]}',
+               request_id="rid-1")
+        await lg.queue.join()
+        await lg.stop()
+    finally:
+        await sink.stop()
+
+    assert len(sink.received) == 2
+    types = {r["headers"]["ce-type"] for r in sink.received}
+    assert types == {"org.kubeflow.serving.inference.request",
+                     "org.kubeflow.serving.inference.response"}
+    for r in sink.received:
+        assert r["headers"]["ce-id"] == "rid-1"
+        assert r["headers"]["ce-inferenceservicename"] == "isvc1"
+        assert r["headers"]["ce-namespace"] == "ns"
+    bodies = {r["body"] for r in sink.received}
+    assert b'{"instances": [1]}' in bodies
+
+
+async def test_logger_mode_filters():
+    sink = _Sink()
+    await sink.start()
+    try:
+        lg = RequestLogger(sink.url, log_mode=LogMode.response)
+        await lg.start()
+        lg.log("m", "predict", "request", b"req")
+        lg.log("m", "predict", "response", b"resp")
+        await lg.queue.join()
+        await lg.stop()
+    finally:
+        await sink.stop()
+    assert len(sink.received) == 1
+    assert sink.received[0]["body"] == b"resp"
+
+
+async def test_logger_queue_full_drops_not_blocks():
+    lg = RequestLogger("http://sink.invalid/", queue_size=2)
+    # no workers started: queue fills
+    for _ in range(5):
+        lg.log("m", "predict", "request", b"x")
+    assert lg.queue.qsize() == 2
+    assert lg.dropped == 3
+
+
+async def test_logger_attached_to_server_tees_predict(tmp_path):
+    """End-to-end: ModelServer hook -> logger -> sink."""
+    import numpy as np
+
+    from kfserving_tpu.model.model import Model
+    from kfserving_tpu.server.app import ModelServer
+
+    class Echo(Model):
+        def load(self):
+            self.ready = True
+            return True
+
+        async def predict(self, request):
+            return {"predictions": request["instances"]}
+
+    sink = _Sink()
+    await sink.start()
+    lg = RequestLogger(sink.url)
+    server = ModelServer(http_port=0)
+    m = Echo("e")
+    m.load()
+    server.register_model(m)
+    lg.attach(server)
+    await lg.start()
+    try:
+        # Call through the inference path without binding a socket.
+        from kfserving_tpu.server.http import Request
+
+        req = Request(method="POST", path="/v1/models/e:predict", query={},
+                      headers={}, body=b'{"instances": [1, 2]}')
+        req.path_params = {"name": "e"}
+        resp = await server._inference(req, "predict", server.dataplane.infer)
+        assert resp.status == 200
+        await lg.queue.join()
+        await lg.stop()
+    finally:
+        await sink.stop()
+    assert len(sink.received) == 2
+    ids = {r["headers"]["ce-id"] for r in sink.received}
+    assert len(ids) == 1  # request/response share one CE id
+
+
+# --------------------------------------------------------------- watcher --
+def test_parse_model_config_skips_invalid():
+    raw = json.dumps([
+        {"modelName": "a", "modelSpec": {"storageUri": "file:///x"}},
+        {"modelName": "bad"},
+        {"modelSpec": {"storageUri": "file:///y"}},
+    ]).encode()
+    out = parse_model_config(raw)
+    assert list(out) == ["a"]
+
+
+def test_diff_configs():
+    old = {"a": {"storageUri": "u1"}, "b": {"storageUri": "u2"}}
+    new = {"a": {"storageUri": "u1-changed"}, "c": {"storageUri": "u3"}}
+    added, unchanged, removed = diff_configs(old, new)
+    assert set(added) == {"a", "c"}  # changed spec counts as re-add
+    assert removed == ["b"]
+    assert unchanged == {}
+
+
+async def test_watcher_emits_load_unload(tmp_path):
+    cfg = os.path.join(str(tmp_path), "models.json")
+
+    def write(models):
+        with open(cfg, "w") as f:
+            json.dump(models, f)
+
+    write([{"modelName": "m1", "modelSpec": {"storageUri": "file:///a"}}])
+    w = ModelConfigWatcher(cfg)
+    assert await w.sync()
+    op, name, spec = w.events.get_nowait()
+    assert (op, name) == ("load", "m1")
+
+    # unchanged content -> no events
+    assert not await w.sync()
+
+    write([{"modelName": "m2", "modelSpec": {"storageUri": "file:///b"}}])
+    assert await w.sync()
+    ops = {}
+    while not w.events.empty():
+        op, name, _ = w.events.get_nowait()
+        ops[name] = op
+    assert ops == {"m1": "unload", "m2": "load"}
+
+
+# ------------------------------------------------------------ downloader --
+def test_downloader_idempotent(tmp_path):
+    src = tmp_path / "artifact"
+    src.mkdir()
+    (src / "config.json").write_text("{}")
+    spec = {"storageUri": f"file://{src}"}
+    d = Downloader(str(tmp_path / "models"))
+
+    path = d.download("m", spec)
+    assert path and os.path.exists(os.path.join(path, "config.json"))
+    assert d.is_downloaded("m", spec)
+    assert d.download("m", spec) is None  # marker short-circuits
+
+    # changed spec -> new digest -> re-download, old marker gone
+    spec2 = {"storageUri": f"file://{src}", "version": "2"}
+    assert d.download("m", spec2) is not None
+    assert d.is_downloaded("m", spec2)
+    assert not d.is_downloaded("m", spec)
+
+
+def test_spec_digest_stable_across_key_order():
+    assert spec_digest({"a": 1, "b": 2}) == spec_digest({"b": 2, "a": 1})
+
+
+# ---------------------------------------------------------------- puller --
+class _FakeRepo:
+    def __init__(self):
+        self.loaded = []
+        self.unloaded = []
+
+    async def load(self, name):
+        self.loaded.append(name)
+        return True
+
+    async def unload(self, name):
+        self.unloaded.append(name)
+
+
+async def test_puller_end_to_end(tmp_path):
+    src = tmp_path / "artifact"
+    src.mkdir()
+    (src / "config.json").write_text("{}")
+    cfg = os.path.join(str(tmp_path), "models.json")
+    with open(cfg, "w") as f:
+        json.dump([{"modelName": "m1",
+                    "modelSpec": {"storageUri": f"file://{src}"}}], f)
+
+    repo = _FakeRepo()
+    events: asyncio.Queue = asyncio.Queue()
+    watcher = ModelConfigWatcher(cfg, events=events)
+    puller = Puller(repo, Downloader(str(tmp_path / "models")),
+                    events=events)
+    await puller.start()
+    try:
+        await watcher.sync()
+        await events.join()
+        for _ in range(100):
+            if repo.loaded:
+                break
+            await asyncio.sleep(0.01)
+        assert repo.loaded == ["m1"]
+        assert os.path.exists(
+            str(tmp_path / "models" / "m1" / "config.json"))
+
+        with open(cfg, "w") as f:
+            json.dump([], f)
+        await watcher.sync()
+        for _ in range(100):
+            if repo.unloaded:
+                break
+            await asyncio.sleep(0.01)
+        assert repo.unloaded == ["m1"]
+    finally:
+        await puller.stop()
+
+
+async def test_puller_survives_failing_op(tmp_path):
+    class _BoomRepo(_FakeRepo):
+        async def load(self, name):
+            if name == "bad":
+                raise RuntimeError("boom")
+            return await super().load(name)
+
+    src = tmp_path / "artifact"
+    src.mkdir()
+    (src / "f").write_text("x")
+    repo = _BoomRepo()
+    puller = Puller(repo, Downloader(str(tmp_path / "models")))
+    await puller.start()
+    try:
+        spec = {"storageUri": f"file://{src}"}
+        await puller.events.put(("load", "bad", spec))
+        await puller.events.put(("load", "good", spec))
+        for _ in range(200):
+            if repo.loaded:
+                break
+            await asyncio.sleep(0.01)
+        assert repo.loaded == ["good"]
+        assert puller.ops_failed == 1
+    finally:
+        await puller.stop()
+
+
+async def test_mms_end_to_end_jax_repository(tmp_path):
+    """BASELINE.json config #4 shape: model appears in the config -> pulled
+    -> loaded as a JaxModel -> serves predictions -> removed -> unloaded."""
+    import numpy as np
+    from flax import serialization
+
+    from kfserving_tpu.models import create_model, init_params
+    from kfserving_tpu.predictors.jaxserver import JaxModelRepository
+
+    # artifact: tiny MLP
+    src = tmp_path / "artifacts" / "m1"
+    src.mkdir(parents=True)
+    arch_kwargs = {"input_dim": 4, "features": [8], "num_classes": 2}
+    (src / "config.json").write_text(json.dumps({
+        "architecture": "mlp", "arch_kwargs": arch_kwargs,
+        "max_latency_ms": 5, "warmup": False}))
+    spec = create_model("mlp", **arch_kwargs)
+    (src / "checkpoint.msgpack").write_bytes(
+        serialization.to_bytes(init_params(spec, seed=0)))
+
+    models_dir = str(tmp_path / "models")
+    cfg = str(tmp_path / "models.json")
+    with open(cfg, "w") as f:
+        json.dump([{"modelName": "m1",
+                    "modelSpec": {"storageUri": f"file://{src}",
+                                  "memory": "1Gi"}}], f)
+
+    repo = JaxModelRepository(models_dir=models_dir)
+    events: asyncio.Queue = asyncio.Queue()
+    watcher = ModelConfigWatcher(cfg, events=events)
+    puller = Puller(repo, Downloader(models_dir), events=events)
+    await puller.start()
+    try:
+        await watcher.sync()
+        for _ in range(500):
+            if repo.is_model_ready("m1"):
+                break
+            await asyncio.sleep(0.02)
+        assert repo.is_model_ready("m1")
+
+        model = repo.get_model("m1")
+        resp = await model.predict(
+            {"instances": np.ones((2, 4)).tolist()})
+        assert len(resp["predictions"]) == 2
+
+        with open(cfg, "w") as f:
+            json.dump([], f)
+        await watcher.sync()
+        for _ in range(500):
+            if repo.get_model("m1") is None:
+                break
+            await asyncio.sleep(0.02)
+        assert repo.get_model("m1") is None
+    finally:
+        await puller.stop()

@@ -1,9 +1,11 @@
 """Driver-contract tests: the multichip dryrun must compile and execute on
 the virtual CPU mesh, and the mesh factorization must use every device."""
 
+import os
 import sys
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import __graft_entry__ as graft  # noqa: E402
 

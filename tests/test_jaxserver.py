@@ -176,3 +176,18 @@ def test_config_requires_architecture(tmp_path):
         json.dump({"max_batch_size": 8}, f)
     with pytest.raises(Exception, match="architecture"):
         JaxModelConfig.from_file(p)
+
+
+def test_failed_admission_leaves_no_residue(tmp_path):
+    """A model too big for the budget must fail load() without holding any
+    HBM accounting (admission runs before device allocation)."""
+    from kfserving_tpu.engine.hbm import InsufficientHBM
+
+    model_dir = _write_model_dir(tmp_path)
+    hbm = HBMManager(budget_bytes=10)  # smaller than the MLP params
+    m = JaxModel("m", model_dir, hbm=hbm)
+    with pytest.raises(InsufficientHBM):
+        m.load()
+    assert not m.ready
+    assert m.engine is None
+    assert hbm.resident_models() == []
