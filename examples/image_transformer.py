@@ -1,0 +1,54 @@
+"""Transformer example: preprocess images, proxy predict to the predictor.
+
+Mirrors the reference sample (reference docs/samples/v1alpha2/transformer/
+image_transformer/image_transformer/image_transformer.py:45-53 — a KFModel
+subclass overriding preprocess only; predict proxies to predictor_host over
+the cluster-local gateway, reference kfmodel.py:88-104).
+
+Run:
+    python examples/image_transformer.py --predictor_host localhost:8080
+"""
+
+import argparse
+import logging
+
+import numpy as np
+
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.server.app import ModelServer, parser as server_parser
+
+logging.basicConfig(level=logging.INFO)
+
+MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
+STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
+
+
+class ImageTransformer(Model):
+    """Scales uint8 HWC images to the predictor's normalized float input."""
+
+    def __init__(self, name: str, predictor_host: str):
+        super().__init__(name)
+        self.predictor_host = predictor_host
+        self.ready = True
+
+    async def preprocess(self, request):
+        request = await super().preprocess(request)  # CloudEvent unwrap
+        instances = request.get("instances", [])
+        out = []
+        for inst in instances:
+            arr = np.asarray(inst, dtype=np.float32)
+            if arr.max() > 1.5:  # uint8-range pixels
+                arr = arr / 255.0
+            arr = (arr - MEAN) / STD
+            out.append(arr.tolist())
+        return {"instances": out}
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(parents=[server_parser])
+    parser.add_argument("--model_name", default="model")
+    parser.add_argument("--predictor_host", required=True)
+    args, _ = parser.parse_known_args()
+    transformer = ImageTransformer(args.model_name,
+                                   predictor_host=args.predictor_host)
+    ModelServer(http_port=args.http_port).start([transformer])

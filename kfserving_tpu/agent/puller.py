@@ -92,7 +92,13 @@ class Puller:
         logger.info("model %s loaded", name)
 
     async def _unload(self, name: str):
-        await self.repository.unload(name)
+        try:
+            await self.repository.unload(name)
+        except KeyError:
+            # Never-successfully-loaded model removed from the config:
+            # expected no-op, not a failure (its load may have errored).
+            logger.info("model %s was not loaded; nothing to unload", name)
+            return
         logger.info("model %s unloaded", name)
 
     def stats(self) -> dict:

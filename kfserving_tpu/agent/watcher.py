@@ -32,8 +32,14 @@ def parse_model_config(raw: bytes) -> Dict[str, dict]:
         entries = json.loads(raw or b"[]")
     except ValueError as e:
         raise ValueError(f"invalid model config: {e}")
+    if not isinstance(entries, list):
+        # A dict/scalar here is a config typo, not "zero models" — treating
+        # it as empty would silently unload the whole fleet.
+        raise ValueError(
+            f"invalid model config: expected a JSON list, got "
+            f"{type(entries).__name__}")
     out: Dict[str, dict] = {}
-    for entry in entries if isinstance(entries, list) else []:
+    for entry in entries:
         name = entry.get("modelName")
         spec = entry.get("modelSpec")
         if not name or not isinstance(spec, dict) or \

@@ -112,10 +112,11 @@ class HBMManager:
             plan = OrderedDict(
                 (k, v) for k, v in self._resident.items() if k != name)
             victims: List[str] = []
-            while nbytes > self.budget_bytes - sum(
-                    r.bytes for r in plan.values()):
+            while True:
                 plan_free = self.budget_bytes - sum(
                     r.bytes for r in plan.values())
+                if nbytes <= plan_free:
+                    break
                 if not evict:
                     raise InsufficientHBM(
                         f"model {name} needs {nbytes} bytes; only "

@@ -1,0 +1,13 @@
+"""Explainers: the reference's explainer component (reference
+python/alibiexplainer wraps Alibi Anchor* behind explain(); served at
+/v1/models/<m>:explain via the same ingress split,
+pkg/controller/.../ingress_reconciler.go:184-217).
+
+The TPU-native explainer is gradient saliency computed with jax.grad ON
+DEVICE next to the served model — no black-box perturbation loop over
+HTTP, which is what made the reference's explainers orders of magnitude
+slower than predicts.  A black-box (predictor_host-proxying) explainer is
+also provided for parity with the reference's deployment shape.
+"""
+
+from kfserving_tpu.explainers.saliency import SaliencyExplainer  # noqa: F401

@@ -1,0 +1,129 @@
+"""Gradient-saliency explainer for JAX models.
+
+explain() returns, per instance, the input-gradient attribution of the
+winning logit: d logit[argmax] / d input, reduced over non-feature axes.
+Runs as one jitted program on the same device as the model — contrast the
+reference's explainer pods, which POST thousands of perturbed samples to
+the predictor over HTTP (reference alibiexplainer/explainer.py:39-100).
+
+Serves either:
+- co-located: constructed over a loaded JaxModel's spec/params; or
+- standalone explainer pod: constructed with its own model_dir copy
+  (the reference's explainer downloads the same storageUri).
+"""
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from kfserving_tpu.predictors.jax_model import JaxModel
+from kfserving_tpu.protocol import v1
+from kfserving_tpu.protocol.errors import InferenceError
+
+logger = logging.getLogger("kfserving_tpu.explainers")
+
+
+class SaliencyExplainer(JaxModel):
+    """JaxModel whose explain() returns input-gradient saliency maps."""
+
+    def __init__(self, name: str, model_dir: str, **kwargs):
+        super().__init__(name, model_dir, **kwargs)
+        self._saliency_fn = None
+
+    def load(self) -> bool:
+        ok = super().load()
+        if not ok:
+            return ok
+        import jax
+        import jax.numpy as jnp
+
+        engine = self.engine
+        params = engine.params
+        base = engine._jitted  # serve_fn(params, batch)
+
+        def winning_logit_sum(x):
+            out = base(params, x)
+            # output modes: logits [B, C] (or [B, L, C]); reduce to the
+            # winning class per instance and sum over batch for one grad.
+            logits = out if not isinstance(out, dict) else out["values"]
+            winners = jnp.max(logits, axis=-1)
+            return jnp.sum(winners)
+
+        self._saliency_fn = jax.jit(jax.grad(winning_logit_sum))
+        return ok
+
+    async def explain(self, request: Any) -> Any:
+        if self.predictor_host:
+            return await super().explain(request)
+        if self._saliency_fn is None:
+            raise InferenceError(f"explainer {self.name} not loaded")
+        instances = v1.get_instances(request)
+        batch = np.asarray(instances, dtype=np.float32)
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        grads = await loop.run_in_executor(
+            None, lambda: np.asarray(self._saliency_fn(batch)))
+        return {
+            "explanations": [
+                {"saliency": g.tolist(),
+                 "method": "gradient_saliency"} for g in grads
+            ]
+        }
+
+    def metadata(self) -> Dict[str, Any]:
+        meta = super().metadata()
+        meta["explainer"] = "gradient_saliency"
+        return meta
+
+
+class BlackBoxExplainer(JaxModel):
+    """Parity shape with the reference explainer pods: explain() perturbs
+    inputs locally and scores them against predictor_host over HTTP
+    (reference explainer_wrapper.py _predict_fn pattern).  Feature
+    importance = prediction flip rate under feature masking."""
+
+    def __init__(self, name: str, num_samples: int = 32,
+                 seed: int = 0):
+        # Deliberately not calling JaxModel.__init__ loading machinery:
+        # black-box explainers own no model artifact.
+        from kfserving_tpu.model.model import Model
+
+        Model.__init__(self, name)
+        self.num_samples = num_samples
+        self.seed = seed
+
+    def load(self) -> bool:
+        self.ready = True
+        return True
+
+    async def explain(self, request: Any) -> Any:
+        if not self.predictor_host:
+            raise InferenceError(
+                "BlackBoxExplainer requires predictor_host")
+        instances = v1.get_instances(request)
+        batch = np.asarray(instances, dtype=np.float32)
+        base = await self._remote_predict(batch)
+        rng = np.random.default_rng(self.seed)
+        n_features = batch.shape[1]
+        importance = np.zeros((batch.shape[0], n_features))
+        for f in range(n_features):
+            flips = np.zeros(batch.shape[0])
+            for _ in range(self.num_samples):
+                perturbed = batch.copy()
+                perturbed[:, f] = rng.permutation(perturbed[:, f])
+                pred = await self._remote_predict(perturbed)
+                flips += (np.asarray(pred) != np.asarray(base)).reshape(
+                    batch.shape[0], -1).any(axis=1)
+            importance[:, f] = flips / self.num_samples
+        return {"explanations": [
+            {"feature_importance": imp.tolist(),
+             "method": "permutation_flip_rate"} for imp in importance]}
+
+    async def _remote_predict(self, batch: np.ndarray):
+        from kfserving_tpu.model.model import PREDICTOR_URL_FORMAT
+
+        url = PREDICTOR_URL_FORMAT.format(self.predictor_host, self.name)
+        resp = await self._proxy(url, {"instances": batch.tolist()})
+        return resp["predictions"]

@@ -117,6 +117,12 @@ class JaxModel(Model):
 
         spec = create_model(cfg.architecture, **cfg.arch_kwargs)
 
+        # Reload is transactional: the new engine/batcher are built aside
+        # and swapped in only on success.  A failed reload leaves the old
+        # generation serving (and restores its HBM accounting); a failed
+        # first load leaves the model not-ready with nothing allocated.
+        old_engine, old_batcher = self.engine, self.batcher
+
         # HBM admission BEFORE any device allocation: size the params with
         # eval_shape (no buffers), admit/evict against the budget, and only
         # then materialize.  A failed admit leaves the device untouched.
@@ -130,16 +136,22 @@ class JaxModel(Model):
             self.hbm.admit(self.name, nbytes)
 
         try:
-            return self._load_admitted(spec, cfg)
+            engine, batcher = self._build_engine(spec, cfg)
         except Exception:
             if self.hbm is not None:
-                self.hbm.release(self.name)
-            if self.engine is not None:
-                self.engine.close()
-                self.engine = None
+                if old_engine is not None:
+                    # Old generation still serving: put its entry back.
+                    self.hbm.admit(self.name, old_engine.param_bytes())
+                else:
+                    self.hbm.release(self.name)
             raise
+        self.engine, self.batcher = engine, batcher
+        self.ready = True
+        if old_engine is not None:
+            old_engine.close()  # quiesces in-flight work, frees old HBM
+        return True
 
-    def _load_admitted(self, spec, cfg) -> bool:
+    def _build_engine(self, spec, cfg):
         import jax.numpy as jnp
 
         from kfserving_tpu.models import apply_fn_for, init_params
@@ -189,22 +201,24 @@ class JaxModel(Model):
 
         seq_buckets = (BucketPolicy(cfg.seq_buckets)
                        if cfg.seq_buckets else None)
-        self.engine = JaxEngine(
+        engine = JaxEngine(
             serve_fn, variables,
             batch_buckets=BucketPolicy.pow2(cfg.max_batch_size),
             seq_buckets=seq_buckets)
+        try:
+            if cfg.warmup:
+                example = self._example_instance(spec)
+                engine.warmup(example)
+        except Exception:
+            engine.close()
+            raise
 
-        if cfg.warmup:
-            example = self._example_instance(spec)
-            self.engine.warmup(example)
-
-        self.batcher = DynamicBatcher(
+        batcher = DynamicBatcher(
             self._batch_handler,
             max_batch_size=cfg.max_batch_size,
             max_latency_ms=cfg.max_latency_ms,
             key_fn=self._bucket_key if seq_buckets else None)
-        self.ready = True
-        return True
+        return engine, batcher
 
     def _example_instance(self, spec):
         cfg = self.config

@@ -1,0 +1,1 @@
+from kfserving_tpu.predictors.lgbserver.model import LightGBMModel  # noqa: F401

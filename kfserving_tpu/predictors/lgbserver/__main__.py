@@ -1,0 +1,20 @@
+"""`python -m kfserving_tpu.predictors.lgbserver`."""
+
+import argparse
+import logging
+
+from kfserving_tpu.predictors.lgbserver.model import LightGBMModel
+from kfserving_tpu.server.app import ModelServer, parser as server_parser
+
+logging.basicConfig(level=logging.INFO)
+
+parser = argparse.ArgumentParser(parents=[server_parser])
+parser.add_argument("--model_name", default="model")
+parser.add_argument("--model_dir", required=True)
+parser.add_argument("--nthread", default=1, type=int)
+
+if __name__ == "__main__":
+    args, _ = parser.parse_known_args()
+    model = LightGBMModel(args.model_name, args.model_dir, args.nthread)
+    model.load()
+    ModelServer(http_port=args.http_port).start([model])

@@ -1,0 +1,21 @@
+"""lightgbm predictor (reference python/lgbserver/lgbserver/model.py:
+Booster(model_file=...) then predict).  Import-gated like xgbserver."""
+
+from kfserving_tpu.predictors.tabular import TabularModel
+
+
+class LightGBMModel(TabularModel):
+    ARTIFACT_EXTENSIONS = (".txt", ".lgb")
+
+    def __init__(self, name: str, model_dir: str, nthread: int = 1):
+        super().__init__(name, model_dir)
+        self.nthread = nthread
+
+    def _load_artifact(self, path: str):
+        import lightgbm as lgb
+
+        return lgb.Booster(params={"num_threads": self.nthread},
+                           model_file=path)
+
+    def _predict_batch(self, batch):
+        return self._model.predict(batch)

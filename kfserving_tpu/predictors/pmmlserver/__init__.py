@@ -1,0 +1,1 @@
+from kfserving_tpu.predictors.pmmlserver.model import PMMLModel  # noqa: F401

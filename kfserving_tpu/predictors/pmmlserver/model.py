@@ -1,0 +1,18 @@
+"""PMML predictor (reference python/pmmlserver/pmmlserver/model.py: pypmml
+Model.load then evaluate row-wise).  Import-gated like xgbserver."""
+
+from kfserving_tpu.predictors.tabular import TabularModel
+
+
+class PMMLModel(TabularModel):
+    ARTIFACT_EXTENSIONS = (".pmml", ".xml")
+
+    def _load_artifact(self, path: str):
+        from pypmml import Model as PmmlModel
+
+        return PmmlModel.load(path)
+
+    def _predict_batch(self, batch):
+        # pypmml evaluates row-by-row (reference model.py does the same).
+        return [list(self._model.predict(list(row)).values())
+                for row in batch]

@@ -1,0 +1,4 @@
+from kfserving_tpu.predictors.sklearnserver.model import (  # noqa: F401
+    SKLearnModel,
+    SKLearnModelRepository,
+)

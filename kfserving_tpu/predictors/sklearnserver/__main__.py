@@ -1,0 +1,21 @@
+"""`python -m kfserving_tpu.predictors.sklearnserver` — args as the
+reference server (`--model_name --model_dir --http_port`, reference
+python/sklearnserver/sklearnserver/__main__.py:27-41)."""
+
+import argparse
+import logging
+
+from kfserving_tpu.predictors.sklearnserver.model import SKLearnModel
+from kfserving_tpu.server.app import ModelServer, parser as server_parser
+
+logging.basicConfig(level=logging.INFO)
+
+parser = argparse.ArgumentParser(parents=[server_parser])
+parser.add_argument("--model_name", default="model")
+parser.add_argument("--model_dir", required=True)
+
+if __name__ == "__main__":
+    args, _ = parser.parse_known_args()
+    model = SKLearnModel(args.model_name, args.model_dir)
+    model.load()
+    ModelServer(http_port=args.http_port).start([model])

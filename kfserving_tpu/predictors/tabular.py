@@ -1,0 +1,88 @@
+"""Shared base for tabular (CPU, per-batch numpy) predictors.
+
+The reference's sklearn/xgboost/lightgbm/pmml servers are near-identical
+~200-line packages (reference python/sklearnserver/sklearnserver/model.py,
+python/xgbserver/..., SURVEY.md §2.2): find the artifact in the model dir,
+load it with the framework, `np.array(instances)` -> predict.  Here that
+shape is one base class; each framework contributes artifact discovery and
+a batch-predict function.  They still serve through the same Model contract
+and V1/V2 routes as the TPU predictor.
+"""
+
+import glob
+import logging
+import os
+from typing import Any, List, Sequence
+
+import numpy as np
+
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.protocol import v1
+from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
+from kfserving_tpu.storage import Storage
+
+logger = logging.getLogger("kfserving_tpu.predictors.tabular")
+
+
+class TabularModel(Model):
+    """Base: download model_dir, locate an artifact by extension, load it
+    with the framework, serve V1 instances through batch predict."""
+
+    ARTIFACT_EXTENSIONS: Sequence[str] = ()
+
+    def __init__(self, name: str, model_dir: str):
+        super().__init__(name)
+        self.model_dir = model_dir
+        self._model = None
+
+    # -- framework hooks ---------------------------------------------------
+    def _load_artifact(self, path: str):
+        raise NotImplementedError
+
+    def _predict_batch(self, batch: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    # -- lifecycle ---------------------------------------------------------
+    def find_artifact(self, local_dir: str) -> str:
+        paths: List[str] = []
+        for ext in self.ARTIFACT_EXTENSIONS:
+            paths += glob.glob(os.path.join(local_dir, f"*{ext}"))
+        if len(paths) == 0:
+            raise InvalidInput(
+                f"no model artifact matching {list(self.ARTIFACT_EXTENSIONS)}"
+                f" under {local_dir}")
+        if len(paths) > 1:
+            # Reference behavior: exactly one model file per server dir
+            # (sklearnserver/model.py raises on ambiguity).
+            raise InvalidInput(
+                f"multiple model artifacts found: {sorted(paths)}")
+        return paths[0]
+
+    def load(self) -> bool:
+        local_dir = Storage.download(self.model_dir)
+        artifact = self.find_artifact(local_dir)
+        self._model = self._load_artifact(artifact)
+        logger.info("loaded %s from %s", self.name, artifact)
+        self.ready = True
+        return True
+
+    def unload(self) -> None:
+        self._model = None
+        self.ready = False
+
+    # -- inference ---------------------------------------------------------
+    async def predict(self, request: Any) -> Any:
+        if self.predictor_host:
+            return await super().predict(request)
+        if self._model is None:
+            raise InferenceError(f"model {self.name} not loaded")
+        instances = v1.get_instances(request)
+        try:
+            batch = np.asarray(instances)
+        except Exception as e:
+            raise InvalidInput(f"failed to build batch array: {e}")
+        try:
+            result = self._predict_batch(batch)
+        except Exception as e:
+            raise InferenceError(f"Failed to predict: {e}")
+        return v1.make_response(np.asarray(result).tolist())

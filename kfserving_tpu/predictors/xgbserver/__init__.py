@@ -1,0 +1,1 @@
+from kfserving_tpu.predictors.xgbserver.model import XGBoostModel  # noqa: F401

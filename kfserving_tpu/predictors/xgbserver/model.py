@@ -1,0 +1,26 @@
+"""xgboost predictor (reference python/xgbserver/xgbserver/model.py:
+booster load from .bst, DMatrix predict).  Import-gated: xgboost is not in
+the hermetic image; the module loads and errors helpfully without it."""
+
+from kfserving_tpu.predictors.tabular import TabularModel
+
+
+class XGBoostModel(TabularModel):
+    ARTIFACT_EXTENSIONS = (".bst", ".json", ".ubj")
+
+    def __init__(self, name: str, model_dir: str, nthread: int = 1):
+        super().__init__(name, model_dir)
+        self.nthread = nthread
+
+    def _load_artifact(self, path: str):
+        import xgboost as xgb
+
+        booster = xgb.Booster(params={"nthread": self.nthread},
+                              model_file=path)
+        return booster
+
+    def _predict_batch(self, batch):
+        import xgboost as xgb
+
+        dmatrix = xgb.DMatrix(batch, nthread=self.nthread)
+        return self._model.predict(dmatrix)

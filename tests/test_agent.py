@@ -351,3 +351,27 @@ async def test_mms_end_to_end_jax_repository(tmp_path):
         assert repo.get_model("m1") is None
     finally:
         await puller.stop()
+
+
+def test_parse_model_config_rejects_non_list():
+    with pytest.raises(ValueError, match="expected a JSON list"):
+        parse_model_config(b'{"modelName": "m"}')
+
+
+async def test_unload_never_loaded_model_is_noop():
+    class _EmptyRepo:
+        async def unload(self, name):
+            raise KeyError(name)
+
+    p = Puller(_EmptyRepo(), Downloader("/tmp/nonexistent-agent-test"))
+    await p.start()
+    try:
+        await p.events.put(("unload", "ghost", {}))
+        for _ in range(100):
+            if p.ops_ok:
+                break
+            await asyncio.sleep(0.01)
+        assert p.ops_ok == 1
+        assert p.ops_failed == 0
+    finally:
+        await p.stop()

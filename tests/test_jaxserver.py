@@ -191,3 +191,37 @@ def test_failed_admission_leaves_no_residue(tmp_path):
     assert not m.ready
     assert m.engine is None
     assert hbm.resident_models() == []
+
+
+def test_reload_failure_keeps_old_generation_serving(tmp_path):
+    """A failed reload (corrupt new checkpoint) must leave the previous
+    generation ready and serving, with HBM accounting intact."""
+    model_dir = _write_model_dir(tmp_path)
+    hbm = HBMManager(budget_bytes=10_000)
+    m = JaxModel("m", model_dir, hbm=hbm)
+    assert m.load()
+    old_engine = m.engine
+
+    # corrupt the checkpoint, then reload
+    with open(os.path.join(model_dir, "checkpoint.msgpack"), "wb") as f:
+        f.write(b"not msgpack")
+    with pytest.raises(Exception):
+        m.load()
+    assert m.ready
+    assert m.engine is old_engine
+    assert hbm.resident_models() == ["m"]
+
+    async def run():
+        return await m.predict({"instances": np.ones((1, 8)).tolist()})
+
+    assert len(asyncio.run(run())["predictions"]) == 1
+
+
+def test_reload_success_swaps_and_closes_old_engine(tmp_path):
+    model_dir = _write_model_dir(tmp_path)
+    m = JaxModel("m", model_dir)
+    assert m.load()
+    old_engine = m.engine
+    assert m.load()  # reload same artifact
+    assert m.engine is not old_engine
+    assert old_engine.params is None  # old generation freed

@@ -1,0 +1,164 @@
+"""Ecosystem predictor tests (reference per-server strategy, SURVEY.md §4:
+train a tiny local model in-process and assert predictions).  Framework
+servers whose library isn't in the hermetic image are import-gated and
+skipped, mirroring how the reference gates e2e tests on cluster deps."""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.predictors.sklearnserver import (
+    SKLearnModel,
+    SKLearnModelRepository,
+)
+
+
+def _train_iris_joblib(model_dir: str) -> None:
+    import joblib
+    from sklearn import datasets, svm
+
+    X, y = datasets.load_iris(return_X_y=True)
+    clf = svm.SVC(gamma="scale").fit(X, y)
+    joblib.dump(clf, os.path.join(model_dir, "model.joblib"))
+
+
+def test_sklearn_iris_parity(tmp_path):
+    """The reference e2e contract: sklearn-iris predicts [1, 1] for these
+    two instances (reference test/e2e/predictor/test_sklearn.py:68-70)."""
+    _train_iris_joblib(str(tmp_path))
+    m = SKLearnModel("sklearn-iris", str(tmp_path))
+    assert m.load()
+
+    async def run():
+        return await m.predict({"instances": [
+            [6.8, 2.8, 4.8, 1.4], [6.0, 3.4, 4.5, 1.6]]})
+
+    resp = asyncio.run(run())
+    assert resp == {"predictions": [1, 1]}
+
+
+def test_sklearn_pickle_artifact(tmp_path):
+    import pickle
+
+    from sklearn import datasets, svm
+
+    X, y = datasets.load_iris(return_X_y=True)
+    clf = svm.SVC(gamma="scale").fit(X, y)
+    with open(os.path.join(str(tmp_path), "model.pkl"), "wb") as f:
+        pickle.dump(clf, f)
+    m = SKLearnModel("m", str(tmp_path))
+    assert m.load()
+
+
+def test_artifact_discovery_errors(tmp_path):
+    m = SKLearnModel("m", str(tmp_path))
+    with pytest.raises(Exception, match="no model artifact"):
+        m.load()
+    # ambiguity is an error too
+    (tmp_path / "a.joblib").write_bytes(b"")
+    (tmp_path / "b.joblib").write_bytes(b"")
+    m2 = SKLearnModel("m2", str(tmp_path))
+    with pytest.raises(Exception, match="multiple model artifacts"):
+        m2.load()
+
+
+def test_sklearn_repository_load(tmp_path):
+    d = tmp_path / "iris"
+    d.mkdir()
+    _train_iris_joblib(str(d))
+    repo = SKLearnModelRepository(models_dir=str(tmp_path))
+
+    async def run():
+        assert await repo.load("iris")
+        assert repo.is_model_ready("iris")
+        assert not await repo.load("missing")
+
+    asyncio.run(run())
+
+
+def test_bad_instances_rejected(tmp_path):
+    _train_iris_joblib(str(tmp_path))
+    m = SKLearnModel("m", str(tmp_path))
+    m.load()
+
+    async def run():
+        with pytest.raises(Exception, match="to be a list"):
+            await m.predict({"instances": 5})
+
+    asyncio.run(run())
+
+
+@pytest.mark.skipif(
+    not pytest.importorskip("importlib").util.find_spec("xgboost"),
+    reason="xgboost not installed")
+def test_xgboost_model():  # pragma: no cover - gated on xgboost presence
+    pass
+
+
+def test_xgb_lgb_pmml_importable_without_libs():
+    """The server packages must import (and fail helpfully at load time)
+    even when their framework library is absent."""
+    from kfserving_tpu.predictors.lgbserver import LightGBMModel
+    from kfserving_tpu.predictors.pmmlserver import PMMLModel
+    from kfserving_tpu.predictors.xgbserver import XGBoostModel
+
+    for cls, ext in ((XGBoostModel, ".bst"), (LightGBMModel, ".txt"),
+                     (PMMLModel, ".pmml")):
+        assert ext in cls.ARTIFACT_EXTENSIONS
+
+
+# ---------------------------------------------------------------- explainer
+def test_saliency_explainer(tmp_path):
+    import json
+
+    from flax import serialization
+
+    from kfserving_tpu.explainers import SaliencyExplainer
+    from kfserving_tpu.models import create_model, init_params
+
+    model_dir = tmp_path / "m"
+    model_dir.mkdir()
+    ak = {"input_dim": 6, "features": [8], "num_classes": 3}
+    (model_dir / "config.json").write_text(json.dumps(
+        {"architecture": "mlp", "arch_kwargs": ak,
+         "max_latency_ms": 5, "warmup": False}))
+    spec = create_model("mlp", **ak)
+    (model_dir / "checkpoint.msgpack").write_bytes(
+        serialization.to_bytes(init_params(spec, seed=0)))
+
+    ex = SaliencyExplainer("m", str(model_dir))
+    assert ex.load()
+
+    async def run():
+        return await ex.explain(
+            {"instances": np.ones((2, 6)).tolist()})
+
+    resp = asyncio.run(run())
+    assert len(resp["explanations"]) == 2
+    sal = np.asarray(resp["explanations"][0]["saliency"])
+    assert sal.shape == (6,)
+    assert np.abs(sal).sum() > 0  # nonzero gradients
+
+
+# -------------------------------------------------------------- transformer
+def test_image_transformer_preprocess():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "examples"))
+    from image_transformer import ImageTransformer
+
+    t = ImageTransformer("t", predictor_host="predictor:80")
+
+    async def run():
+        out = await t.preprocess(
+            {"instances": [np.full((2, 2, 3), 255).tolist()]})
+        arr = np.asarray(out["instances"][0])
+        # 255 -> 1.0 -> (1 - mean)/std
+        expect = (1.0 - np.array([0.485, 0.456, 0.406])) / \
+            np.array([0.229, 0.224, 0.225])
+        np.testing.assert_allclose(arr[0, 0], expect, rtol=1e-5)
+
+    asyncio.run(run())
