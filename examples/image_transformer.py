@@ -36,8 +36,11 @@ class ImageTransformer(Model):
         instances = request.get("instances", [])
         out = []
         for inst in instances:
-            arr = np.asarray(inst, dtype=np.float32)
-            if arr.max() > 1.5:  # uint8-range pixels
+            raw = np.asarray(inst)
+            arr = raw.astype(np.float32)
+            if arr.size and np.issubdtype(raw.dtype, np.integer):
+                # Integer payloads are 0-255 pixel values; float payloads
+                # are taken as already scaled to [0, 1].
                 arr = arr / 255.0
             arr = (arr - MEAN) / STD
             out.append(arr.tolist())

@@ -40,6 +40,9 @@ def parse_model_config(raw: bytes) -> Dict[str, dict]:
             f"{type(entries).__name__}")
     out: Dict[str, dict] = {}
     for entry in entries:
+        if not isinstance(entry, dict):
+            logger.warning("skipping invalid model config entry: %r", entry)
+            continue
         name = entry.get("modelName")
         spec = entry.get("modelSpec")
         if not name or not isinstance(spec, dict) or \

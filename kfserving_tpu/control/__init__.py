@@ -1,0 +1,38 @@
+"""Control plane: declarative serving specs reconciled into running
+replicas, with routing, canary, autoscaling, and multi-model sharding.
+
+The reference's control plane is a Kubernetes operator (reference
+pkg/controller/v1beta1/inferenceservice/controller.go:68-161) that
+delegates actuation to Knative/Istio.  The TPU build keeps the same
+layering with explicit, swappable backends:
+
+- spec.py:        the InferenceService/TrainedModel schema (reference
+                  pkg/apis/serving/v1beta1/) plus TPU-only fields
+                  (mesh parallelism, HBM budget, shape buckets).
+- defaults.py:    defaulting webhook equivalent.
+- validation.py:  validating webhook equivalent.
+- modelconfig.py: models.json shard-config codec (reference
+                  pkg/modelconfig/configmap.go).
+- sharding.py:    HBM-aware bin-packing shard strategy — the reference's
+                  always-shard-0 stub made real (reference
+                  pkg/controller/v1alpha1/trainedmodel/sharding/memory/
+                  strategy.go:29-39).
+- reconciler.py:  spec -> desired replica set -> Orchestrator actuation,
+                  with revision tracking for canary (reference
+                  ksvc_reconciler.go:64-151).
+- router.py:      HTTP ingress: transformer->predictor chain,
+                  :predict/:explain split, canary weighted routing
+                  (reference ingress_reconciler.go:164-236).
+- autoscaler.py:  concurrency-based replica autoscaling with
+                  scale-to-zero (Knative KPA equivalent).
+"""
+
+from kfserving_tpu.control.spec import (  # noqa: F401
+    BatcherSpec,
+    ComponentSpec,
+    InferenceService,
+    LoggerSpec,
+    ParallelismSpec,
+    PredictorSpec,
+    TrainedModel,
+)

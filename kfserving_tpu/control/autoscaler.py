@@ -1,0 +1,96 @@
+"""Concurrency-based replica autoscaling (Knative KPA equivalent).
+
+Reference knobs (pkg/apis/serving/v1beta1/component.go:72-82 +
+ksvc_reconciler.go:70-83): min/max replicas and containerConcurrency; the
+KPA scales on observed concurrency per replica and supports scale-to-zero
+with activator buffering.
+
+This autoscaler samples the router's in-flight gauge each tick, averages
+over a sliding window, and converges each component to
+ceil(avg_concurrency / target_concurrency), clamped to [min, max].
+Scale-to-zero fires after `idle_ticks` windows of zero traffic when
+min_replicas == 0 (cold start is then the router's _activate path, which
+on TPU includes compile time — the persistent compile cache is what makes
+it tolerable, SURVEY.md §5.3).
+"""
+
+import asyncio
+import logging
+import math
+from collections import deque
+from typing import Dict
+
+logger = logging.getLogger("kfserving_tpu.control.autoscaler")
+
+DEFAULT_TARGET_CONCURRENCY = 4.0
+WINDOW_TICKS = 6
+IDLE_TICKS_TO_ZERO = 30
+
+
+class Autoscaler:
+    def __init__(self, controller, router,
+                 target_concurrency: float = DEFAULT_TARGET_CONCURRENCY,
+                 tick_seconds: float = 2.0):
+        self.controller = controller
+        self.router = router
+        self.target_concurrency = target_concurrency
+        self.tick_seconds = tick_seconds
+        self._windows: Dict[str, deque] = {}
+        self._idle: Dict[str, int] = {}
+        self._task = None
+
+    async def start(self):
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self):
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self):
+        while True:
+            try:
+                await self.tick()
+            except Exception:
+                logger.exception("autoscaler tick failed")
+            await asyncio.sleep(self.tick_seconds)
+
+    async def tick(self):
+        """One scaling evaluation (callable directly in tests)."""
+        for name, isvc in list(self.controller.specs.items()):
+            for cname, comp in isvc.components().items():
+                await self._scale_component(name, isvc, cname, comp)
+
+    async def _scale_component(self, name, isvc, cname, comp):
+        gauge_key = f"router/{isvc.name}"
+        inflight = self.router.inflight.get(gauge_key, 0)
+        window = self._windows.setdefault(
+            f"{name}/{cname}", deque(maxlen=WINDOW_TICKS))
+        window.append(inflight)
+        avg = sum(window) / len(window)
+        target = (comp.container_concurrency
+                  or self.target_concurrency)
+        desired = math.ceil(avg / target) if avg > 0 else 0
+        key = f"{name}/{cname}"
+        if desired == 0:
+            self._idle[key] = self._idle.get(key, 0) + 1
+            if comp.min_replicas == 0 and \
+                    self._idle[key] >= IDLE_TICKS_TO_ZERO:
+                await self.controller.reconciler.scale(isvc, cname, 0)
+                return
+            desired = max(comp.min_replicas, 0)
+            if desired == 0:
+                return  # stay as-is until idle threshold
+        else:
+            self._idle[key] = 0
+        current = len(self.controller.reconciler.orchestrator.replicas(
+            self.controller.reconciler.component_id(isvc, cname)))
+        clamped = max(comp.min_replicas, min(comp.max_replicas, desired))
+        if clamped != current and clamped > 0:
+            logger.info("scaling %s/%s %d -> %d (avg conc %.1f)",
+                        name, cname, current, clamped, avg)
+            await self.controller.reconciler.scale(isvc, cname, clamped)

@@ -1,0 +1,164 @@
+"""Actuation backends: turn desired replicas into running servers.
+
+The reference delegates actuation to Knative (the reconciler creates a
+Knative Service and Knative makes pods, reference
+ksvc_reconciler.go:153-187).  Here actuation is an interface with two
+backends:
+
+- InProcessOrchestrator: replicas are real ModelServer instances in this
+  process on ephemeral ports — the single-host deployment mode and the
+  test backend (the envtest analogue, SURVEY.md §4: real serving, no
+  cluster).
+- FakeOrchestrator: records desired state for pure reconciler-logic tests
+  (golden-object style, reference ingress_reconciler_test.go).
+
+A replica handle is (component_id, revision, host) — the router routes to
+hosts and never knows which backend made them.
+"""
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger("kfserving_tpu.control.orchestrator")
+
+
+@dataclass
+class Replica:
+    component_id: str      # "<namespace>/<isvc>/<component>"
+    revision: str          # content hash of the component spec
+    host: str              # "127.0.0.1:<port>" (in-process backend)
+    handle: object = None  # backend-private
+
+
+@dataclass
+class _ComponentState:
+    replicas: List[Replica] = field(default_factory=list)
+
+
+class FakeOrchestrator:
+    """Records desired replicas; hosts are synthetic."""
+
+    def __init__(self):
+        self.state: Dict[str, _ComponentState] = {}
+        self._port = 30000
+
+    def replicas(self, component_id: str) -> List[Replica]:
+        return list(self.state.get(component_id,
+                                   _ComponentState()).replicas)
+
+    async def create_replica(self, component_id: str, revision: str,
+                             spec) -> Replica:
+        self._port += 1
+        replica = Replica(component_id, revision,
+                          f"fake-host:{self._port}")
+        self.state.setdefault(component_id,
+                              _ComponentState()).replicas.append(replica)
+        return replica
+
+    async def delete_replica(self, replica: Replica) -> None:
+        comp = self.state.get(replica.component_id)
+        if comp and replica in comp.replicas:
+            comp.replicas.remove(replica)
+
+
+class InProcessOrchestrator:
+    """Replicas are ModelServers running in this process.
+
+    model_factory(component_id, spec) -> Model | None builds the served
+    model for a replica; the default factory understands the predictor
+    frameworks (jax/sklearn/...) and saliency explainers.  Loading runs in
+    a thread (compile/IO off the loop).
+    """
+
+    def __init__(self, model_factory: Optional[Callable] = None):
+        self.model_factory = model_factory or default_model_factory
+        self.state: Dict[str, _ComponentState] = {}
+
+    def replicas(self, component_id: str) -> List[Replica]:
+        return list(self.state.get(component_id,
+                                   _ComponentState()).replicas)
+
+    async def create_replica(self, component_id: str, revision: str,
+                             spec) -> Replica:
+        from kfserving_tpu.server.app import ModelServer
+
+        model = self.model_factory(component_id, spec)
+        if model is not None and not model.ready:
+            loop = asyncio.get_running_loop()
+            await loop.run_in_executor(None, model.load)
+        server = ModelServer(http_port=0, enable_docs=False)
+        await server.start_async([model] if model is not None else [],
+                                 host="127.0.0.1")
+        replica = Replica(component_id, revision,
+                          f"127.0.0.1:{server.http_port}", handle=server)
+        self.state.setdefault(component_id,
+                              _ComponentState()).replicas.append(replica)
+        logger.info("replica up: %s rev=%s at %s",
+                    component_id, revision[:8], replica.host)
+        return replica
+
+    async def delete_replica(self, replica: Replica) -> None:
+        comp = self.state.get(replica.component_id)
+        if comp and replica in comp.replicas:
+            comp.replicas.remove(replica)
+        server = replica.handle
+        if server is not None:
+            await server.stop_async()
+        logger.info("replica down: %s at %s",
+                    replica.component_id, replica.host)
+
+    async def shutdown(self):
+        for comp in list(self.state.values()):
+            for replica in list(comp.replicas):
+                await self.delete_replica(replica)
+
+
+def default_model_factory(component_id: str, spec):
+    """Build the served model for a component spec.
+
+    component kinds map to the reference's container images (SURVEY.md
+    §2.1 per-framework predictor specs); model name is the isvc name so
+    routes match /v1/models/<isvc>:predict.
+    """
+    from kfserving_tpu.control.spec import (
+        ExplainerSpec,
+        PredictorSpec,
+        TransformerSpec,
+    )
+
+    isvc_name = component_id.split("/")[1]
+    if isinstance(spec, PredictorSpec):
+        if spec.framework == "jax":
+            from kfserving_tpu.predictors.jax_model import JaxModel
+
+            return JaxModel(isvc_name, spec.storage_uri)
+        if spec.framework == "sklearn":
+            from kfserving_tpu.predictors.sklearnserver import SKLearnModel
+
+            return SKLearnModel(isvc_name, spec.storage_uri)
+        if spec.framework == "xgboost":
+            from kfserving_tpu.predictors.xgbserver import XGBoostModel
+
+            return XGBoostModel(isvc_name, spec.storage_uri)
+        if spec.framework == "lightgbm":
+            from kfserving_tpu.predictors.lgbserver import LightGBMModel
+
+            return LightGBMModel(isvc_name, spec.storage_uri)
+        if spec.framework == "pmml":
+            from kfserving_tpu.predictors.pmmlserver import PMMLModel
+
+            return PMMLModel(isvc_name, spec.storage_uri)
+        raise ValueError(
+            f"in-process orchestrator cannot run framework "
+            f"{spec.framework!r}")
+    if isinstance(spec, ExplainerSpec):
+        from kfserving_tpu.explainers import SaliencyExplainer
+
+        return SaliencyExplainer(isvc_name, spec.storage_uri)
+    if isinstance(spec, TransformerSpec):
+        raise ValueError(
+            "transformer replicas need a custom model_factory (their "
+            "preprocess code is user-supplied)")
+    raise ValueError(f"unknown component spec {type(spec).__name__}")

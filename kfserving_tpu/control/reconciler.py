@@ -1,0 +1,181 @@
+"""InferenceService reconciler: spec -> replicas + routes + status.
+
+Reference shape (pkg/controller/v1beta1/inferenceservice/
+controller.go:68-161): per-component reconcile, then ingress, then status
+conditions; canary is two revisions with a traffic split
+(ksvc_reconciler.go:84-118); status tracks previous-ready revision for
+rollback (inference_service_status.go:47-70).
+
+The TPU reconciler is the same loop without Kubernetes: revisions are
+content hashes of the component spec; the previous revision's replicas
+are kept alive while canary_traffic_percent routes a slice of traffic to
+the new one; promoting (canary=None) or rolling back (reverting the spec)
+garbage-collects the losing revision.
+"""
+
+import hashlib
+import json
+import logging
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+from kfserving_tpu.control.defaults import apply_defaults
+from kfserving_tpu.control.spec import ComponentSpec, InferenceService
+from kfserving_tpu.control.validation import validate
+
+logger = logging.getLogger("kfserving_tpu.control.reconciler")
+
+
+# Fields that configure traffic/scaling policy, not the served artifact:
+# changing them must not mint a new revision (Knative hashes the pod spec;
+# traffic split and autoscaling bounds live outside it).
+_POLICY_FIELDS = ("canary_traffic_percent", "min_replicas", "max_replicas")
+
+
+def revision_of(component: ComponentSpec) -> str:
+    """Content-addressed revision id (replaces Knative revision names)."""
+    d = asdict(component)
+    for f in _POLICY_FIELDS:
+        d.pop(f, None)
+    blob = json.dumps(d, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+@dataclass
+class TrafficTarget:
+    revision: str
+    percent: int
+    tag: str = ""  # "prev" for the canary's stable side
+
+
+@dataclass
+class ComponentStatus:
+    ready: bool = False
+    latest_revision: str = ""
+    previous_revision: str = ""
+    traffic: List[TrafficTarget] = field(default_factory=list)
+    replicas: int = 0
+
+
+@dataclass
+class IsvcStatus:
+    components: Dict[str, ComponentStatus] = field(default_factory=dict)
+    conditions: Dict[str, bool] = field(default_factory=dict)
+
+    @property
+    def ready(self) -> bool:
+        return bool(self.conditions) and all(self.conditions.values())
+
+
+class InferenceServiceReconciler:
+    def __init__(self, orchestrator):
+        self.orchestrator = orchestrator
+        self.status: Dict[str, IsvcStatus] = {}
+        # component_id -> revision -> replica list is derived from the
+        # orchestrator; we track the revision ring (latest, previous).
+        self._revisions: Dict[str, Dict[str, str]] = {}
+
+    @staticmethod
+    def component_id(isvc: InferenceService, component: str) -> str:
+        return f"{isvc.namespace}/{isvc.name}/{component}"
+
+    async def reconcile(self, isvc: InferenceService) -> IsvcStatus:
+        apply_defaults(isvc)
+        validate(isvc)
+        key = f"{isvc.namespace}/{isvc.name}"
+        status = self.status.setdefault(key, IsvcStatus())
+
+        for cname, comp in isvc.components().items():
+            cstatus = status.components.setdefault(cname, ComponentStatus())
+            await self._reconcile_component(isvc, cname, comp, cstatus)
+            status.conditions[f"{cname}Ready"] = cstatus.ready
+        # Drop components removed from the spec.
+        for gone in set(status.components) - set(isvc.components()):
+            await self._scale_revisions(
+                self.component_id(isvc, gone), {}, None)
+            del status.components[gone]
+            status.conditions.pop(f"{gone}Ready", None)
+        return status
+
+    async def delete(self, isvc: InferenceService) -> None:
+        """Finalizer: tear down all components (reference
+        controller.go:208-223 deletes child resources)."""
+        for cname in list(isvc.components()):
+            await self._scale_revisions(
+                self.component_id(isvc, cname), {}, None)
+        self.status.pop(f"{isvc.namespace}/{isvc.name}", None)
+
+    # -- internals ---------------------------------------------------------
+    async def _reconcile_component(self, isvc: InferenceService,
+                                   cname: str, comp: ComponentSpec,
+                                   cstatus: ComponentStatus) -> None:
+        cid = self.component_id(isvc, cname)
+        new_rev = revision_of(comp)
+        revs = self._revisions.setdefault(cid, {})
+
+        if cstatus.latest_revision and cstatus.latest_revision != new_rev:
+            cstatus.previous_revision = cstatus.latest_revision
+        cstatus.latest_revision = new_rev
+
+        canary = comp.canary_traffic_percent
+        desired: Dict[str, int] = {new_rev: max(comp.min_replicas, 1)
+                                   if comp.min_replicas > 0 or canary
+                                   is not None else comp.min_replicas}
+        if canary is not None and cstatus.previous_revision and \
+                cstatus.previous_revision != new_rev:
+            # Canary: previous revision keeps serving (reference keeps the
+            # `prev` TrafficTarget, ksvc_reconciler.go:92-118).
+            desired[cstatus.previous_revision] = max(comp.min_replicas, 1)
+            cstatus.traffic = [
+                TrafficTarget(new_rev, canary),
+                TrafficTarget(cstatus.previous_revision, 100 - canary,
+                              tag="prev"),
+            ]
+        else:
+            cstatus.traffic = [TrafficTarget(new_rev, 100)]
+            if canary is None:
+                cstatus.previous_revision = ""
+
+        await self._scale_revisions(cid, desired, comp)
+        revs.clear()
+        revs.update({rev: rev for rev in desired})
+        replicas = self.orchestrator.replicas(cid)
+        cstatus.replicas = len(replicas)
+        cstatus.ready = all(
+            desired.get(rev, 0) <= sum(
+                1 for r in replicas if r.revision == rev)
+            for rev in desired) and cstatus.replicas > 0
+
+    async def _scale_revisions(self, cid: str,
+                               desired: Dict[str, int],
+                               comp: Optional[ComponentSpec]) -> None:
+        """Converge the orchestrator's replicas to `desired` (rev->count)."""
+        current = self.orchestrator.replicas(cid)
+        by_rev: Dict[str, List] = {}
+        for r in current:
+            by_rev.setdefault(r.revision, []).append(r)
+        # scale down / remove dead revisions
+        for rev, replicas in by_rev.items():
+            want = desired.get(rev, 0)
+            for replica in replicas[want:]:
+                await self.orchestrator.delete_replica(replica)
+        # scale up
+        for rev, want in desired.items():
+            have = len(by_rev.get(rev, []))
+            for _ in range(max(0, want - have)):
+                await self.orchestrator.create_replica(cid, rev, comp)
+
+    async def scale(self, isvc: InferenceService, cname: str,
+                    replicas: int) -> None:
+        """Autoscaler entry: resize the latest revision within bounds."""
+        comp = isvc.components()[cname]
+        replicas = max(comp.min_replicas,
+                       min(comp.max_replicas, replicas))
+        cid = self.component_id(isvc, cname)
+        key = f"{isvc.namespace}/{isvc.name}"
+        cstatus = self.status[key].components[cname]
+        desired = {t.revision: replicas for t in cstatus.traffic
+                   if t.percent > 0}
+        # revisions with zero traffic keep zero replicas
+        await self._scale_revisions(cid, desired, comp)
+        cstatus.replicas = len(self.orchestrator.replicas(cid))

@@ -1,0 +1,188 @@
+"""Ingress router: the Istio-VirtualService + activator equivalent.
+
+Reference routing rules (pkg/controller/v1beta1/inferenceservice/
+reconcilers/ingress/ingress_reconciler.go:164-236): top-level traffic goes
+to the transformer when one exists, else the predictor; `:explain` paths
+go to the explainer; canary splits ride weighted revision targets.  The
+activator role (buffer + scale-from-zero, reference
+test/benchmark/README.md:14-17) lives here too: a request for a
+zero-replica component triggers scale-up and waits for readiness.
+
+One router fronts many InferenceServices; services are addressed by model
+name (the isvc name), matching the reference's host-regex authority match
+reduced to its observable effect.
+"""
+
+import asyncio
+import itertools
+import logging
+import random
+from typing import Dict, Optional, Tuple
+
+from kfserving_tpu.server.http import HTTPServer, Request, Response, Router
+
+logger = logging.getLogger("kfserving_tpu.control.router")
+
+ACTIVATOR_TIMEOUT_S = 60.0
+
+
+class IngressRouter:
+    def __init__(self, controller, http_port: int = 0, seed: int = 0):
+        self.controller = controller  # Controller (store + reconciler)
+        self.http_port = http_port
+        self._rng = random.Random(seed)
+        self._rr = {}  # component_id -> round-robin counter
+        self.router = Router()
+        self._register_routes()
+        self.http_server = HTTPServer(self.router)
+        self._session = None
+        self.inflight: Dict[str, int] = {}  # component_id -> gauge
+        self.request_count: Dict[str, int] = {}
+
+    # -- routes ------------------------------------------------------------
+    def _register_routes(self):
+        r = self.router
+        r.add("POST", "/v1/models/{name}:predict", self._predict)
+        r.add("POST", "/v1/models/{name}:explain", self._explain)
+        r.add("POST", "/v2/models/{name}/infer", self._predict)
+        r.add("POST", "/v2/models/{name}/explain", self._explain)
+        r.add("GET", "/v1/models/{name}", self._health)
+        # Direct-to-predictor lane for transformer->predictor hops (the
+        # reference's cluster-local gateway, constants.go:121-127).
+        r.add("POST", "/direct/predictor/v1/models/{name}:predict",
+              self._predict_direct)
+        r.add("POST", "/direct/predictor/v2/models/{name}/infer",
+              self._predict_direct)
+
+    async def start_async(self, host: str = "127.0.0.1"):
+        import aiohttp
+
+        self._session = aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=ACTIVATOR_TIMEOUT_S))
+        await self.http_server.start(host, self.http_port)
+        self.http_port = self.http_server.port
+
+    async def stop_async(self):
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+        await self.http_server.stop()
+
+    # -- routing core ------------------------------------------------------
+    def _entry_component(self, isvc, verb: str) -> str:
+        if verb == "explain":
+            if isvc.explainer is not None:
+                return "explainer"
+            return "predictor"
+        if isvc.transformer is not None:
+            return "transformer"
+        return "predictor"
+
+    def _pick_revision(self, cstatus) -> Optional[str]:
+        targets = [t for t in cstatus.traffic if t.percent > 0]
+        if not targets:
+            return None
+        roll = self._rng.uniform(0, 100)
+        acc = 0.0
+        for t in targets:
+            acc += t.percent
+            if roll <= acc:
+                return t.revision
+        return targets[-1].revision
+
+    def _pick_replica(self, cid: str, revision: str) -> Optional[str]:
+        replicas = [r for r in
+                    self.controller.reconciler.orchestrator.replicas(cid)
+                    if r.revision == revision]
+        if not replicas:
+            return None
+        idx = self._rr.get(cid, 0)
+        self._rr[cid] = idx + 1
+        return replicas[idx % len(replicas)].host
+
+    async def _resolve(self, name: str, verb: str,
+                       component: Optional[str] = None
+                       ) -> Tuple[Optional[str], Optional[str]]:
+        """Returns (host, error)."""
+        isvc = self.controller.get(name)
+        if isvc is None:
+            return None, f"inference service {name} not found"
+        cname = component or self._entry_component(isvc, verb)
+        key = f"{isvc.namespace}/{isvc.name}"
+        status = self.controller.reconciler.status.get(key)
+        cstatus = status.components.get(cname) if status else None
+        if cstatus is None:
+            return None, f"component {cname} of {name} not reconciled"
+        revision = self._pick_revision(cstatus)
+        if revision is None:
+            return None, f"no traffic targets for {name}/{cname}"
+        cid = self.controller.reconciler.component_id(isvc, cname)
+        host = self._pick_replica(cid, revision)
+        if host is None:
+            host = await self._activate(isvc, cname, cid, revision)
+            if host is None:
+                return None, f"no replicas for {name}/{cname}"
+        return host, None
+
+    async def _activate(self, isvc, cname: str, cid: str,
+                        revision: str) -> Optional[str]:
+        """Scale-from-zero: bring up one replica and wait (activator
+        buffering)."""
+        logger.info("activating %s (scale from zero)", cid)
+        await self.controller.reconciler.scale(isvc, cname, 1)
+        for _ in range(600):
+            host = self._pick_replica(cid, revision)
+            if host is not None:
+                return host
+            await asyncio.sleep(0.1)
+        return None
+
+    # -- handlers ----------------------------------------------------------
+    async def _predict(self, req: Request) -> Response:
+        return await self._proxy(req, "predict")
+
+    async def _explain(self, req: Request) -> Response:
+        return await self._proxy(req, "explain")
+
+    async def _predict_direct(self, req: Request) -> Response:
+        return await self._proxy(req, "predict", component="predictor",
+                                 strip_prefix="/direct/predictor")
+
+    async def _health(self, req: Request) -> Response:
+        return await self._proxy(req, "health")
+
+    async def _proxy(self, req: Request, verb: str,
+                     component: Optional[str] = None,
+                     strip_prefix: str = "") -> Response:
+        name = req.path_params["name"]
+        host, err = await self._resolve(name, verb, component)
+        if err is not None:
+            return Response(
+                body=f'{{"error": "{err}"}}'.encode(), status=404)
+        path = req.path
+        if strip_prefix and path.startswith(strip_prefix):
+            path = path[len(strip_prefix):]
+        url = f"http://{host}{path}"
+        cid = f"router/{name}"
+        self.inflight[cid] = self.inflight.get(cid, 0) + 1
+        self.request_count[cid] = self.request_count.get(cid, 0) + 1
+        try:
+            headers = {k: v for k, v in req.headers.items()
+                       if k.lower() not in ("host", "content-length",
+                                            "connection")}
+            async with self._session.request(
+                    req.method, url, data=req.body or None,
+                    headers=headers) as upstream:
+                body = await upstream.read()
+                resp_headers = {
+                    k: v for k, v in upstream.headers.items()
+                    if k.lower() in ("content-type",) or
+                    k.lower().startswith("ce-")}
+                return Response(body=body, status=upstream.status,
+                                headers=resp_headers)
+        except Exception as e:
+            logger.warning("proxy to %s failed: %s", url, e)
+            return Response(
+                body=b'{"error": "upstream unavailable"}', status=503)
+        finally:
+            self.inflight[cid] -= 1

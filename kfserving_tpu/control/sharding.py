@@ -1,0 +1,115 @@
+"""HBM-aware shard assignment for multi-model serving.
+
+The reference's strategy interface has exactly one implementation: a stub
+that puts every model on shard 0 (reference pkg/controller/v1alpha1/
+trainedmodel/sharding/memory/strategy.go:29-39), with the TrainedModel's
+declared Memory unused.  SURVEY.md §7 names real HBM-aware sharding a
+north-star item; this is it:
+
+- each shard is one predictor replica-set with an HBM budget (chip HBM x
+  chips_per_replica minus runtime headroom);
+- placement is first-fit-decreasing bin packing on declared memory_bytes —
+  FFD is within 22% of optimal and, more importantly here, deterministic
+  and stable under incremental adds;
+- existing placements are sticky (a re-reconcile never migrates a model
+  that still fits), because moving a model = recompiling its executables.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kfserving_tpu.control.spec import TrainedModel
+
+
+class ShardingError(ValueError):
+    pass
+
+
+@dataclass
+class Shard:
+    index: int
+    budget_bytes: int
+    models: Dict[str, int] = field(default_factory=dict)  # name -> bytes
+
+    @property
+    def used_bytes(self) -> int:
+        return sum(self.models.values())
+
+    @property
+    def free_bytes(self) -> int:
+        return self.budget_bytes - self.used_bytes
+
+
+class HBMShardStrategy:
+    """Assign TrainedModels to shards within an HBM budget per shard.
+
+    max_shards bounds the fleet (a shard is a whole serving replica-set);
+    growing past it raises, mirroring the admission error a user sees when
+    a TrainedModel can't fit (reference surfaces this via the TrainedModel
+    Ready condition)."""
+
+    def __init__(self, shard_budget_bytes: int, max_shards: int = 8):
+        if shard_budget_bytes <= 0:
+            raise ValueError("shard_budget_bytes must be > 0")
+        self.shard_budget_bytes = shard_budget_bytes
+        self.max_shards = max_shards
+        self.shards: List[Shard] = []
+        self._placement: Dict[str, int] = {}
+
+    # -- queries -----------------------------------------------------------
+    def get_shard(self, model_name: str) -> Optional[int]:
+        return self._placement.get(model_name)
+
+    def models_on(self, shard_index: int) -> List[str]:
+        return sorted(self.shards[shard_index].models)
+
+    # -- assignment --------------------------------------------------------
+    def get_or_assign(self, tm: TrainedModel) -> int:
+        """Sticky first-fit: an existing placement is kept; a new model
+        goes to the first shard with room, else a new shard."""
+        existing = self._placement.get(tm.name)
+        if existing is not None:
+            shard = self.shards[existing]
+            old = shard.models[tm.name]
+            if tm.memory_bytes <= shard.free_bytes + old:
+                shard.models[tm.name] = tm.memory_bytes
+                return existing
+            # grew past its shard: remove and re-place
+            del shard.models[tm.name]
+            del self._placement[tm.name]
+        if tm.memory_bytes > self.shard_budget_bytes:
+            raise ShardingError(
+                f"model {tm.name} declares {tm.memory_bytes} bytes; a "
+                f"shard holds {self.shard_budget_bytes}")
+        for shard in self.shards:
+            if tm.memory_bytes <= shard.free_bytes:
+                shard.models[tm.name] = tm.memory_bytes
+                self._placement[tm.name] = shard.index
+                return shard.index
+        if len(self.shards) >= self.max_shards:
+            raise ShardingError(
+                f"model {tm.name} does not fit in any of "
+                f"{self.max_shards} shards")
+        shard = Shard(index=len(self.shards),
+                      budget_bytes=self.shard_budget_bytes)
+        shard.models[tm.name] = tm.memory_bytes
+        self.shards.append(shard)
+        self._placement[tm.name] = shard.index
+        return shard.index
+
+    def remove(self, model_name: str) -> Optional[int]:
+        idx = self._placement.pop(model_name, None)
+        if idx is not None:
+            self.shards[idx].models.pop(model_name, None)
+        return idx
+
+    def pack(self, models: List[TrainedModel]) -> Dict[str, int]:
+        """Batch placement, first-fit-decreasing (initial reconcile)."""
+        for tm in sorted(models, key=lambda m: -m.memory_bytes):
+            self.get_or_assign(tm)
+        return dict(self._placement)
+
+    def stats(self) -> List[dict]:
+        return [{"shard": s.index, "used": s.used_bytes,
+                 "free": s.free_bytes, "models": len(s.models)}
+                for s in self.shards]

@@ -1,0 +1,176 @@
+"""InferenceService / TrainedModel spec schema.
+
+Re-expresses the reference CRD types (reference
+pkg/apis/serving/v1beta1/inference_service.go:24-36 — Predictor required,
+Transformer/Explainer optional; component extension knobs
+component.go:72-95; per-framework one-of predictor.go:33-59) as plain
+dataclasses serializable to/from JSON/YAML-shaped dicts.
+
+TPU-first additions, absent in the reference because it never touched
+model internals (SURVEY.md §2.3):
+- ParallelismSpec (dp/tp/sp mesh axes per replica);
+- hbm_budget_bytes on the predictor (multi-model admission);
+- batcher.max_latency_ms at millisecond granularity and shape buckets.
+"""
+
+import re
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+# Frameworks the predictor one-of accepts (reference predictor.go:33-59
+# lists 8 + custom; 'jax' is the TPU-native addition replacing pytorch/
+# triton/tfserving — those artifacts convert offline).
+PREDICTOR_FRAMEWORKS = (
+    "jax", "sklearn", "xgboost", "lightgbm", "pmml", "custom")
+
+NAME_REGEX = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")  # k8s DNS-1035
+STORAGE_URI_PREFIXES = (
+    "gs://", "s3://", "file://", "http://", "https://", "pvc://", "/")
+
+
+@dataclass
+class LoggerSpec:
+    """Payload logging (reference inference_service.go:53-64)."""
+
+    url: str = ""
+    mode: str = "all"  # all | request | response
+
+
+@dataclass
+class BatcherSpec:
+    """Dynamic batching (reference inference_service.go:66-77; TPU adds
+    millisecond deadlines — the reference floor was whole seconds)."""
+
+    max_batch_size: int = 32
+    max_latency_ms: float = 5.0
+
+
+@dataclass
+class ParallelismSpec:
+    """Within-replica mesh (TPU-native; reference has no counterpart)."""
+
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def chips_per_replica(self) -> int:
+        return self.dp * self.tp * self.sp
+
+
+@dataclass
+class ComponentSpec:
+    """Shared component knobs (reference component.go:72-95)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    container_concurrency: int = 0  # 0 = unlimited
+    timeout_seconds: int = 300
+    canary_traffic_percent: Optional[int] = None
+    logger: Optional[LoggerSpec] = None
+    batcher: Optional[BatcherSpec] = None
+
+
+@dataclass
+class PredictorSpec(ComponentSpec):
+    """Exactly one framework must be set (reference predictor.go:33-59 +
+    validation component.go:109-141)."""
+
+    framework: str = "jax"
+    storage_uri: str = ""
+    runtime_version: str = ""
+    protocol_version: str = "v1"
+    multi_model: bool = False
+    parallelism: ParallelismSpec = field(default_factory=ParallelismSpec)
+    hbm_budget_bytes: Optional[int] = None
+    # custom framework: explicit command to exec
+    command: Optional[List[str]] = None
+
+
+@dataclass
+class TransformerSpec(ComponentSpec):
+    command: Optional[List[str]] = None
+    storage_uri: str = ""
+
+
+@dataclass
+class ExplainerSpec(ComponentSpec):
+    explainer_type: str = "saliency"  # saliency | blackbox | custom
+    storage_uri: str = ""
+    command: Optional[List[str]] = None
+
+
+@dataclass
+class InferenceService:
+    """Top level (reference inference_service.go:24-36)."""
+
+    name: str
+    namespace: str = "default"
+    predictor: PredictorSpec = field(default_factory=PredictorSpec)
+    transformer: Optional[TransformerSpec] = None
+    explainer: Optional[ExplainerSpec] = None
+    annotations: Dict[str, str] = field(default_factory=dict)
+    generation: int = 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "InferenceService":
+        d = dict(d)
+        pred = d.get("predictor") or {}
+        if "parallelism" in pred and isinstance(pred["parallelism"], dict):
+            pred["parallelism"] = ParallelismSpec(**pred["parallelism"])
+        for key in ("logger", "batcher"):
+            if pred.get(key) and isinstance(pred[key], dict):
+                pred[key] = (LoggerSpec if key == "logger"
+                             else BatcherSpec)(**pred[key])
+        d["predictor"] = PredictorSpec(**pred)
+        if d.get("transformer") and isinstance(d["transformer"], dict):
+            d["transformer"] = TransformerSpec(**_coerce_component(
+                d["transformer"]))
+        if d.get("explainer") and isinstance(d["explainer"], dict):
+            d["explainer"] = ExplainerSpec(**_coerce_component(
+                d["explainer"]))
+        return cls(**d)
+
+    def components(self) -> Dict[str, ComponentSpec]:
+        out: Dict[str, ComponentSpec] = {"predictor": self.predictor}
+        if self.transformer is not None:
+            out["transformer"] = self.transformer
+        if self.explainer is not None:
+            out["explainer"] = self.explainer
+        return out
+
+
+def _coerce_component(d: Dict[str, Any]) -> Dict[str, Any]:
+    d = dict(d)
+    for key in ("logger", "batcher"):
+        if d.get(key) and isinstance(d[key], dict):
+            d[key] = (LoggerSpec if key == "logger"
+                      else BatcherSpec)(**d[key])
+    return d
+
+
+@dataclass
+class TrainedModel:
+    """Per-model CR for multi-model serving (reference
+    pkg/apis/serving/v1alpha1/trained_model.go:49-70)."""
+
+    name: str
+    inference_service: str
+    storage_uri: str
+    framework: str = "jax"
+    memory_bytes: int = 0  # declared footprint; feeds sharding + HBM
+    namespace: str = "default"
+
+    def to_model_spec(self) -> Dict[str, Any]:
+        """models.json entry (reference modelconfig/configmap.go:34-51)."""
+        return {
+            "modelName": self.name,
+            "modelSpec": {
+                "storageUri": self.storage_uri,
+                "framework": self.framework,
+                "memory": self.memory_bytes,
+            },
+        }

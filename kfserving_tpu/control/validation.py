@@ -1,0 +1,87 @@
+"""Validation: the validating-webhook equivalent.
+
+Reference rules (pkg/apis/serving/v1beta1/
+inference_service_validation.go:46-82 + component.go:109-176): DNS-1035
+name, exactly one predictor implementation, storage URI prefix whitelist,
+replica/concurrency bounds, logger mode enum.  TPU adds mesh-axis and
+bucket sanity.
+"""
+
+from typing import List
+
+from kfserving_tpu.control.spec import (
+    NAME_REGEX,
+    PREDICTOR_FRAMEWORKS,
+    STORAGE_URI_PREFIXES,
+    InferenceService,
+    TrainedModel,
+)
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def validate(isvc: InferenceService) -> None:
+    errors: List[str] = []
+    if not NAME_REGEX.match(isvc.name or ""):
+        errors.append(
+            f"name {isvc.name!r} must match {NAME_REGEX.pattern}")
+    pred = isvc.predictor
+    if pred.framework not in PREDICTOR_FRAMEWORKS:
+        errors.append(
+            f"predictor.framework {pred.framework!r} must be one of "
+            f"{PREDICTOR_FRAMEWORKS}")
+    if pred.framework == "custom":
+        if not pred.command:
+            errors.append("custom predictor requires command")
+    elif not pred.storage_uri and not pred.multi_model:
+        errors.append("predictor.storage_uri is required "
+                      "(non-multi-model)")
+    if pred.storage_uri and not pred.storage_uri.startswith(
+            tuple(STORAGE_URI_PREFIXES)):
+        errors.append(
+            f"storage_uri {pred.storage_uri!r} must start with one of "
+            f"{STORAGE_URI_PREFIXES}")
+    for cname, comp in isvc.components().items():
+        if comp.min_replicas < 0:
+            errors.append(f"{cname}.min_replicas must be >= 0")
+        if comp.max_replicas < comp.min_replicas:
+            errors.append(
+                f"{cname}.max_replicas must be >= min_replicas")
+        if comp.container_concurrency < 0:
+            errors.append(f"{cname}.container_concurrency must be >= 0")
+        if comp.canary_traffic_percent is not None and not (
+                0 <= comp.canary_traffic_percent <= 100):
+            errors.append(
+                f"{cname}.canary_traffic_percent must be in [0, 100]")
+        if comp.logger is not None and comp.logger.mode not in (
+                "all", "request", "response"):
+            errors.append(
+                f"{cname}.logger.mode must be all|request|response")
+        if comp.batcher is not None:
+            if comp.batcher.max_batch_size <= 0:
+                errors.append(f"{cname}.batcher.max_batch_size must be > 0")
+            if comp.batcher.max_latency_ms <= 0:
+                errors.append(f"{cname}.batcher.max_latency_ms must be > 0")
+    par = pred.parallelism
+    if par is not None and (par.dp < 1 or par.tp < 1 or par.sp < 1):
+        errors.append("parallelism axes must be >= 1")
+    if errors:
+        raise ValidationError("; ".join(errors))
+
+
+def validate_trained_model(tm: TrainedModel) -> None:
+    errors: List[str] = []
+    if not NAME_REGEX.match(tm.name or ""):
+        errors.append(f"name {tm.name!r} must match {NAME_REGEX.pattern}")
+    if not tm.inference_service:
+        errors.append("inference_service is required")
+    if not tm.storage_uri.startswith(tuple(STORAGE_URI_PREFIXES)):
+        errors.append(
+            f"storage_uri {tm.storage_uri!r} must start with one of "
+            f"{STORAGE_URI_PREFIXES}")
+    if tm.memory_bytes < 0:
+        errors.append("memory_bytes must be >= 0")
+    if errors:
+        raise ValidationError("; ".join(errors))

@@ -6,17 +6,20 @@ Runs as one jitted program on the same device as the model — contrast the
 reference's explainer pods, which POST thousands of perturbed samples to
 the predictor over HTTP (reference alibiexplainer/explainer.py:39-100).
 
-Serves either:
-- co-located: constructed over a loaded JaxModel's spec/params; or
-- standalone explainer pod: constructed with its own model_dir copy
-  (the reference's explainer downloads the same storageUri).
+Deployment shapes (reference ingress splits :explain to the explainer,
+ingress_reconciler.go:219+):
+- SaliencyExplainer: downloads the same storageUri as the predictor and
+  differentiates through the model locally (white box).
+- BlackBoxExplainer: owns no artifact; perturbs inputs and scores them
+  against predictor_host over HTTP (the reference explainer shape).
 """
 
 import logging
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import numpy as np
 
+from kfserving_tpu.model.model import PREDICTOR_URL_FORMAT, Model
 from kfserving_tpu.predictors.jax_model import JaxModel
 from kfserving_tpu.protocol import v1
 from kfserving_tpu.protocol.errors import InferenceError
@@ -25,7 +28,10 @@ logger = logging.getLogger("kfserving_tpu.explainers")
 
 
 class SaliencyExplainer(JaxModel):
-    """JaxModel whose explain() returns input-gradient saliency maps."""
+    """JaxModel whose explain() returns input-gradient saliency maps.
+
+    Differentiates through the raw logits (`_base_apply`), not the serving
+    output mode — argmax/topk-configured models explain identically."""
 
     def __init__(self, name: str, model_dir: str, **kwargs):
         super().__init__(name, model_dir, **kwargs)
@@ -38,15 +44,14 @@ class SaliencyExplainer(JaxModel):
         import jax
         import jax.numpy as jnp
 
-        engine = self.engine
-        params = engine.params
-        base = engine._jitted  # serve_fn(params, batch)
+        params = self.engine.params
+        base_apply = self._base_apply
+        scale = self.config.scale
 
         def winning_logit_sum(x):
-            out = base(params, x)
-            # output modes: logits [B, C] (or [B, L, C]); reduce to the
-            # winning class per instance and sum over batch for one grad.
-            logits = out if not isinstance(out, dict) else out["values"]
+            if scale is not None:
+                x = x * scale  # same on-device input scaling as serving
+            logits = base_apply(params, x)
             winners = jnp.max(logits, axis=-1)
             return jnp.sum(winners)
 
@@ -54,8 +59,6 @@ class SaliencyExplainer(JaxModel):
         return ok
 
     async def explain(self, request: Any) -> Any:
-        if self.predictor_host:
-            return await super().explain(request)
         if self._saliency_fn is None:
             raise InferenceError(f"explainer {self.name} not loaded")
         instances = v1.get_instances(request)
@@ -78,20 +81,18 @@ class SaliencyExplainer(JaxModel):
         return meta
 
 
-class BlackBoxExplainer(JaxModel):
+class BlackBoxExplainer(Model):
     """Parity shape with the reference explainer pods: explain() perturbs
     inputs locally and scores them against predictor_host over HTTP
     (reference explainer_wrapper.py _predict_fn pattern).  Feature
-    importance = prediction flip rate under feature masking."""
+    importance = prediction flip rate under Gaussian feature jitter
+    (noise-based so single-instance requests perturb too)."""
 
     def __init__(self, name: str, num_samples: int = 32,
-                 seed: int = 0):
-        # Deliberately not calling JaxModel.__init__ loading machinery:
-        # black-box explainers own no model artifact.
-        from kfserving_tpu.model.model import Model
-
-        Model.__init__(self, name)
+                 noise_scale: float = 1.0, seed: int = 0):
+        super().__init__(name)
         self.num_samples = num_samples
+        self.noise_scale = noise_scale
         self.seed = seed
 
     def load(self) -> bool:
@@ -107,23 +108,31 @@ class BlackBoxExplainer(JaxModel):
         base = await self._remote_predict(batch)
         rng = np.random.default_rng(self.seed)
         n_features = batch.shape[1]
+        # Perturbation scale per feature: column std across the batch when
+        # informative, else noise_scale (handles batch == 1).
+        stds = batch.std(axis=0)
+        stds = np.where(stds > 0, stds, self.noise_scale)
         importance = np.zeros((batch.shape[0], n_features))
         for f in range(n_features):
             flips = np.zeros(batch.shape[0])
             for _ in range(self.num_samples):
                 perturbed = batch.copy()
-                perturbed[:, f] = rng.permutation(perturbed[:, f])
+                perturbed[:, f] += rng.normal(
+                    0.0, stds[f], size=batch.shape[0])
                 pred = await self._remote_predict(perturbed)
                 flips += (np.asarray(pred) != np.asarray(base)).reshape(
                     batch.shape[0], -1).any(axis=1)
             importance[:, f] = flips / self.num_samples
         return {"explanations": [
             {"feature_importance": imp.tolist(),
-             "method": "permutation_flip_rate"} for imp in importance]}
+             "method": "noise_flip_rate"} for imp in importance]}
+
+    def metadata(self) -> Dict[str, Any]:
+        meta = super().metadata()
+        meta["explainer"] = "noise_flip_rate"
+        return meta
 
     async def _remote_predict(self, batch: np.ndarray):
-        from kfserving_tpu.model.model import PREDICTOR_URL_FORMAT
-
         url = PREDICTOR_URL_FORMAT.format(self.predictor_host, self.name)
         resp = await self._proxy(url, {"instances": batch.tolist()})
         return resp["predictions"]

@@ -118,30 +118,46 @@ class JaxModel(Model):
         spec = create_model(cfg.architecture, **cfg.arch_kwargs)
 
         # Reload is transactional: the new engine/batcher are built aside
-        # and swapped in only on success.  A failed reload leaves the old
-        # generation serving (and restores its HBM accounting); a failed
-        # first load leaves the model not-ready with nothing allocated.
-        old_engine, old_batcher = self.engine, self.batcher
-
-        # HBM admission BEFORE any device allocation: size the params with
-        # eval_shape (no buffers), admit/evict against the budget, and only
-        # then materialize.  A failed admit leaves the device untouched.
+        # and swapped in only on success.  During a reload, BOTH
+        # generations are physically resident until the swap, so the new
+        # one is admitted under a staging key alongside the old entry
+        # (zero-downtime path).  When HBM has no headroom for both, fall
+        # back to stop-the-world: close the old generation first, then
+        # admit and build (downtime, but never device overcommit).
+        old_engine = self.engine
+        staging_key = f"{self.name}!staging"  # '!' excluded from names
+        zero_downtime = True
         if self.hbm is not None:
             import jax
+
+            from kfserving_tpu.engine.hbm import InsufficientHBM
 
             abstract = jax.eval_shape(lambda: init_params(spec, seed=0))
             nbytes = sum(
                 int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
                 for leaf in jax.tree.leaves(abstract))
-            self.hbm.admit(self.name, nbytes)
+            if old_engine is None:
+                self.hbm.admit(self.name, nbytes)
+            else:
+                try:
+                    self.hbm.admit(staging_key, nbytes)
+                except InsufficientHBM:
+                    zero_downtime = False
+                    self.ready = False
+                    self.engine, self.batcher = None, None
+                    old_engine.close()
+                    old_engine = None
+                    self.hbm.release(self.name)
+                    self.hbm.admit(self.name, nbytes)
+        elif old_engine is None:
+            nbytes = None
 
         try:
             engine, batcher = self._build_engine(spec, cfg)
         except Exception:
             if self.hbm is not None:
                 if old_engine is not None:
-                    # Old generation still serving: put its entry back.
-                    self.hbm.admit(self.name, old_engine.param_bytes())
+                    self.hbm.release(staging_key)  # old entry untouched
                 else:
                     self.hbm.release(self.name)
             raise
@@ -149,6 +165,12 @@ class JaxModel(Model):
         self.ready = True
         if old_engine is not None:
             old_engine.close()  # quiesces in-flight work, frees old HBM
+            if self.hbm is not None and zero_downtime:
+                # Commit: staging entry becomes the model's entry.
+                self.hbm.release(self.name)
+                self.hbm.release(staging_key)
+                self.hbm.admit(self.name, engine.param_bytes(),
+                               evict=False)
         return True
 
     def _build_engine(self, spec, cfg):
@@ -158,6 +180,9 @@ class JaxModel(Model):
         from kfserving_tpu.parallel import build_mesh, shard_params
         from kfserving_tpu.parallel.mesh import MeshConfig
 
+        # Kept for subclasses that need the raw logits path (explainers
+        # differentiate through base_apply, not the serving output mode).
+        self._spec = spec
         variables = init_params(spec, seed=0)
         ckpt_path = os.path.join(self._local_dir, CHECKPOINT_NAME)
         if os.path.exists(ckpt_path):
@@ -181,6 +206,7 @@ class JaxModel(Model):
                 }
 
         base_apply = apply_fn_for(spec)
+        self._base_apply = base_apply
         scale = cfg.scale
         output_mode, topk = cfg.output, cfg.topk
 

@@ -6,7 +6,10 @@ from kfserving_tpu.predictors.tabular import TabularModel
 
 
 class XGBoostModel(TabularModel):
-    ARTIFACT_EXTENSIONS = (".bst", ".json", ".ubj")
+    # .json deliberately excluded: model dirs routinely carry JSON sidecars
+    # (this repo's own config.json layout) that would trip the exactly-one-
+    # artifact check.
+    ARTIFACT_EXTENSIONS = (".bst", ".ubj")
 
     def __init__(self, name: str, model_dir: str, nthread: int = 1):
         super().__init__(name, model_dir)

@@ -375,3 +375,13 @@ async def test_unload_never_loaded_model_is_noop():
         assert p.ops_failed == 0
     finally:
         await p.stop()
+
+
+def test_parse_model_config_skips_non_dict_entries():
+    raw = json.dumps([
+        {"modelName": "a", "modelSpec": {"storageUri": "file:///x"}},
+        "typo",
+        42,
+    ]).encode()
+    out = parse_model_config(raw)
+    assert list(out) == ["a"]

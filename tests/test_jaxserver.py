@@ -225,3 +225,33 @@ def test_reload_success_swaps_and_closes_old_engine(tmp_path):
     assert m.load()  # reload same artifact
     assert m.engine is not old_engine
     assert old_engine.params is None  # old generation freed
+
+
+def test_reload_stop_the_world_when_no_headroom(tmp_path):
+    """Budget fits one generation: reload falls back to close-then-build
+    instead of overcommitting HBM with both generations resident."""
+    model_dir = _write_model_dir(tmp_path)
+    m0 = JaxModel("probe", model_dir)
+    m0.load()
+    one_gen = m0.engine.param_bytes()
+
+    hbm = HBMManager(budget_bytes=int(one_gen * 1.5))  # < two generations
+    m = JaxModel("m", model_dir, hbm=hbm)
+    assert m.load()
+    assert m.load()  # reload within a too-small-for-two budget
+    assert m.ready
+    assert hbm.resident_models() == ["m"]
+    assert hbm.used_bytes <= hbm.budget_bytes
+
+
+def test_reload_zero_downtime_accounting(tmp_path):
+    """With headroom for both generations, reload commits exactly one
+    entry afterwards."""
+    model_dir = _write_model_dir(tmp_path)
+    hbm = HBMManager(budget_bytes=1_000_000)
+    m = JaxModel("m", model_dir, hbm=hbm)
+    assert m.load()
+    used_after_first = hbm.used_bytes
+    assert m.load()
+    assert hbm.resident_models() == ["m"]
+    assert hbm.used_bytes == used_after_first

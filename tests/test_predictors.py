@@ -162,3 +162,72 @@ def test_image_transformer_preprocess():
         np.testing.assert_allclose(arr[0, 0], expect, rtol=1e-5)
 
     asyncio.run(run())
+
+
+def test_saliency_explainer_argmax_config(tmp_path):
+    """Explainer must differentiate through raw logits even when serving
+    output mode is argmax (int outputs are not differentiable)."""
+    import json
+
+    from flax import serialization
+
+    from kfserving_tpu.explainers import SaliencyExplainer
+    from kfserving_tpu.models import create_model, init_params
+
+    model_dir = tmp_path / "m"
+    model_dir.mkdir()
+    ak = {"input_dim": 4, "features": [8], "num_classes": 3}
+    (model_dir / "config.json").write_text(json.dumps(
+        {"architecture": "mlp", "arch_kwargs": ak, "output": "argmax",
+         "warmup": False}))
+    spec = create_model("mlp", **ak)
+    (model_dir / "checkpoint.msgpack").write_bytes(
+        serialization.to_bytes(init_params(spec, seed=0)))
+    ex = SaliencyExplainer("m", str(model_dir))
+    assert ex.load()
+
+    async def run():
+        return await ex.explain({"instances": np.ones((1, 4)).tolist()})
+
+    resp = asyncio.run(run())
+    assert np.abs(np.asarray(
+        resp["explanations"][0]["saliency"])).sum() > 0
+
+
+def test_blackbox_explainer_single_instance():
+    """Gaussian jitter perturbs even a batch of one (permutation of a
+    single row is the identity and yields all-zero importance)."""
+    from kfserving_tpu.explainers.saliency import BlackBoxExplainer
+
+    ex = BlackBoxExplainer("m", num_samples=8)
+    ex.predictor_host = "fake:80"
+    calls = []
+
+    async def fake_predict(batch):
+        calls.append(batch.copy())
+        # decision boundary on feature 1 only
+        return (batch[:, 1] > 0.5).astype(int).tolist()
+
+    ex._remote_predict = fake_predict
+
+    async def run():
+        return await ex.explain({"instances": [[0.0, 0.6, 1.0]]})
+
+    resp = asyncio.run(run())
+    imp = resp["explanations"][0]["feature_importance"]
+    assert len(imp) == 3
+    assert imp[1] > 0          # the decisive feature flips predictions
+    assert imp[0] == 0 and imp[2] == 0
+    # perturbed batches differ from the original
+    assert any((c != calls[0]).any() for c in calls[1:])
+
+
+def test_blackbox_explainer_metadata_safe():
+    from kfserving_tpu.explainers.saliency import BlackBoxExplainer
+
+    ex = BlackBoxExplainer("m")
+    ex.load()
+    meta = ex.metadata()
+    assert meta["explainer"] == "noise_flip_rate"
+    ex.unload()
+    assert not ex.ready
