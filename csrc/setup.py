@@ -1,0 +1,26 @@
+"""Build the native extensions: python csrc/setup.py build_ext --inplace
+
+Output lands next to this file; kfserving_tpu/protocol/native.py adds
+csrc/ to the extension search path and falls back to pure Python when the
+build is absent (hermetic environments never require the .so)."""
+
+import os
+
+from setuptools import Extension, setup
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+setup(
+    name="kfserving-tpu-native",
+    version="0.1.0",
+    ext_modules=[
+        Extension(
+            "_tensorjson",
+            sources=[os.path.join(HERE, "tensorjson.c")],
+            extra_compile_args=["-O3"],
+        ),
+    ],
+    script_args=["build_ext", "--inplace",
+                 "--build-lib", HERE, "--build-temp",
+                 os.path.join(HERE, "build")],
+)

@@ -95,7 +95,9 @@ class DynamicBatcher:
 
     async def submit(self, instances: List[Any]) -> BatchResult:
         """Enqueue one request's instances; resolves with its own predictions."""
-        if not instances:
+        # len() (not truthiness): instances may be a numpy array from the
+        # native codec fast path, where bool() on >1 element raises.
+        if len(instances) == 0:
             raise ValueError("no instances in the request")
         key = self.key_fn(instances[0]) if self.key_fn else None
         loop = asyncio.get_running_loop()

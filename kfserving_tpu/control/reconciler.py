@@ -71,9 +71,6 @@ class InferenceServiceReconciler:
     def __init__(self, orchestrator):
         self.orchestrator = orchestrator
         self.status: Dict[str, IsvcStatus] = {}
-        # component_id -> revision -> replica list is derived from the
-        # orchestrator; we track the revision ring (latest, previous).
-        self._revisions: Dict[str, Dict[str, str]] = {}
 
     @staticmethod
     def component_id(isvc: InferenceService, component: str) -> str:
@@ -111,16 +108,22 @@ class InferenceServiceReconciler:
                                    cstatus: ComponentStatus) -> None:
         cid = self.component_id(isvc, cname)
         new_rev = revision_of(comp)
-        revs = self._revisions.setdefault(cid, {})
 
         if cstatus.latest_revision and cstatus.latest_revision != new_rev:
             cstatus.previous_revision = cstatus.latest_revision
         cstatus.latest_revision = new_rev
 
         canary = comp.canary_traffic_percent
-        desired: Dict[str, int] = {new_rev: max(comp.min_replicas, 1)
-                                   if comp.min_replicas > 0 or canary
-                                   is not None else comp.min_replicas}
+        base = (max(comp.min_replicas, 1)
+                if comp.min_replicas > 0 or canary is not None
+                else comp.min_replicas)
+        # Re-applying an unchanged revision must not undo autoscaling: the
+        # reconciler owns the floor, the autoscaler owns anything above it
+        # (clamped to max_replicas).
+        current = sum(1 for r in self.orchestrator.replicas(cid)
+                      if r.revision == new_rev)
+        desired: Dict[str, int] = {
+            new_rev: min(max(base, current), max(comp.max_replicas, base))}
         if canary is not None and cstatus.previous_revision and \
                 cstatus.previous_revision != new_rev:
             # Canary: previous revision keeps serving (reference keeps the
@@ -137,8 +140,6 @@ class InferenceServiceReconciler:
                 cstatus.previous_revision = ""
 
         await self._scale_revisions(cid, desired, comp)
-        revs.clear()
-        revs.update({rev: rev for rev in desired})
         replicas = self.orchestrator.replicas(cid)
         cstatus.replicas = len(replicas)
         cstatus.ready = all(

@@ -140,7 +140,10 @@ class JaxModel(Model):
                 self.hbm.admit(self.name, nbytes)
             else:
                 try:
-                    self.hbm.admit(staging_key, nbytes)
+                    # evict=False: staging must never evict live models
+                    # (including this model's own serving generation) —
+                    # no headroom means the stop-the-world path below.
+                    self.hbm.admit(staging_key, nbytes, evict=False)
                 except InsufficientHBM:
                     zero_downtime = False
                     self.ready = False
@@ -320,14 +323,22 @@ class JaxModel(Model):
         if self.batcher is None:
             raise InferenceError(f"model {self.name} not loaded")
         if isinstance(request, InferRequest) or (
-                isinstance(request, dict) and "inputs" in request
-                and request["inputs"] and isinstance(request["inputs"][0], dict)
+                isinstance(request, dict)
+                and isinstance(request.get("inputs"), list)
+                and request["inputs"]
+                and isinstance(request["inputs"][0], dict)
                 and "datatype" in request["inputs"][0]):
             return await self._predict_v2(request)
         instances = v1.get_instances(request)
         result = await self.batcher.submit(instances)
-        return v1.make_response(
-            [_tolist(p) for p in result.predictions])
+        preds = result.predictions
+        # Uniform float32 predictions stay an ndarray so the server's
+        # native codec serializes them in one pass (protocol/native.py).
+        if preds and isinstance(preds[0], np.ndarray) \
+                and preds[0].dtype == np.float32 \
+                and all(p.shape == preds[0].shape for p in preds[1:]):
+            return v1.make_response(np.stack(preds))
+        return v1.make_response([_tolist(p) for p in preds])
 
     async def _predict_v2(self, request: Any) -> Dict[str, Any]:
         req = (request if isinstance(request, InferRequest)

@@ -71,6 +71,15 @@ def from_http(headers: Dict[str, str], body: bytes) -> CloudEvent:
     return CloudEvent(attrs, body)
 
 
+def _np_default(obj):
+    """numpy arrays (native-codec fast path responses) serialize as lists."""
+    tolist = getattr(obj, "tolist", None)
+    if tolist is not None:
+        return tolist()
+    raise TypeError(
+        f"Object of type {type(obj).__name__} is not JSON serializable")
+
+
 def ce_time_now() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime())
 
@@ -82,7 +91,7 @@ def to_binary(event: CloudEvent) -> Tuple[Dict[str, str], bytes]:
     if isinstance(data, bytes):
         body = data
     else:
-        body = json.dumps(data).encode("utf-8")
+        body = json.dumps(data, default=_np_default).encode("utf-8")
         headers.setdefault("content-type", "application/json")
     return headers, body
 
@@ -101,7 +110,7 @@ def to_structured(event: CloudEvent) -> Tuple[Dict[str, str], bytes]:
     else:
         envelope["data"] = data
     return ({"content-type": STRUCTURED_CONTENT_TYPE},
-            json.dumps(envelope).encode("utf-8"))
+            json.dumps(envelope, default=_np_default).encode("utf-8"))
 
 
 def new_event(event_type: str, source: str, data: Any,

@@ -1,0 +1,141 @@
+"""Native codec loader + pure-Python fallback.
+
+The C extension (csrc/tensorjson.c) parses dense V1 predict bodies into
+contiguous float32 buffers and dumps prediction tensors back to JSON in
+one pass.  This wrapper:
+
+- loads `_tensorjson` from csrc/ when built (csrc/setup.py), else exposes
+  the same API in pure Python;
+- returns numpy views over the parsed buffer (zero-copy reshape).
+
+Fast path eligibility is decided by the caller (server/dataplane): dense
+numeric bodies only; anything else (dicts, strings, V2 tensor objects,
+CloudEvents) takes the json.loads route unchanged.
+"""
+
+import json
+import logging
+import os
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("kfserving_tpu.native")
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+
+_native = None
+
+
+def _load():
+    global _native
+    if _native is not None:
+        return _native
+    if _CSRC not in sys.path:
+        sys.path.insert(0, _CSRC)
+    try:
+        import _tensorjson  # type: ignore
+
+        _native = _tensorjson
+        logger.info("native tensorjson codec loaded")
+    except ImportError:
+        _native = False
+    return _native
+
+
+def build(force: bool = False) -> bool:
+    """Compile the extension in-place (used by tests/deploy scripts)."""
+    import glob
+    import subprocess
+
+    if not force and glob.glob(os.path.join(_CSRC, "_tensorjson*.so")):
+        return True
+    try:
+        subprocess.run(
+            [sys.executable, os.path.join(_CSRC, "setup.py")],
+            cwd=_CSRC, check=True, capture_output=True, timeout=120)
+        global _native
+        _native = None  # re-probe
+        return bool(_load())
+    except Exception as e:
+        logger.warning("native build failed: %s", e)
+        return False
+
+
+def available() -> bool:
+    return bool(_load())
+
+
+def parse_v1(body: bytes) -> Optional[Tuple[np.ndarray, str]]:
+    """Parse a dense V1 body -> (array, key) or None if ineligible.
+
+    Never raises for non-dense bodies: the caller falls back to
+    json.loads.
+    """
+    mod = _load()
+    if mod:
+        try:
+            data, shape, key, dtype = mod.parse_v1(body)
+        except ValueError:
+            return None
+        arr = np.frombuffer(
+            data, dtype=np.int32 if dtype == "i4" else np.float32
+        ).reshape(shape)
+        return arr, key
+    return _parse_v1_py(body)
+
+
+def _parse_v1_py(body: bytes) -> Optional[Tuple[np.ndarray, str]]:
+    """Pure-Python fallback with identical eligibility rules."""
+    try:
+        obj = json.loads(body)
+    except ValueError:
+        return None
+    if not isinstance(obj, dict):
+        return None
+    key = ("instances" if "instances" in obj
+           else "inputs" if "inputs" in obj else None)
+    if key is None or not isinstance(obj[key], list):
+        return None
+    try:
+        arr = np.asarray(obj[key])
+    except (ValueError, TypeError):
+        return None
+    if arr.ndim == 0 or arr.dtype == object:
+        return None
+    if np.issubdtype(arr.dtype, np.integer):
+        if arr.size and (np.abs(arr) > np.iinfo(np.int32).max).any():
+            arr = arr.astype(np.float32)
+        else:
+            arr = arr.astype(np.int32)
+    elif np.issubdtype(arr.dtype, np.floating):
+        arr = arr.astype(np.float32)
+    else:
+        return None
+    return arr, key
+
+
+def dump_f32(arr: np.ndarray) -> bytes:
+    """Serialize a float tensor as a JSON array (bytes)."""
+    arr = np.ascontiguousarray(arr, dtype=np.float32)
+    mod = _load()
+    if mod:
+        return mod.dump_f32(arr.tobytes(), tuple(arr.shape))
+    return json.dumps(arr.tolist()).encode()
+
+
+def dump_response(body) -> Optional[bytes]:
+    """Fast-serialize `{"predictions": <float32 ndarray>}` responses.
+
+    Returns None when ineligible (other keys, non-array, non-float32 —
+    integer class labels must round-trip as ints, not "1.0").
+    """
+    if not isinstance(body, dict) or set(body) != {"predictions"}:
+        return None
+    arr = body["predictions"]
+    if not isinstance(arr, np.ndarray) or arr.dtype != np.float32 \
+            or arr.ndim == 0:
+        return None
+    return b'{"predictions": ' + dump_f32(arr) + b"}"

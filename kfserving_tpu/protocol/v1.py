@@ -21,10 +21,15 @@ def validate_request(request: Dict[str, Any]) -> Dict[str, Any]:
     """
     if not isinstance(request, dict):
         raise InvalidInput('Expected request body to be a JSON object')
-    if ("instances" in request and not isinstance(request["instances"], list)) or (
-        "inputs" in request and not isinstance(request["inputs"], list)
-    ):
-        raise InvalidInput('Expected "instances" or "inputs" to be a list')
+    for key in ("instances", "inputs"):
+        value = request.get(key)
+        if value is None:
+            continue
+        # Accepted: JSON lists, or numpy arrays from the native codec fast
+        # path (protocol/native.py).
+        if not (isinstance(value, list) or hasattr(value, "ndim")):
+            raise InvalidInput(
+                'Expected "instances" or "inputs" to be a list')
     return request
 
 

@@ -37,7 +37,7 @@ from typing import Any, Dict, List, Optional
 
 from kfserving_tpu.model.model import Model
 from kfserving_tpu.model.repository import ModelRepository
-from kfserving_tpu.protocol import cloudevents
+from kfserving_tpu.protocol import cloudevents, native
 from kfserving_tpu.protocol.errors import ServingError
 from kfserving_tpu.server.dataplane import DataPlane
 from kfserving_tpu.server.http import HTTPServer, Request, Response, Router
@@ -62,7 +62,19 @@ parser.add_argument("--max_batch_size", default=32, type=int,
 
 
 def _json(data: Any, status: int = 200) -> Response:
-    return Response(json.dumps(data).encode("utf-8"), status=status)
+    fast = native.dump_response(data)
+    if fast is not None:
+        return Response(fast, status=status)
+    return Response(json.dumps(data, default=_np_default).encode("utf-8"),
+                    status=status)
+
+
+def _np_default(obj):
+    tolist = getattr(obj, "tolist", None)
+    if tolist is not None:
+        return tolist()
+    raise TypeError(
+        f"Object of type {type(obj).__name__} is not JSON serializable")
 
 
 def _error(e: ServingError) -> Response:

@@ -12,7 +12,7 @@ from typing import Any, Dict, List
 from kfserving_tpu import __version__ as SERVER_VERSION
 from kfserving_tpu.model.model import Model
 from kfserving_tpu.model.repository import ModelRepository, maybe_await
-from kfserving_tpu.protocol import cloudevents, v1
+from kfserving_tpu.protocol import cloudevents, native, v1
 from kfserving_tpu.protocol.errors import (
     InvalidInput,
     ModelNotFound,
@@ -71,12 +71,23 @@ class DataPlane:
         return model
 
     def decode_body(self, headers: Dict[str, str], body: bytes) -> Any:
-        """Decode a request body: CloudEvent (binary or structured) or JSON."""
+        """Decode a request body: CloudEvent (binary or structured) or JSON.
+
+        Dense numeric V1 bodies take the native tensorjson fast path
+        (protocol/native.py): one C pass straight into a float32 array,
+        no per-element PyObjects.  Everything else (CloudEvents, V2
+        tensor objects, dict instances, strings) decodes as before.
+        """
         if cloudevents.has_ce_headers(headers) or cloudevents.is_structured(headers):
             try:
                 return cloudevents.from_http(headers, body)
             except ValueError as e:
                 raise InvalidInput(f"Cloud Event Exceptions: {e}")
+        if body[:1] == b"{" and b'"datatype"' not in body:
+            fast = native.parse_v1(body)
+            if fast is not None:
+                arr, key = fast
+                return {key: arr}
         try:
             return json.loads(body) if body else {}
         except ValueError as e:

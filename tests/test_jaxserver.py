@@ -64,9 +64,9 @@ def test_checkpoint_restore_changes_output(tmp_path):
         m.load()
         return (await m.predict(x))["predictions"]
 
-    p1 = asyncio.run(run(d1, "a"))
-    p2 = asyncio.run(run(d2, "b"))
-    assert p1 != p2
+    p1 = np.asarray(asyncio.run(run(d1, "a")))
+    p2 = np.asarray(asyncio.run(run(d2, "b")))
+    assert not np.allclose(p1, p2)
 
 
 def test_argmax_output_mode(tmp_path):

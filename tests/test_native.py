@@ -1,0 +1,138 @@
+"""Native tensorjson codec tests: correctness against json.loads, fallback
+parity, and server integration (dense fast path vs everything else)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.protocol import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    # Build the extension if the toolchain is present; tests still pass on
+    # the pure-Python fallback when it isn't.
+    native.build()
+
+
+def test_parse_dense_2d():
+    body = json.dumps({"instances": [[1.5, 2, 3], [4, 5, 6.25]]}).encode()
+    arr, key = native.parse_v1(body)
+    assert key == "instances"
+    assert arr.shape == (2, 3)
+    assert arr.dtype == np.float32
+    np.testing.assert_allclose(arr, [[1.5, 2, 3], [4, 5, 6.25]])
+
+
+def test_parse_inputs_key_and_extra_keys():
+    body = (b'{"parameters": {"x": ["s", 1]}, '
+            b'"inputs": [[1, 2]], "id": "r1"}')
+    arr, key = native.parse_v1(body)
+    assert key == "inputs"
+    np.testing.assert_allclose(arr, [[1, 2]])
+
+
+def test_parse_3d():
+    data = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    body = json.dumps({"instances": data.tolist()}).encode()
+    arr, _ = native.parse_v1(body)
+    np.testing.assert_allclose(arr, data)
+
+
+@pytest.mark.parametrize("body", [
+    b'{"instances": [[1, 2], [3]]}',          # ragged
+    b'{"instances": [["a"]]}',                # non-numeric
+    b'{"instances": [{"k": 1}]}',             # dict instances
+    b'{"other": [1]}',                        # no instances key
+    b'[1, 2]',                                # not an object
+    b'{"instances": [[1, 2]',                 # truncated
+])
+def test_ineligible_bodies_return_none(body):
+    assert native.parse_v1(body) is None
+
+
+def test_parse_matches_python_fallback():
+    body = json.dumps({"instances":
+                       np.random.default_rng(0).normal(
+                           size=(4, 7)).round(4).tolist()}).encode()
+    fast = native.parse_v1(body)
+    slow = native._parse_v1_py(body)
+    assert fast is not None and slow is not None
+    np.testing.assert_allclose(fast[0], slow[0], rtol=1e-6)
+    assert fast[1] == slow[1]
+
+
+def test_dump_roundtrip():
+    arr = np.random.default_rng(1).normal(size=(3, 5)).astype(np.float32)
+    out = native.dump_f32(arr)
+    back = np.asarray(json.loads(out), dtype=np.float32)
+    np.testing.assert_allclose(back, arr, rtol=1e-6)
+
+
+def test_dump_integers_keep_float_form():
+    out = native.dump_f32(np.array([1.0, 2.0], dtype=np.float32))
+    assert json.loads(out) == [1.0, 2.0]
+
+
+def test_dump_response_eligibility():
+    assert native.dump_response(
+        {"predictions": np.zeros((2, 2), np.float32)}) is not None
+    assert native.dump_response(
+        {"predictions": np.zeros(2, np.int32)}) is None  # labels stay ints
+    assert native.dump_response({"predictions": [1, 2]}) is None
+    assert native.dump_response(
+        {"predictions": np.zeros(2, np.float32), "id": "x"}) is None
+
+
+async def test_server_fast_path_end_to_end(tmp_path):
+    """Dense body -> native parse -> model sees ndarray -> float32
+    response -> native dump; exact JSON equivalence with the slow path."""
+    import os
+
+    from flax import serialization
+
+    from kfserving_tpu.models import create_model, init_params
+    from kfserving_tpu.predictors.jax_model import JaxModel
+    from kfserving_tpu.server.app import ModelServer
+    from kfserving_tpu.server.http import Request
+
+    model_dir = os.path.join(str(tmp_path), "m")
+    os.makedirs(model_dir)
+    ak = {"input_dim": 4, "features": [8], "num_classes": 3}
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump({"architecture": "mlp", "arch_kwargs": ak,
+                   "max_latency_ms": 5, "warmup": False}, f)
+    spec = create_model("mlp", **ak)
+    with open(os.path.join(model_dir, "checkpoint.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(init_params(spec, seed=0)))
+
+    m = JaxModel("m", model_dir)
+    m.load()
+    server = ModelServer(http_port=0)
+    server.register_model(m)
+
+    body = json.dumps({"instances": [[1, 2, 3, 4], [4, 3, 2, 1]]}).encode()
+    req = Request(method="POST", path="/v1/models/m:predict", query={},
+                  headers={}, body=body)
+    req.path_params = {"name": "m"}
+    resp = await server._inference(req, "predict",
+                                   server.dataplane.infer)
+    assert resp.status == 200
+    out = json.loads(resp.body)
+    assert len(out["predictions"]) == 2
+    assert len(out["predictions"][0]) == 3
+    assert all(isinstance(x, float) for x in out["predictions"][0])
+
+
+def test_integer_payloads_stay_ints():
+    """Class labels / token ids round-trip as ints, not 1.0."""
+    arr, _ = native.parse_v1(b'{"instances": [[9, 2], [3, 4]]}')
+    assert arr.dtype == np.int32
+    assert arr.tolist() == [[9, 2], [3, 4]]
+    # mixed int/float -> float32
+    arr2, _ = native.parse_v1(b'{"instances": [[9, 2.5]]}')
+    assert arr2.dtype == np.float32
+    # int too big for int32 -> float32
+    arr3, _ = native.parse_v1(b'{"instances": [[4000000000]]}')
+    assert arr3.dtype == np.float32
