@@ -10,8 +10,12 @@
  *
  * Exposed functions (see kfserving_tpu/protocol/native.py for the
  * integration and the pure-Python fallback):
- *   parse_v1(body: bytes) -> (data: bytes, shape: tuple, key: str)
+ *   parse_v1(body: bytes) -> (data: bytes, shape: tuple, key: str,
+ *                             dtype: str, extra: int)
  *       Parses {"instances": <dense array>} or {"inputs": ...}.
+ *       `extra` is 1 when the body carried other top-level keys
+ *       (parameters, signature_name, ...) — the caller must fall back
+ *       to a full decode so those keys reach the model unchanged.
  *       Raises ValueError on ragged/non-numeric arrays or other JSON
  *       (caller falls back to json.loads for those).
  *   dump_f32(data: bytes, shape: tuple) -> bytes
@@ -20,6 +24,7 @@
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <math.h>
 #include <stdint.h>
 #include <stdlib.h>
 #include <string.h>
@@ -226,6 +231,7 @@ py_parse_v1(PyObject *self, PyObject *arg)
         ps.dims[i] = -1;
 
     const char *key = NULL;
+    int extra = 0;   /* any top-level key besides the tensor key */
     skip_ws(&ps);
     if (ps.p >= ps.end || *ps.p != '{')
         goto fail;
@@ -263,6 +269,7 @@ py_parse_v1(PyObject *self, PyObject *arg)
                 goto fail;
         }
         else {
+            extra = 1;
             if (skip_value(&ps, 0) < 0)
                 goto fail;
         }
@@ -308,7 +315,8 @@ py_parse_v1(PyObject *self, PyObject *arg)
             Py_DECREF(shape);
             return NULL;
         }
-        PyObject *out = Py_BuildValue("(NNss)", bytes, shape, key, dtype);
+        PyObject *out = Py_BuildValue("(NNssi)", bytes, shape, key, dtype,
+                                      extra);
         return out;
     }
 
@@ -361,8 +369,16 @@ write_level(Writer *w, const float *data, const Py_ssize_t *dims,
             if (wgrow(w, 32) < 0)
                 return -1;
             double v = (double)data[(*offset)++];
-            if (v == (double)(long long)v &&
-                v > -1e15 && v < 1e15) {
+            if (!isfinite(v)) {
+                /* json.dumps parity: Python accepts only these spellings */
+                const char *tok = isnan(v) ? "NaN"
+                                : (v > 0) ? "Infinity" : "-Infinity";
+                w->len += (size_t)snprintf(w->buf + w->len, 32, "%s", tok);
+            }
+            /* range guard BEFORE the (long long) cast: casting a double
+             * outside long long range is undefined behavior */
+            else if (v > -1e15 && v < 1e15 &&
+                     v == (double)(long long)v) {
                 w->len += (size_t)snprintf(w->buf + w->len, 32, "%lld.0",
                                            (long long)v);
             }

@@ -132,12 +132,7 @@ class DynamicBatcher:
     async def _run_batch(self, key: Hashable, pending: _Pending):
         batch_id = str(uuid.uuid4())
         try:
-            if self.key_fn is not None:
-                predictions = await self.handler(pending.instances, key)
-            else:
-                predictions = await self.handler(pending.instances)
-            if len(predictions) != len(pending.instances):
-                raise BatchSizeMismatch()
+            predictions = await self._run_chunked(pending.instances, key)
         except Exception as e:
             for _, _, future in pending.waiters:
                 if not future.done():
@@ -151,6 +146,41 @@ class DynamicBatcher:
             if not future.done():
                 future.set_result(BatchResult(
                     predictions[start:start + count], batch_id))
+
+    async def _run_chunked(self, instances: List[Any],
+                           key: Hashable) -> List[Any]:
+        """Execute a flush in handler calls of at most ``max_batch_size``.
+
+        Coalescing can overshoot the cap (31 pending + a 20-instance
+        arrival = 51), and a single request may exceed it outright; the
+        engine's largest compiled bucket is ``max_batch_size``, so the
+        handler must never see more (the reference's downstream server
+        takes any size, pkg/batcher/handler.go:98-154 — the TPU build
+        chunks instead).  Chunks run concurrently so the engine's pipeline
+        can overlap them; results re-concatenate in order.
+        """
+        n = self.max_batch_size
+        if len(instances) <= n:
+            chunks = [instances]
+        else:
+            chunks = [instances[i:i + n] for i in range(0, len(instances), n)]
+        if self.key_fn is not None:
+            coros = [self.handler(c, key) for c in chunks]
+        else:
+            coros = [self.handler(c) for c in chunks]
+        # return_exceptions: a failing chunk must not leave sibling chunks
+        # running untracked — flush()'s shutdown drain guarantees every
+        # handler call has finished before the engine is torn down.
+        results = await asyncio.gather(*coros, return_exceptions=True)
+        for preds in results:
+            if isinstance(preds, BaseException):
+                raise preds
+        for chunk, preds in zip(chunks, results):
+            if len(preds) != len(chunk):
+                raise BatchSizeMismatch()
+        if len(results) == 1:
+            return results[0]
+        return [p for preds in results for p in preds]
 
     async def flush(self):
         """Force-flush all pending batches and drain in-flight ones

@@ -15,6 +15,7 @@ reduced to its observable effect.
 
 import asyncio
 import itertools
+import json
 import logging
 import random
 from typing import Dict, Optional, Tuple
@@ -157,8 +158,10 @@ class IngressRouter:
         name = req.path_params["name"]
         host, err = await self._resolve(name, verb, component)
         if err is not None:
+            # json.dumps, not f-string interpolation: err embeds the
+            # client-supplied model name, which may contain quotes.
             return Response(
-                body=f'{{"error": "{err}"}}'.encode(), status=404)
+                body=json.dumps({"error": err}).encode(), status=404)
         path = req.path
         if strip_prefix and path.startswith(strip_prefix):
             path = path[len(strip_prefix):]

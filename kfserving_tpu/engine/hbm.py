@@ -149,6 +149,26 @@ class HBMManager:
         with self._lock:
             self._resident.pop(name, None)
 
+    def commit(self, staging: str, name: str,
+               nbytes: Optional[int] = None) -> None:
+        """Atomically replace ``name``'s entry with the ``staging`` entry.
+
+        Used by zero-downtime reload: releasing old+staging and re-admitting
+        would open a window where a concurrent admit claims the freed bytes
+        and the re-admit fails after the new engine is already serving.
+        Under the manager lock there is no such window.  ``nbytes``
+        overrides the staged estimate with the measured size.
+        """
+        with self._lock:
+            staged = self._resident.pop(staging, None)
+            old = self._resident.pop(name, None)
+            src = staged or old
+            if src is None:
+                return
+            final = nbytes if nbytes is not None else src.bytes
+            self._resident[name] = Residency(
+                name, final, src.loaded_at, time.time())
+
     def stats(self) -> Dict[str, float]:
         return {
             "budget_bytes": self.budget_bytes,

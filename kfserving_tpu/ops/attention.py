@@ -80,7 +80,10 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         causal_mask = jnp.tril(
             jnp.ones((Lq, Lk), jnp.bool_), k=Lk - Lq)[None, None, :, :]
         mask = causal_mask if mask is None else (mask & causal_mask)
-        flash_ok = mask is causal_mask  # no extra mask was merged in
+        # The Pallas kernel's causal mask assumes query i sits at absolute
+        # position i, which only holds when Lq == Lk; KV-cache decode
+        # (Lq < Lk, shifted triangle) must take the XLA path.
+        flash_ok = mask is causal_mask and Lq == Lk
     else:
         flash_ok = mask is None
     if flash_ok and _flash_eligible(q, None):

@@ -169,11 +169,11 @@ class JaxModel(Model):
         if old_engine is not None:
             old_engine.close()  # quiesces in-flight work, frees old HBM
             if self.hbm is not None and zero_downtime:
-                # Commit: staging entry becomes the model's entry.
-                self.hbm.release(self.name)
-                self.hbm.release(staging_key)
-                self.hbm.admit(self.name, engine.param_bytes(),
-                               evict=False)
+                # Atomic commit: staging entry becomes the model's entry
+                # under the manager lock (no release/re-admit window a
+                # concurrent admit could claim).
+                self.hbm.commit(staging_key, self.name,
+                                engine.param_bytes())
         return True
 
     def _build_engine(self, spec, cfg):

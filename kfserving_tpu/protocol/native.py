@@ -38,9 +38,19 @@ def _load():
     try:
         import _tensorjson  # type: ignore
 
-        _native = _tensorjson
-        logger.info("native tensorjson codec loaded")
-    except ImportError:
+        # API probe: parse_v1 must report extra top-level keys (5-tuple).
+        # A stale prebuilt .so with the 4-tuple API would silently drop
+        # keys like parameters/signature_name, so refuse it.
+        probe = _tensorjson.parse_v1(b'{"instances": [1], "x": 1}')
+        if len(probe) != 5:
+            logger.warning(
+                "stale _tensorjson extension (no extra-keys flag); "
+                "using pure-Python codec — rebuild with native.build(force=True)")
+            _native = False
+        else:
+            _native = _tensorjson
+            logger.info("native tensorjson codec loaded")
+    except (ImportError, ValueError):
         _native = False
     return _native
 
@@ -77,8 +87,15 @@ def parse_v1(body: bytes) -> Optional[Tuple[np.ndarray, str]]:
     mod = _load()
     if mod:
         try:
-            data, shape, key, dtype = mod.parse_v1(body)
+            out = mod.parse_v1(body)
         except ValueError:
+            return None
+        data, shape, key, dtype, extra = out
+        if extra:
+            # Body carries other top-level keys (parameters,
+            # signature_name, custom fields): a {key: arr} dict would
+            # silently drop them before model.preprocess, so fall back
+            # to the full json.loads decode.
             return None
         arr = np.frombuffer(
             data, dtype=np.int32 if dtype == "i4" else np.float32
@@ -98,6 +115,10 @@ def _parse_v1_py(body: bytes) -> Optional[Tuple[np.ndarray, str]]:
     key = ("instances" if "instances" in obj
            else "inputs" if "inputs" in obj else None)
     if key is None or not isinstance(obj[key], list):
+        return None
+    if len(obj) > 1:
+        # Extra top-level keys must survive to model.preprocess; the
+        # {key: arr} fast-path shape would drop them.
         return None
     try:
         arr = np.asarray(obj[key])

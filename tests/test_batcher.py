@@ -122,13 +122,59 @@ async def test_scatter_property_random():
 
 async def test_oversized_single_request_flushes_whole():
     """A single request larger than max_batch_size still executes (reference
-    appends then flushes on >= max, handler.go:160-176)."""
+    appends then flushes on >= max, handler.go:160-176), but the handler
+    never sees a chunk above the cap (TPU bucket ceiling)."""
+    sizes = []
+
     async def handler(instances):
+        sizes.append(len(instances))
         return instances
 
     b = DynamicBatcher(handler, max_batch_size=4, max_latency_ms=1000)
     result = await asyncio.wait_for(b.submit(list(range(10))), timeout=1.0)
     assert result.predictions == list(range(10))
+    assert sizes == [4, 4, 2]
+
+
+async def test_coalesced_overflow_chunks_to_cap():
+    """Two 20-instance requests under max_batch_size=32 coalesce to 40;
+    the flush must run as <=32-sized handler calls and both callers get
+    exactly their own slices back."""
+    sizes = []
+
+    async def handler(instances):
+        sizes.append(len(instances))
+        return [i * 2 for i in instances]
+
+    b = DynamicBatcher(handler, max_batch_size=32, max_latency_ms=50)
+    a = list(range(20))
+    c = list(range(100, 120))
+    r1, r2 = await asyncio.gather(b.submit(a), b.submit(c))
+    assert r1.predictions == [i * 2 for i in a]
+    assert r2.predictions == [i * 2 for i in c]
+    assert max(sizes) <= 32 and sum(sizes) == 40
+
+
+async def test_hundred_instance_request_chunks():
+    sizes = []
+
+    async def handler(instances):
+        sizes.append(len(instances))
+        return instances
+
+    b = DynamicBatcher(handler, max_batch_size=32, max_latency_ms=50)
+    result = await asyncio.wait_for(b.submit(list(range(100))), timeout=2.0)
+    assert result.predictions == list(range(100))
+    assert sizes == [32, 32, 32, 4]
+
+
+async def test_chunk_mismatch_still_raises():
+    async def bad_handler(instances):
+        return instances[:-1]  # every chunk short by one
+
+    b = DynamicBatcher(bad_handler, max_batch_size=4, max_latency_ms=10)
+    with pytest.raises(BatchSizeMismatch):
+        await b.submit(list(range(10)))
 
 
 async def test_empty_request_rejected():

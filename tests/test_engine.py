@@ -142,6 +142,26 @@ class TestHBMManager:
         m.release("a")
         assert m.used_bytes == 0
 
+    def test_commit_replaces_atomically(self):
+        """Reload commit: staging entry becomes the model's entry with the
+        measured size; no release/re-admit window for a concurrent admit
+        to exploit."""
+        m = HBMManager(budget_bytes=100)
+        m.admit("a", 40)
+        m.admit("a!staging", 40, evict=False)
+        m.commit("a!staging", "a", nbytes=45)
+        assert m.resident_models() == ["a"]
+        assert m.used_bytes == 45
+        # freed headroom is claimable only AFTER commit
+        m.admit("b", 55, evict=False)
+        assert m.used_bytes == 100
+
+    def test_commit_without_staging_keeps_entry(self):
+        m = HBMManager(budget_bytes=100)
+        m.admit("a", 40)
+        m.commit("a!staging", "a")  # staging missing: keep current books
+        assert m.used_bytes == 40
+
 
 def test_hbm_readmit_replaces_old_entry():
     """Re-admitting a resident model replaces its accounting entry instead of

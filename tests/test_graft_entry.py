@@ -27,3 +27,31 @@ def test_dryrun_multichip_with_ring_attention():
     # 4 devices -> sp=2, tp=2: exercises the ring-attention path + tp
     # sharding + backward pass in one jitted step.
     graft.dryrun_multichip(4)
+
+
+def test_dryrun_self_provisions_like_the_driver(tmp_path):
+    """MULTICHIP_r01 regression: the driver imports this module into a
+    process where JAX is already initialized with too few devices and
+    calls dryrun_multichip(8) directly — the function must self-provision
+    a subprocess on the virtual CPU mesh rather than raise.
+
+    Reproduced here in a fresh interpreter pinned to ONE CPU device (the
+    driver's single real chip analogue)."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.pop("_KFSERVING_TPU_DRYRUN_CHILD", None)
+    code = (
+        "import sys; sys.path.insert(0, {repo!r}); "
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        "assert len(jax.devices()) == 1, jax.devices(); "
+        "import __graft_entry__ as g; g.dryrun_multichip(4)"
+    ).format(repo=repo)
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "dryrun_multichip: mesh" in proc.stdout

@@ -52,6 +52,31 @@ def test_load_and_v1_predict(tmp_path):
     assert len(resp["predictions"][0]) == 3  # 3-class logits
 
 
+def test_coalesced_overflow_executes_through_engine(tmp_path):
+    """VERDICT weak #2 regression: two 20-instance requests under
+    max_batch_size=32 coalesce to 40 > the largest compiled bucket; the
+    chunked flush must keep every engine call within bucket range, and a
+    100-instance request must succeed via chunking."""
+    model_dir = _write_model_dir(
+        tmp_path, config_extra={"max_batch_size": 32, "max_latency_ms": 20})
+    m = JaxModel("m", model_dir)
+    assert m.load()
+    rng = np.random.default_rng(0)
+
+    async def run():
+        a = {"instances": rng.normal(size=(20, 8)).tolist()}
+        b = {"instances": rng.normal(size=(20, 8)).tolist()}
+        r1, r2 = await asyncio.gather(m.predict(a), m.predict(b))
+        big = {"instances": rng.normal(size=(100, 8)).tolist()}
+        r3 = await m.predict(big)
+        return r1, r2, r3
+
+    r1, r2, r3 = asyncio.run(run())
+    assert len(r1["predictions"]) == 20
+    assert len(r2["predictions"]) == 20
+    assert len(r3["predictions"]) == 100
+
+
 def test_checkpoint_restore_changes_output(tmp_path):
     """Same inputs, different checkpoints -> different logits (proves the
     checkpoint actually loads rather than serving the seed-0 init)."""

@@ -25,12 +25,42 @@ def test_parse_dense_2d():
     np.testing.assert_allclose(arr, [[1.5, 2, 3], [4, 5, 6.25]])
 
 
-def test_parse_inputs_key_and_extra_keys():
-    body = (b'{"parameters": {"x": ["s", 1]}, '
-            b'"inputs": [[1, 2]], "id": "r1"}')
+def test_parse_inputs_key():
+    body = b'{"inputs": [[1, 2]]}'
     arr, key = native.parse_v1(body)
     assert key == "inputs"
     np.testing.assert_allclose(arr, [[1, 2]])
+
+
+def test_extra_keys_fall_back():
+    """Bodies with keys besides the tensor key must NOT take the fast
+    path: a {key: arr} result would silently drop parameters /
+    signature_name / custom fields before model.preprocess."""
+    body = (b'{"parameters": {"x": ["s", 1]}, '
+            b'"inputs": [[1, 2]], "id": "r1"}')
+    assert native.parse_v1(body) is None
+    assert native._parse_v1_py(body) is None
+
+
+def test_extra_keys_reach_model_via_decode_body():
+    """decode_body delivers the FULL dict when extra keys are present."""
+    from kfserving_tpu.model.repository import ModelRepository
+    from kfserving_tpu.server.dataplane import DataPlane
+
+    dp = DataPlane(ModelRepository())
+    body = b'{"instances": [[1.0, 2.0]], "signature_name": "serving"}'
+    decoded = dp.decode_body({}, body)
+    assert decoded["signature_name"] == "serving"
+    assert decoded["instances"] == [[1.0, 2.0]]
+
+
+def test_dump_non_finite_json_dumps_parity():
+    arr = np.array([1.0, np.nan, np.inf, -np.inf], np.float32)
+    out = native.dump_f32(arr)
+    back = json.loads(out)  # Python's parser accepts NaN/Infinity
+    assert back[0] == 1.0
+    assert np.isnan(back[1])
+    assert back[2] == float("inf") and back[3] == float("-inf")
 
 
 def test_parse_3d():
