@@ -1,0 +1,10 @@
+"""SDK client + CLI for the TPU serving fabric (reference
+python/kfserving/kfserving/api/kf_serving_client.py equivalent)."""
+
+from kfserving_tpu.client.client import (
+    ClientError,
+    KFServingClient,
+    isvc_spec,
+)
+
+__all__ = ["KFServingClient", "ClientError", "isvc_spec"]

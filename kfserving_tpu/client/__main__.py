@@ -1,0 +1,5 @@
+import sys
+
+from kfserving_tpu.client.cli import main
+
+sys.exit(main())

@@ -1,0 +1,130 @@
+"""kfs CLI: kubectl-style command line over the control API.
+
+    python -m kfserving_tpu.client apply -f isvc.json
+    python -m kfserving_tpu.client get [NAME]
+    python -m kfserving_tpu.client delete NAME
+    python -m kfserving_tpu.client wait NAME --timeout 120
+    python -m kfserving_tpu.client predict NAME -d '{"instances": [[...]]}'
+    python -m kfserving_tpu.client canary NAME --percent 20
+    python -m kfserving_tpu.client promote NAME
+
+The reference splits this between kubectl (CRDs) and the SDK; the TPU
+build ships one client for both planes.
+"""
+
+import argparse
+import asyncio
+import json
+import sys
+
+from kfserving_tpu.client.client import KFServingClient
+
+parser = argparse.ArgumentParser(prog="kfs")
+parser.add_argument("--control-url", default="http://127.0.0.1:8081")
+parser.add_argument("--ingress-url", default="http://127.0.0.1:8080")
+parser.add_argument("--namespace", "-n", default="default")
+sub = parser.add_subparsers(dest="command", required=True)
+
+p_apply = sub.add_parser("apply", help="create or update from a spec file")
+p_apply.add_argument("-f", "--filename", required=True)
+
+p_get = sub.add_parser("get", help="get one isvc (or list all)")
+p_get.add_argument("name", nargs="?")
+
+p_delete = sub.add_parser("delete")
+p_delete.add_argument("name")
+
+p_wait = sub.add_parser("wait", help="block until ready")
+p_wait.add_argument("name")
+p_wait.add_argument("--timeout", type=float, default=120.0)
+
+p_predict = sub.add_parser("predict")
+p_predict.add_argument("name")
+p_predict.add_argument("-d", "--data", help="inline JSON payload")
+p_predict.add_argument("-f", "--filename", help="payload file")
+p_predict.add_argument("--protocol", default="v1", choices=["v1", "v2"])
+p_predict.add_argument("--model", default=None,
+                       help="model name when it differs from the isvc "
+                            "(TrainedModel under a multi-model isvc)")
+
+p_explain = sub.add_parser("explain")
+p_explain.add_argument("name")
+p_explain.add_argument("-d", "--data")
+p_explain.add_argument("-f", "--filename")
+
+p_canary = sub.add_parser("canary", help="set canary traffic percent")
+p_canary.add_argument("name")
+p_canary.add_argument("--percent", type=int, required=True)
+
+p_promote = sub.add_parser("promote", help="promote canary to 100%")
+p_promote.add_argument("name")
+
+p_tm = sub.add_parser("trainedmodel", help="TrainedModel ops")
+tm_sub = p_tm.add_subparsers(dest="tm_command", required=True)
+tm_apply = tm_sub.add_parser("apply")
+tm_apply.add_argument("-f", "--filename", required=True)
+tm_delete = tm_sub.add_parser("delete")
+tm_delete.add_argument("name")
+tm_get = tm_sub.add_parser("get")
+tm_get.add_argument("name", nargs="?")
+
+
+def _payload(args) -> dict:
+    if getattr(args, "data", None):
+        return json.loads(args.data)
+    if getattr(args, "filename", None):
+        with open(args.filename) as f:
+            return json.load(f)
+    return json.load(sys.stdin)
+
+
+async def _run(args) -> dict:
+    async with KFServingClient(args.control_url, args.ingress_url) as c:
+        ns = args.namespace
+        if args.command == "apply":
+            with open(args.filename) as f:
+                spec = json.load(f)
+            return await c.create(spec)
+        if args.command == "get":
+            return await c.get(args.name, ns) if args.name \
+                else await c.get()
+        if args.command == "delete":
+            return await c.delete(args.name, ns)
+        if args.command == "wait":
+            await c.wait_isvc_ready(args.name, ns,
+                                    timeout_seconds=args.timeout)
+            return {"name": args.name, "ready": True}
+        if args.command == "predict":
+            return await c.predict(args.name, _payload(args),
+                                   protocol=args.protocol,
+                                   model_name=args.model)
+        if args.command == "explain":
+            return await c.explain(args.name, _payload(args))
+        if args.command == "canary":
+            return await c.rollout_canary(args.name, args.percent, ns)
+        if args.command == "promote":
+            return await c.promote(args.name, ns)
+        if args.command == "trainedmodel":
+            if args.tm_command == "apply":
+                with open(args.filename) as f:
+                    return await c.create_trained_model(json.load(f))
+            if args.tm_command == "delete":
+                return await c.delete_trained_model(args.name, ns)
+            return await c.get_trained_model(args.name, ns) \
+                if args.name else await c.get_trained_model()
+        raise SystemExit(f"unknown command {args.command}")
+
+
+def main(argv=None) -> int:
+    args = parser.parse_args(argv)
+    try:
+        result = asyncio.run(_run(args))
+    except Exception as e:
+        print(json.dumps({"error": str(e)}), file=sys.stderr)
+        return 1
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,226 @@
+"""KFServingClient: the programmatic SDK for the serving fabric.
+
+Mirrors the reference Python SDK's surface (reference
+python/kfserving/kfserving/api/kf_serving_client.py:29-380 —
+create/get/patch/delete/wait_isvc_ready plus TrainedModel ops, and
+kf_serving_watch.py's watch loop) against the TPU control API and
+ingress router instead of the K8s apiserver:
+
+    client = KFServingClient("http://127.0.0.1:8081",
+                             "http://127.0.0.1:8080")
+    await client.create(isvc_dict)
+    await client.wait_isvc_ready("sklearn-iris")
+    result = await client.predict("sklearn-iris",
+                                  {"instances": [[6.8, 2.8, 4.8, 1.4]]})
+
+All methods are async (the whole stack is asyncio); use
+``asyncio.run(...)`` from synchronous code or the CLI
+(`python -m kfserving_tpu.client`).
+"""
+
+import asyncio
+import json
+from dataclasses import asdict, is_dataclass
+from typing import Any, Dict, List, Optional
+
+DEFAULT_TIMEOUT_S = 60.0
+
+
+class ClientError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+
+
+class TimeoutError_(Exception):
+    pass
+
+
+def _to_dict(obj: Any) -> Dict[str, Any]:
+    if is_dataclass(obj) and not isinstance(obj, type):
+        return asdict(obj)
+    if isinstance(obj, dict):
+        return obj
+    raise TypeError(f"expected spec dict or dataclass, got {type(obj)}")
+
+
+class KFServingClient:
+    """Async client for the control API (+ optional ingress data plane)."""
+
+    def __init__(self, control_url: str,
+                 ingress_url: Optional[str] = None,
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.control_url = control_url.rstrip("/")
+        self.ingress_url = (ingress_url or "").rstrip("/") or None
+        self.timeout_s = timeout_s
+        self._session = None
+
+    async def _ensure_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s))
+        return self._session
+
+    async def close(self):
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def __aenter__(self):
+        await self._ensure_session()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.close()
+
+    async def _request(self, method: str, url: str,
+                       body: Optional[Dict[str, Any]] = None
+                       ) -> Dict[str, Any]:
+        session = await self._ensure_session()
+        data = json.dumps(body).encode() if body is not None else None
+        async with session.request(method, url, data=data) as resp:
+            payload = await resp.read()
+            try:
+                decoded = json.loads(payload) if payload else {}
+            except ValueError:
+                decoded = {"raw": payload.decode("utf-8", "replace")}
+            if resp.status >= 400:
+                raise ClientError(
+                    resp.status,
+                    decoded.get("error", decoded.get("raw", "")))
+            return decoded
+
+    # -- InferenceService CRUD (reference kf_serving_client.py:89-231) ------
+    async def create(self, isvc: Any) -> Dict[str, Any]:
+        return await self._request(
+            "POST", f"{self.control_url}/v1/inferenceservices",
+            _to_dict(isvc))
+
+    async def get(self, name: Optional[str] = None,
+                  namespace: str = "default") -> Dict[str, Any]:
+        if name is None:
+            return await self._request(
+                "GET", f"{self.control_url}/v1/inferenceservices")
+        return await self._request(
+            "GET",
+            f"{self.control_url}/v1/inferenceservices/{namespace}/{name}")
+
+    async def patch(self, name: str, patch: Dict[str, Any],
+                    namespace: str = "default") -> Dict[str, Any]:
+        return await self._request(
+            "PATCH",
+            f"{self.control_url}/v1/inferenceservices/{namespace}/{name}",
+            patch)
+
+    async def delete(self, name: str, namespace: str = "default"
+                     ) -> Dict[str, Any]:
+        return await self._request(
+            "DELETE",
+            f"{self.control_url}/v1/inferenceservices/{namespace}/{name}")
+
+    # -- rollout helpers (reference canary docs flow) -----------------------
+    async def rollout_canary(self, name: str, percent: int,
+                             namespace: str = "default",
+                             **spec_changes) -> Dict[str, Any]:
+        """Set canary traffic percent (optionally with spec changes that
+        mint the new revision)."""
+        patch: Dict[str, Any] = {"predictor": {
+            "canary_traffic_percent": percent, **spec_changes}}
+        return await self.patch(name, patch, namespace)
+
+    async def promote(self, name: str, namespace: str = "default"
+                      ) -> Dict[str, Any]:
+        """Promote the canary to 100% (clears the split; the losing
+        revision is garbage-collected)."""
+        return await self.patch(
+            name, {"predictor": {"canary_traffic_percent": None}},
+            namespace)
+
+    # -- readiness (reference wait_isvc_ready, kf_serving_client.py:232+) ---
+    async def wait_isvc_ready(self, name: str, namespace: str = "default",
+                              timeout_seconds: float = 120.0,
+                              polling_interval: float = 0.2) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout_seconds
+        last: Dict[str, Any] = {}
+        while asyncio.get_event_loop().time() < deadline:
+            try:
+                last = await self.get(name, namespace)
+            except ClientError as e:
+                if e.status != 404:
+                    raise
+                last = {}
+            status = (last or {}).get("status") or {}
+            if status.get("ready"):
+                return
+            await asyncio.sleep(polling_interval)
+        raise TimeoutError_(
+            f"timeout waiting for {namespace}/{name} ready; "
+            f"last status: {json.dumps((last or {}).get('status'))}")
+
+    # -- TrainedModel ops (reference client TrainedModel section) -----------
+    async def create_trained_model(self, tm: Any) -> Dict[str, Any]:
+        return await self._request(
+            "POST", f"{self.control_url}/v1/trainedmodels", _to_dict(tm))
+
+    async def get_trained_model(self, name: Optional[str] = None,
+                                namespace: str = "default"
+                                ) -> Dict[str, Any]:
+        if name is None:
+            return await self._request(
+                "GET", f"{self.control_url}/v1/trainedmodels")
+        return await self._request(
+            "GET", f"{self.control_url}/v1/trainedmodels/{namespace}/{name}")
+
+    async def delete_trained_model(self, name: str,
+                                   namespace: str = "default"
+                                   ) -> Dict[str, Any]:
+        return await self._request(
+            "DELETE",
+            f"{self.control_url}/v1/trainedmodels/{namespace}/{name}")
+
+    # -- data plane ---------------------------------------------------------
+    def _ingress(self) -> str:
+        if self.ingress_url is None:
+            raise ValueError(
+                "no ingress_url configured; pass it to KFServingClient "
+                "to use predict/explain")
+        return self.ingress_url
+
+    async def predict(self, name: str, payload: Dict[str, Any],
+                      protocol: str = "v1",
+                      model_name: Optional[str] = None) -> Dict[str, Any]:
+        """POST a predict request through the ingress router.
+
+        model_name: path model (defaults to the isvc name; differs for
+        TrainedModels served under a parent isvc)."""
+        model = model_name or name
+        if protocol == "v2":
+            url = f"{self._ingress()}/v2/models/{model}/infer"
+        else:
+            url = f"{self._ingress()}/v1/models/{model}:predict"
+        return await self._request("POST", url, payload)
+
+    async def explain(self, name: str, payload: Dict[str, Any],
+                      model_name: Optional[str] = None) -> Dict[str, Any]:
+        model = model_name or name
+        url = f"{self._ingress()}/v1/models/{model}:explain"
+        return await self._request("POST", url, payload)
+
+
+def isvc_spec(name: str, framework: str, storage_uri: str,
+              namespace: str = "default", **predictor_kwargs
+              ) -> Dict[str, Any]:
+    """Convenience builder for a minimal InferenceService spec dict
+    (the SDK-side constructors the reference generates from swagger)."""
+    return {
+        "name": name,
+        "namespace": namespace,
+        "predictor": {
+            "framework": framework,
+            "storage_uri": storage_uri,
+            **predictor_kwargs,
+        },
+    }

@@ -66,7 +66,7 @@ class Autoscaler:
                 await self._scale_component(name, isvc, cname, comp)
 
     async def _scale_component(self, name, isvc, cname, comp):
-        gauge_key = f"router/{isvc.name}"
+        gauge_key = f"router/{isvc.name}/{cname}"
         inflight = self.router.inflight.get(gauge_key, 0)
         window = self._windows.setdefault(
             f"{name}/{cname}", deque(maxlen=WINDOW_TICKS))

@@ -1,0 +1,146 @@
+"""Cluster-tier configuration (tier 1 of the three-tier config system).
+
+The reference keeps cluster-wide serving policy in the
+``inferenceservice-config`` ConfigMap — per-framework runtime
+images/versions, ingress gateways, logger/batcher/agent resource bounds,
+and credential file names (reference config/configmap/
+inferenceservice.yaml:1-120, parsed at pkg/apis/serving/v1beta1/
+configmap.go:121-158 on every reconcile).  The TPU build has no images;
+the per-framework entry is the *entrypoint module* the subprocess
+orchestrator execs plus default runtime knobs.
+
+Tier 2 is the InferenceService spec (control/spec.py); tier 3 is process
+flags (server/app.py parser).  Spec fields override cluster defaults;
+flags are per-process only.
+"""
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+logger = logging.getLogger("kfserving_tpu.control.clusterconfig")
+
+# Per-framework runtime registry (reference configmap `predictors` block:
+# image/defaultImageVersion/supportedFrameworks per entry).
+DEFAULT_PREDICTOR_RUNTIMES = {
+    "jax": {
+        "module": "kfserving_tpu.predictors.jaxserver",
+        "multiModel": True,
+        "defaultTimeout": 300,
+    },
+    "sklearn": {
+        "module": "kfserving_tpu.predictors.sklearnserver",
+        "multiModel": False,
+        "defaultTimeout": 60,
+    },
+    "xgboost": {
+        "module": "kfserving_tpu.predictors.xgbserver",
+        "multiModel": False,
+        "defaultTimeout": 60,
+    },
+    "lightgbm": {
+        "module": "kfserving_tpu.predictors.lgbserver",
+        "multiModel": False,
+        "defaultTimeout": 60,
+    },
+    "pmml": {
+        "module": "kfserving_tpu.predictors.pmmlserver",
+        "multiModel": False,
+        "defaultTimeout": 60,
+    },
+}
+
+
+@dataclass
+class IngressConfig:
+    """Reference `ingress` block (gateway + service); here: bind address."""
+
+    host: str = "127.0.0.1"
+    port: int = 8080
+
+
+@dataclass
+class LoggerConfig:
+    """Payload logger bounds (reference agent_injector.go:64-113 caps the
+    sidecar's resources; here the worker pool / queue are the bound)."""
+
+    workers: int = 5
+    max_queue: int = 100
+
+
+@dataclass
+class BatcherConfig:
+    """Cluster ceilings for per-isvc batcher requests (the reference caps
+    the sidecar's memory; the TPU analogue caps compiled-shape count)."""
+
+    max_batch_size_limit: int = 256
+    min_latency_ms: float = 0.5
+
+
+@dataclass
+class AutoscalerConfig:
+    target_concurrency: float = 4.0
+    tick_seconds: float = 2.0
+
+
+@dataclass
+class CredentialsConfig:
+    """Reference `credentials` block (configmap keys
+    gcsCredentialFileName / s3AccessKeyIDName / ...)."""
+
+    gcs_credential_file_name: str = "gcloud-application-credentials.json"
+    s3_access_key_id_name: str = "awsAccessKeyID"
+    s3_secret_access_key_name: str = "awsSecretAccessKey"
+
+
+@dataclass
+class ClusterConfig:
+    predictors: Dict[str, dict] = field(
+        default_factory=lambda: {
+            k: dict(v) for k, v in DEFAULT_PREDICTOR_RUNTIMES.items()})
+    ingress: IngressConfig = field(default_factory=IngressConfig)
+    logger: LoggerConfig = field(default_factory=LoggerConfig)
+    batcher: BatcherConfig = field(default_factory=BatcherConfig)
+    autoscaler: AutoscalerConfig = field(default_factory=AutoscalerConfig)
+    credentials: CredentialsConfig = field(
+        default_factory=CredentialsConfig)
+    # Where TrainedModel shard configs (models.json) are written.
+    modelconfig_dir: Optional[str] = None
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "ClusterConfig":
+        """Parse a JSON config file; absent path/file -> all defaults
+        (the reference reads the ConfigMap on every reconcile; a restart
+        picks up changes here)."""
+        cfg = cls()
+        if not path:
+            return cfg
+        with open(path) as f:
+            data = json.load(f)
+        return cls.from_dict(data)
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ClusterConfig":
+        cfg = cls()
+        for name, entry in (data.get("predictors") or {}).items():
+            base = cfg.predictors.setdefault(name, {})
+            base.update(entry)
+        for key, klass in (("ingress", IngressConfig),
+                           ("logger", LoggerConfig),
+                           ("batcher", BatcherConfig),
+                           ("autoscaler", AutoscalerConfig),
+                           ("credentials", CredentialsConfig)):
+            if isinstance(data.get(key), dict):
+                setattr(cfg, key, klass(**data[key]))
+        if data.get("modelconfig_dir"):
+            cfg.modelconfig_dir = data["modelconfig_dir"]
+        return cfg
+
+    def runtime_for(self, framework: str) -> dict:
+        entry = self.predictors.get(framework)
+        if entry is None:
+            raise KeyError(
+                f"no predictor runtime configured for framework "
+                f"{framework!r} (configured: {sorted(self.predictors)})")
+        return entry

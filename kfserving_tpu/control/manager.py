@@ -1,0 +1,122 @@
+"""ServingManager: the deployable control-plane process.
+
+The reference's manager binary wires schemes, both reconcilers, and the
+webhooks into one controller-runtime manager (reference
+cmd/manager/main.go:59-186).  The TPU equivalent wires the controller,
+ingress router, autoscaler, and control API into one asyncio process:
+
+    python -m kfserving_tpu.control serve \
+        --config cluster.json --control-port 8081 --ingress-port 8080 \
+        --orchestrator subprocess --apply examples/iris.json
+
+Data-plane traffic enters the ingress router (the Istio VS + activator
+role); declarative specs enter the control API (the apiserver role); the
+autoscaler ticks in the background (the KPA role); replicas are actuated
+in-process or as subprocesses.
+"""
+
+import asyncio
+import json
+import logging
+import signal
+from typing import List, Optional
+
+from kfserving_tpu.control.api import ControlAPI
+from kfserving_tpu.control.autoscaler import Autoscaler
+from kfserving_tpu.control.clusterconfig import ClusterConfig
+from kfserving_tpu.control.controller import Controller
+from kfserving_tpu.control.orchestrator import InProcessOrchestrator
+from kfserving_tpu.control.router import IngressRouter
+from kfserving_tpu.control.spec import InferenceService
+from kfserving_tpu.control.subprocess_orchestrator import (
+    SubprocessOrchestrator,
+)
+
+logger = logging.getLogger("kfserving_tpu.control.manager")
+
+
+class ServingManager:
+    def __init__(self, cluster_config: Optional[ClusterConfig] = None,
+                 orchestrator: str = "inprocess",
+                 control_port: int = 8081,
+                 ingress_port: Optional[int] = None,
+                 host: Optional[str] = None):
+        self.cluster_config = cluster_config or ClusterConfig()
+        # Tier precedence: explicit args (tier 3) over the cluster
+        # config's ingress block (tier 1).
+        if ingress_port is None:
+            ingress_port = self.cluster_config.ingress.port
+        if host is None:
+            host = self.cluster_config.ingress.host
+        if orchestrator == "subprocess":
+            self.orchestrator = SubprocessOrchestrator(
+                self.cluster_config, host=host)
+        elif orchestrator == "inprocess":
+            self.orchestrator = InProcessOrchestrator()
+        else:
+            raise ValueError(
+                f"unknown orchestrator backend {orchestrator!r} "
+                f"(inprocess | subprocess)")
+        self.controller = Controller(
+            self.orchestrator,
+            modelconfig_dir=self.cluster_config.modelconfig_dir)
+        self.router = IngressRouter(self.controller,
+                                    http_port=ingress_port)
+        self.autoscaler = Autoscaler(
+            self.controller, self.router,
+            target_concurrency=(
+                self.cluster_config.autoscaler.target_concurrency),
+            tick_seconds=self.cluster_config.autoscaler.tick_seconds)
+        self.api = ControlAPI(self.controller, http_port=control_port)
+        self.host = host
+
+    # -- lifecycle ----------------------------------------------------------
+    async def start_async(self) -> None:
+        await self.api.start_async(self.host)
+        await self.router.start_async(self.host)
+        await self.autoscaler.start()
+        logger.info("control API on %s:%d, ingress on %s:%d",
+                    self.host, self.api.http_port,
+                    self.host, self.router.http_port)
+
+    async def stop_async(self) -> None:
+        await self.autoscaler.stop()
+        await self.router.stop_async()
+        await self.api.stop_async()
+        for name in list(self.controller.specs):
+            ns, isvc_name = name.split("/", 1)
+            await self.controller.remove(isvc_name, ns)
+        shutdown = getattr(self.orchestrator, "shutdown", None)
+        if shutdown is not None:
+            await shutdown()
+
+    async def apply_files(self, paths: List[str]) -> None:
+        """Apply spec files at startup (kubectl-apply-at-boot)."""
+        for path in paths:
+            with open(path) as f:
+                data = json.load(f)
+            items = data if isinstance(data, list) else [data]
+            for item in items:
+                isvc = InferenceService.from_dict(item)
+                status = await self.controller.apply(isvc)
+                logger.info("applied %s/%s (ready=%s)",
+                            isvc.namespace, isvc.name, status.ready)
+
+    def run(self, apply: Optional[List[str]] = None) -> None:
+        """Blocking entrypoint with graceful signal-driven shutdown."""
+        async def _main():
+            await self.start_async()
+            if apply:
+                await self.apply_files(apply)
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                try:
+                    loop.add_signal_handler(sig, stop.set)
+                except NotImplementedError:
+                    pass
+            await stop.wait()
+            logger.info("shutting down")
+            await self.stop_async()
+
+        asyncio.run(_main())

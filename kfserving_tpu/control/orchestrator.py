@@ -88,7 +88,10 @@ class InProcessOrchestrator:
         if model is not None and not model.ready:
             loop = asyncio.get_running_loop()
             await loop.run_in_executor(None, model.load)
-        server = ModelServer(http_port=0, enable_docs=False)
+        server = ModelServer(
+            http_port=0, enable_docs=False,
+            container_concurrency=getattr(
+                spec, "container_concurrency", 0) or 0)
         await server.start_async([model] if model is not None else [],
                                  host="127.0.0.1")
         replica = Replica(component_id, revision,

@@ -103,27 +103,28 @@ class IngressRouter:
 
     async def _resolve(self, name: str, verb: str,
                        component: Optional[str] = None
-                       ) -> Tuple[Optional[str], Optional[str]]:
-        """Returns (host, error)."""
+                       ) -> Tuple[Optional[str], Optional[str],
+                                  Optional[str]]:
+        """Returns (host, component_name, error)."""
         isvc = self.controller.get(name)
         if isvc is None:
-            return None, f"inference service {name} not found"
+            return None, None, f"inference service {name} not found"
         cname = component or self._entry_component(isvc, verb)
         key = f"{isvc.namespace}/{isvc.name}"
         status = self.controller.reconciler.status.get(key)
         cstatus = status.components.get(cname) if status else None
         if cstatus is None:
-            return None, f"component {cname} of {name} not reconciled"
+            return None, cname, f"component {cname} of {name} not reconciled"
         revision = self._pick_revision(cstatus)
         if revision is None:
-            return None, f"no traffic targets for {name}/{cname}"
+            return None, cname, f"no traffic targets for {name}/{cname}"
         cid = self.controller.reconciler.component_id(isvc, cname)
         host = self._pick_replica(cid, revision)
         if host is None:
             host = await self._activate(isvc, cname, cid, revision)
             if host is None:
-                return None, f"no replicas for {name}/{cname}"
-        return host, None
+                return None, cname, f"no replicas for {name}/{cname}"
+        return host, cname, None
 
     async def _activate(self, isvc, cname: str, cid: str,
                         revision: str) -> Optional[str]:
@@ -156,7 +157,7 @@ class IngressRouter:
                      component: Optional[str] = None,
                      strip_prefix: str = "") -> Response:
         name = req.path_params["name"]
-        host, err = await self._resolve(name, verb, component)
+        host, cname, err = await self._resolve(name, verb, component)
         if err is not None:
             # json.dumps, not f-string interpolation: err embeds the
             # client-supplied model name, which may contain quotes.
@@ -166,7 +167,9 @@ class IngressRouter:
         if strip_prefix and path.startswith(strip_prefix):
             path = path[len(strip_prefix):]
         url = f"http://{host}{path}"
-        cid = f"router/{name}"
+        # Per-component gauge: the autoscaler must see transformer and
+        # predictor traffic separately (they scale independently).
+        cid = f"router/{name}/{cname}"
         self.inflight[cid] = self.inflight.get(cid, 0) + 1
         self.request_count[cid] = self.request_count.get(cid, 0) + 1
         try:

@@ -1,0 +1,185 @@
+"""SubprocessOrchestrator: replicas are real OS processes.
+
+The reference's replicas are pods created by Knative from the ksvc the
+reconciler writes (reference ksvc_reconciler.go:153-187); the
+single-host TPU equivalent is one process per replica, exec'd from the
+per-framework entrypoint module registered in the cluster config
+(`python -m kfserving_tpu.predictors.<fw> --model_name ... --model_dir
+... --http_port ...` — the same arg convention the reference's
+predictor specs build, predictor_sklearn.go:77-96).
+
+Readiness mirrors the pod readiness probe: the replica joins the
+router's rotation only after its health route answers.  Deletion is
+SIGTERM (the server's signal handler drains in-flight work) escalating
+to SIGKILL.
+
+TPU note: on a single chip only one process can own the device; either
+give each JAX replica a distinct mesh slice via env (TPU_VISIBLE_DEVICES
+/ JAX_PLATFORMS) through `env_overrides`, or keep max_replicas=1 for
+chip-owning predictors.  CPU frameworks (sklearn/xgb/...) scale freely.
+"""
+
+import asyncio
+import logging
+import os
+import socket
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from kfserving_tpu.control.clusterconfig import ClusterConfig
+from kfserving_tpu.control.orchestrator import Replica, _ComponentState
+
+logger = logging.getLogger("kfserving_tpu.control.subprocess")
+
+READY_TIMEOUT_S = 120.0
+TERM_GRACE_S = 10.0
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class _Proc:
+    process: asyncio.subprocess.Process
+    port: int
+
+
+class SubprocessOrchestrator:
+    """Actuation backend that execs one server process per replica."""
+
+    def __init__(self, cluster_config: Optional[ClusterConfig] = None,
+                 env_overrides: Optional[Dict[str, str]] = None,
+                 host: str = "127.0.0.1"):
+        self.cluster_config = cluster_config or ClusterConfig()
+        self.env_overrides = env_overrides or {}
+        self.host = host
+        self.state: Dict[str, _ComponentState] = {}
+
+    def replicas(self, component_id: str) -> List[Replica]:
+        return list(self.state.get(component_id,
+                                   _ComponentState()).replicas)
+
+    # -- spec -> argv -------------------------------------------------------
+    def _command(self, component_id: str, spec, port: int) -> List[str]:
+        from kfserving_tpu.control.spec import (
+            ExplainerSpec,
+            PredictorSpec,
+            TransformerSpec,
+        )
+
+        isvc_name = component_id.split("/")[1]
+        if isinstance(spec, (TransformerSpec, ExplainerSpec)) and \
+                getattr(spec, "command", None):
+            return list(spec.command) + ["--http_port", str(port)]
+        if isinstance(spec, PredictorSpec):
+            if spec.framework == "custom":
+                if not spec.command:
+                    raise ValueError(
+                        "custom predictor needs an explicit command")
+                return list(spec.command) + ["--http_port", str(port)]
+            runtime = self.cluster_config.runtime_for(spec.framework)
+            argv = [sys.executable, "-m", runtime["module"],
+                    "--model_name", isvc_name,
+                    "--model_dir", spec.storage_uri,
+                    "--http_port", str(port)]
+            if spec.container_concurrency:
+                argv += ["--container_concurrency",
+                         str(spec.container_concurrency)]
+            if spec.batcher is not None:
+                argv += ["--max_batch_size",
+                         str(spec.batcher.max_batch_size),
+                         "--max_latency_ms",
+                         str(spec.batcher.max_latency_ms)]
+            if spec.multi_model:
+                argv += ["--multi_model"]
+            return argv
+        raise ValueError(
+            f"subprocess orchestrator cannot run component spec "
+            f"{type(spec).__name__} without an explicit command")
+
+    # -- lifecycle ----------------------------------------------------------
+    async def create_replica(self, component_id: str, revision: str,
+                             spec) -> Replica:
+        port = _free_port(self.host)
+        argv = self._command(component_id, spec, port)
+        env = dict(os.environ)
+        # The package must be importable from the child even when not
+        # pip-installed.
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (
+            repo_root + os.pathsep + env.get("PYTHONPATH", "")).rstrip(
+                os.pathsep)
+        env.update(self.env_overrides)
+        logger.info("spawning replica %s rev=%s: %s",
+                    component_id, revision[:8], " ".join(argv))
+        process = await asyncio.create_subprocess_exec(
+            *argv, env=env,
+            stdout=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.DEVNULL)
+        host = f"{self.host}:{port}"
+        try:
+            await self._wait_ready(process, host)
+        except Exception:
+            await self._terminate(process)
+            raise
+        replica = Replica(component_id, revision, host,
+                          handle=_Proc(process, port))
+        self.state.setdefault(component_id,
+                              _ComponentState()).replicas.append(replica)
+        return replica
+
+    async def _wait_ready(self, process, host: str) -> None:
+        """Poll the liveness route until it answers (readiness probe)."""
+        import aiohttp
+
+        deadline = asyncio.get_running_loop().time() + READY_TIMEOUT_S
+        url = f"http://{host}/"
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=2.0)) as session:
+            while True:
+                if process.returncode is not None:
+                    raise RuntimeError(
+                        f"replica process exited rc={process.returncode} "
+                        f"before becoming ready")
+                try:
+                    async with session.get(url) as resp:
+                        if resp.status == 200:
+                            return
+                except Exception:
+                    pass
+                if asyncio.get_running_loop().time() > deadline:
+                    raise TimeoutError(
+                        f"replica at {host} not ready after "
+                        f"{READY_TIMEOUT_S}s")
+                await asyncio.sleep(0.1)
+
+    async def delete_replica(self, replica: Replica) -> None:
+        comp = self.state.get(replica.component_id)
+        if comp and replica in comp.replicas:
+            comp.replicas.remove(replica)
+        handle: _Proc = replica.handle
+        if handle is not None:
+            await self._terminate(handle.process)
+        logger.info("replica down: %s at %s",
+                    replica.component_id, replica.host)
+
+    @staticmethod
+    async def _terminate(process) -> None:
+        if process.returncode is not None:
+            return
+        process.terminate()
+        try:
+            await asyncio.wait_for(process.wait(), TERM_GRACE_S)
+        except asyncio.TimeoutError:
+            process.kill()
+            await process.wait()
+
+    async def shutdown(self):
+        for comp in list(self.state.values()):
+            for replica in list(comp.replicas):
+                await self.delete_replica(replica)

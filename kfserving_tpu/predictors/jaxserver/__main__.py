@@ -40,13 +40,16 @@ parser.add_argument("--source_uri", default="",
 
 
 def build_server(args) -> ModelServer:
+    cc = getattr(args, "container_concurrency", 0)
     multi_model = args.multi_model or args.config_dir
     if multi_model:
         repo = JaxModelRepository(models_dir=args.model_dir)
         server = ModelServer(http_port=args.http_port,
-                             registered_models=repo)
+                             registered_models=repo,
+                             container_concurrency=cc)
     else:
-        server = ModelServer(http_port=args.http_port)
+        server = ModelServer(http_port=args.http_port,
+                             container_concurrency=cc)
 
     if args.config_dir:
         import asyncio

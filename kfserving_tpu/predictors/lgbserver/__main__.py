@@ -17,4 +17,6 @@ if __name__ == "__main__":
     args, _ = parser.parse_known_args()
     model = LightGBMModel(args.model_name, args.model_dir, args.nthread)
     model.load()
-    ModelServer(http_port=args.http_port).start([model])
+    ModelServer(http_port=args.http_port,
+                container_concurrency=args.container_concurrency
+                ).start([model])

@@ -16,4 +16,6 @@ if __name__ == "__main__":
     args, _ = parser.parse_known_args()
     model = PMMLModel(args.model_name, args.model_dir)
     model.load()
-    ModelServer(http_port=args.http_port).start([model])
+    ModelServer(http_port=args.http_port,
+                container_concurrency=args.container_concurrency
+                ).start([model])

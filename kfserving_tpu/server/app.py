@@ -59,6 +59,9 @@ parser.add_argument("--max_latency_ms", default=5.0, type=float,
                     help="Dynamic batcher flush deadline in milliseconds.")
 parser.add_argument("--max_batch_size", default=32, type=int,
                     help="Dynamic batcher max batch size.")
+parser.add_argument("--container_concurrency", default=0, type=int,
+                    help="Max concurrent inference calls per replica "
+                         "(0 = unlimited; Knative containerConcurrency).")
 
 
 def _json(data: Any, status: int = 200) -> Response:
@@ -81,10 +84,59 @@ def _error(e: ServingError) -> Response:
     return _json({"error": e.reason}, status=e.status_code)
 
 
+class _AdmissionGate:
+    """FIFO concurrency gate with a bounded wait queue.
+
+    Not an asyncio.Semaphore: Semaphore.locked() ignores waiters before
+    Python 3.12 and acquire() permits barging, which would let newcomers
+    starve queued requests and grow the queue past its bound.  This gate
+    hands a finishing request's slot directly to the oldest waiter.
+    """
+
+    def __init__(self, limit: int, max_queue: int):
+        self.limit = limit
+        self.max_queue = max_queue
+        self.active = 0
+        self.queue = []  # FIFO of futures
+
+    async def enter(self) -> bool:
+        """True once a slot is held; False = queue full, reject."""
+        if self.active < self.limit and not self.queue:
+            self.active += 1
+            return True
+        if len(self.queue) >= self.max_queue:
+            return False
+        fut = asyncio.get_running_loop().create_future()
+        self.queue.append(fut)
+        try:
+            await fut
+        except asyncio.CancelledError:
+            if fut.done() and not fut.cancelled():
+                # granted between the cancel and now: pass the slot on
+                self.exit()
+            else:
+                try:
+                    self.queue.remove(fut)
+                except ValueError:
+                    pass
+            raise
+        return True
+
+    def exit(self) -> None:
+        while self.queue:
+            fut = self.queue.pop(0)
+            if not fut.done():
+                fut.set_result(None)  # slot transferred; active unchanged
+                return
+        self.active -= 1
+
+
 class ModelServer:
     def __init__(self, http_port: int = DEFAULT_HTTP_PORT,
                  registered_models: Optional[ModelRepository] = None,
-                 enable_docs: bool = True):
+                 enable_docs: bool = True,
+                 container_concurrency: int = 0,
+                 max_queue_depth: Optional[int] = None):
         self.repository = registered_models or ModelRepository()
         self.dataplane = DataPlane(self.repository)
         self.http_port = http_port
@@ -96,6 +148,18 @@ class ModelServer:
         # Agent-style background services (logger, watcher, puller): objects
         # with async start()/stop(), run for the server's lifetime.
         self.services = []
+        # Per-replica admission control (Knative containerConcurrency,
+        # reference component.go:79-82): at most `container_concurrency`
+        # inference calls execute at once; up to `max_queue_depth` more
+        # wait (the queue-proxy buffer), the rest get 503 so the load
+        # balancer retries another replica.  0 = unlimited.
+        self.container_concurrency = container_concurrency
+        self.max_queue_depth = (
+            max_queue_depth if max_queue_depth is not None
+            else max(2 * container_concurrency, 8))
+        self._admission = (
+            _AdmissionGate(container_concurrency, self.max_queue_depth)
+            if container_concurrency > 0 else None)
 
     # -- routes ------------------------------------------------------------
     def _register_routes(self):
@@ -166,6 +230,29 @@ class ModelServer:
     async def _inference(self, req: Request, verb: str, op) -> Response:
         name = req.path_params["name"]
         start = time.perf_counter()
+        if self._admission is not None:
+            if not await self._admission.enter():
+                latency_ms = (time.perf_counter() - start) * 1000.0
+                resp = _json(
+                    {"error": "concurrency limit exceeded"}, status=503)
+                self.metrics.observe_request(name, verb, 503, latency_ms)
+                # Shed requests still reach the hooks: the payload logger
+                # must not go blind exactly during overload.
+                for hook in self.request_hooks:
+                    try:
+                        hook(name, verb, req, resp, latency_ms)
+                    except Exception:
+                        logger.exception("request hook failed")
+                return resp
+            try:
+                return await self._inference_inner(
+                    req, verb, op, name, start)
+            finally:
+                self._admission.exit()
+        return await self._inference_inner(req, verb, op, name, start)
+
+    async def _inference_inner(self, req: Request, verb: str, op,
+                               name: str, start: float) -> Response:
         status = 200
         try:
             body = self.dataplane.decode_body(req.headers, req.body)

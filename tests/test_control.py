@@ -333,3 +333,35 @@ async def test_scale_to_zero_and_activate(tmp_path):
         await scaler.stop()
         await router.stop_async()
         await orch.shutdown()
+
+
+async def test_autoscaler_scales_components_independently(tmp_path):
+    """VERDICT weak #7 regression: transformer and predictor of one isvc
+    must scale off their OWN in-flight gauges, not a shared one."""
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    isvc = _isvc(name="duo")
+    from kfserving_tpu.control.spec import TransformerSpec
+
+    isvc.transformer = TransformerSpec(min_replicas=1, max_replicas=8,
+                                       command=["true"])
+    isvc.predictor.max_replicas = 8
+    await c.apply(isvc)
+    router = IngressRouter(c)  # not started; autoscaler reads its gauges
+    scaler = Autoscaler(c, router, target_concurrency=4.0,
+                        tick_seconds=0.01)
+
+    # asymmetric load: predictor saturated, transformer idle
+    router.inflight["router/duo/predictor"] = 16
+    router.inflight["router/duo/transformer"] = 0
+    for _ in range(8):
+        await scaler.tick()
+    assert len(orch.replicas("default/duo/predictor")) == 4   # 16/4
+    assert len(orch.replicas("default/duo/transformer")) == 1  # idle floor
+
+    # flip the asymmetry: transformer hot, predictor cooling
+    router.inflight["router/duo/predictor"] = 0
+    router.inflight["router/duo/transformer"] = 24
+    for _ in range(8):
+        await scaler.tick()
+    assert len(orch.replicas("default/duo/transformer")) == 6  # 24/4

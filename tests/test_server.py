@@ -246,3 +246,65 @@ async def test_not_ready_model_lazy_loads():
         # lazy load on first request, reference handlers/http.py:32-41
         assert status == 200
         assert body == {"predictions": [[5]]}
+
+
+class SlowModel(Model):
+    def __init__(self, name="slow", delay=0.25):
+        super().__init__(name)
+        self.delay = delay
+        self.peak_inflight = 0
+        self._inflight = 0
+
+    def load(self):
+        self.ready = True
+        return True
+
+    async def predict(self, request):
+        self._inflight += 1
+        self.peak_inflight = max(self.peak_inflight, self._inflight)
+        try:
+            await asyncio.sleep(self.delay)
+            return {"predictions": request["instances"]}
+        finally:
+            self._inflight -= 1
+
+
+async def test_container_concurrency_admission():
+    """containerConcurrency enforcement (reference component.go:79-82 via
+    Knative CC): at most N concurrent inferences; a bounded queue buffers
+    the next arrivals; the rest are rejected 503 so the balancer can
+    retry another replica."""
+    model = SlowModel()
+    model.load()
+    async with running_server(
+            [model], container_concurrency=1, max_queue_depth=2) as server:
+
+        async def one():
+            status, body = await http_json(
+                server.http_port, "POST", "/v1/models/slow:predict",
+                {"instances": [[1]]})
+            return status
+
+        statuses = await asyncio.gather(*[one() for _ in range(8)])
+        assert statuses.count(200) == 3      # 1 executing + 2 queued
+        assert statuses.count(503) == 5      # queue full -> rejected
+        assert model.peak_inflight == 1      # the limit actually held
+
+
+async def test_container_concurrency_queue_drains():
+    """Queued requests run after the in-flight one finishes; nothing is
+    lost below the queue bound."""
+    model = SlowModel(delay=0.05)
+    model.load()
+    async with running_server(
+            [model], container_concurrency=2, max_queue_depth=10) as server:
+
+        async def one(i):
+            status, _ = await http_json(
+                server.http_port, "POST", "/v1/models/slow:predict",
+                {"instances": [[i]]})
+            return status
+
+        statuses = await asyncio.gather(*[one(i) for i in range(10)])
+        assert statuses == [200] * 10
+        assert model.peak_inflight <= 2
