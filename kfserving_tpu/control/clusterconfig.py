@@ -92,6 +92,9 @@ class CredentialsConfig:
     gcs_credential_file_name: str = "gcloud-application-credentials.json"
     s3_access_key_id_name: str = "awsAccessKeyID"
     s3_secret_access_key_name: str = "awsSecretAccessKey"
+    # Path to the secret-store JSON (storage/credentials.py schema);
+    # the single-host analogue of K8s Secret objects.
+    store_file: Optional[str] = None
 
 
 @dataclass

@@ -48,11 +48,18 @@ class ServingManager:
             ingress_port = self.cluster_config.ingress.port
         if host is None:
             host = self.cluster_config.ingress.host
+        from kfserving_tpu.storage.credentials import CredentialStore
+
+        credentials = CredentialStore.load(
+            self.cluster_config.credentials.store_file,
+            gcs_file_name=(
+                self.cluster_config.credentials.gcs_credential_file_name))
         if orchestrator == "subprocess":
             self.orchestrator = SubprocessOrchestrator(
-                self.cluster_config, host=host)
+                self.cluster_config, host=host, credentials=credentials)
         elif orchestrator == "inprocess":
-            self.orchestrator = InProcessOrchestrator()
+            self.orchestrator = InProcessOrchestrator(
+                credentials=credentials)
         else:
             raise ValueError(
                 f"unknown orchestrator backend {orchestrator!r} "

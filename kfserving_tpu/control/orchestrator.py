@@ -72,8 +72,14 @@ class InProcessOrchestrator:
     a thread (compile/IO off the loop).
     """
 
-    def __init__(self, model_factory: Optional[Callable] = None):
+    def __init__(self, model_factory: Optional[Callable] = None,
+                 credentials=None):
         self.model_factory = model_factory or default_model_factory
+        # CredentialStore; in-process replicas share this process, so
+        # the per-service-account env lands in os.environ at build time
+        # (single-host dev mode — subprocess replicas get isolated env).
+        self.credentials = credentials
+        self._applied_cred_keys: set = set()
         self.state: Dict[str, _ComponentState] = {}
 
     def replicas(self, component_id: str) -> List[Replica]:
@@ -84,6 +90,17 @@ class InProcessOrchestrator:
                              spec) -> Replica:
         from kfserving_tpu.server.app import ModelServer
 
+        if self.credentials is not None:
+            import os
+
+            env = self.credentials.build_env(
+                getattr(spec, "service_account_name", "default"))
+            # Clear keys a previous service account set but this one
+            # doesn't: stale AWS_* vars must not leak across accounts.
+            for stale in self._applied_cred_keys - set(env):
+                os.environ.pop(stale, None)
+            os.environ.update(env)
+            self._applied_cred_keys = set(env)
         model = self.model_factory(component_id, spec)
         if model is not None and not model.ready:
             loop = asyncio.get_running_loop()

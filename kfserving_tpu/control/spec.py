@@ -69,6 +69,9 @@ class ComponentSpec:
     canary_traffic_percent: Optional[int] = None
     logger: Optional[LoggerSpec] = None
     batcher: Optional[BatcherSpec] = None
+    # Credentials are resolved per service account at replica build
+    # (reference pod ServiceAccountName + pkg/credentials builder).
+    service_account_name: str = "default"
 
 
 @dataclass

@@ -53,10 +53,14 @@ class SubprocessOrchestrator:
 
     def __init__(self, cluster_config: Optional[ClusterConfig] = None,
                  env_overrides: Optional[Dict[str, str]] = None,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 credentials=None):
         self.cluster_config = cluster_config or ClusterConfig()
         self.env_overrides = env_overrides or {}
         self.host = host
+        # CredentialStore: per-service-account env injected into replica
+        # processes (reference credential builder injects into containers).
+        self.credentials = credentials
         self.state: Dict[str, _ComponentState] = {}
 
     def replicas(self, component_id: str) -> List[Replica]:
@@ -114,6 +118,9 @@ class SubprocessOrchestrator:
         env["PYTHONPATH"] = (
             repo_root + os.pathsep + env.get("PYTHONPATH", "")).rstrip(
                 os.pathsep)
+        if self.credentials is not None:
+            env.update(self.credentials.build_env(
+                getattr(spec, "service_account_name", "default")))
         env.update(self.env_overrides)
         logger.info("spawning replica %s rev=%s: %s",
                     component_id, revision[:8], " ".join(argv))

@@ -41,15 +41,18 @@ parser.add_argument("--source_uri", default="",
 
 def build_server(args) -> ModelServer:
     cc = getattr(args, "container_concurrency", 0)
+    grpc_port = getattr(args, "grpc_port", None)
     multi_model = args.multi_model or args.config_dir
     if multi_model:
         repo = JaxModelRepository(models_dir=args.model_dir)
         server = ModelServer(http_port=args.http_port,
                              registered_models=repo,
-                             container_concurrency=cc)
+                             container_concurrency=cc,
+                             grpc_port=grpc_port)
     else:
         server = ModelServer(http_port=args.http_port,
-                             container_concurrency=cc)
+                             container_concurrency=cc,
+                             grpc_port=grpc_port)
 
     if args.config_dir:
         import asyncio

@@ -1,0 +1,10 @@
+"""Generated V2 gRPC protocol messages (see protos/grpc_predict_v2.proto).
+
+`grpc_predict_v2_pb2` is produced by protoc; regenerate with:
+    protoc --python_out=kfserving_tpu/protocol/grpc \
+        --proto_path=protos grpc_predict_v2.proto
+"""
+
+from kfserving_tpu.protocol.grpc import grpc_predict_v2_pb2 as pb2
+
+__all__ = ["pb2"]

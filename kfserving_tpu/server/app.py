@@ -62,6 +62,9 @@ parser.add_argument("--max_batch_size", default=32, type=int,
 parser.add_argument("--container_concurrency", default=0, type=int,
                     help="Max concurrent inference calls per replica "
                          "(0 = unlimited; Knative containerConcurrency).")
+parser.add_argument("--grpc_port", default=None, type=int,
+                    help="V2 gRPC port (unset = gRPC disabled, 0 = "
+                         "ephemeral).")
 
 
 def _json(data: Any, status: int = 200) -> Response:
@@ -136,10 +139,15 @@ class ModelServer:
                  registered_models: Optional[ModelRepository] = None,
                  enable_docs: bool = True,
                  container_concurrency: int = 0,
-                 max_queue_depth: Optional[int] = None):
+                 max_queue_depth: Optional[int] = None,
+                 grpc_port: Optional[int] = None):
         self.repository = registered_models or ModelRepository()
         self.dataplane = DataPlane(self.repository)
         self.http_port = http_port
+        # V2 gRPC front end over the same dataplane (None = disabled;
+        # 0 = ephemeral port).
+        self.grpc_port = grpc_port
+        self.grpc_server = None
         self.metrics = Metrics()
         self.router = Router()
         self._register_routes()
@@ -326,8 +334,19 @@ class ModelServer:
             await service.start()
         await self.http_server.start(host, self.http_port)
         self.http_port = self.http_server.port
+        if self.grpc_port is not None:
+            from kfserving_tpu.server.grpc_server import GRPCServer
+
+            self.grpc_server = GRPCServer(
+                self.dataplane, port=self.grpc_port,
+                host=host if host != "0.0.0.0" else "[::]")
+            await self.grpc_server.start()
+            self.grpc_port = self.grpc_server.port
 
     async def stop_async(self) -> None:
+        if self.grpc_server is not None:
+            await self.grpc_server.stop()
+            self.grpc_server = None
         for model in self.repository.get_models():
             close = getattr(model, "close", None)
             if close is not None:

@@ -1,0 +1,326 @@
+"""V2 gRPC server sharing the HTTP server's DataPlane.
+
+The reference mandates the V2 gRPC API (reference
+docs/predict-api/v2/grpc_predict_v2.proto:1-328 and required_api.md);
+its data plane never implemented it (delegated to Triton).  Here both
+protocols front the same `server/dataplane.py` operations — the HTTP
+route table and these RPCs are two codecs over one engine path.
+
+grpcio ships no generated service stubs in this image (grpc_tools is
+absent), so handlers are registered through
+`grpc.method_handlers_generic_handler` against the protoc-generated
+message classes — same wire behavior, no _pb2_grpc module needed.
+
+Tensor payloads accept both typed `InferTensorContents` fields and
+`raw_input_contents` (required for FP16/BF16); responses mirror the
+request's form: raw in -> raw out, typed in -> typed out.
+"""
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from kfserving_tpu.protocol.errors import ServingError
+from kfserving_tpu.protocol.grpc import pb2
+from kfserving_tpu.protocol.v2 import InferInput, InferRequest
+from kfserving_tpu.server.dataplane import DataPlane
+
+logger = logging.getLogger("kfserving_tpu.grpc")
+
+# datatype -> InferTensorContents field (reference proto comments:
+# 8/16/32-bit ints share int_contents / uint_contents).
+_CONTENTS_FIELD = {
+    "BOOL": "bool_contents",
+    "INT8": "int_contents", "INT16": "int_contents",
+    "INT32": "int_contents",
+    "INT64": "int64_contents",
+    "UINT8": "uint_contents", "UINT16": "uint_contents",
+    "UINT32": "uint_contents",
+    "UINT64": "uint64_contents",
+    "FP32": "fp32_contents",
+    "FP64": "fp64_contents",
+    "BYTES": "bytes_contents",
+}
+
+_RAW_DTYPE = {
+    "BOOL": np.bool_, "INT8": np.int8, "INT16": np.int16,
+    "INT32": np.int32, "INT64": np.int64, "UINT8": np.uint8,
+    "UINT16": np.uint16, "UINT32": np.uint32, "UINT64": np.uint64,
+    "FP16": np.float16, "FP32": np.float32, "FP64": np.float64,
+}
+
+
+def _decode_raw_bytes(raw: bytes) -> List[bytes]:
+    """V2 raw BYTES framing: each element is a 4-byte little-endian
+    length followed by that many bytes."""
+    import struct
+
+    out: List[bytes] = []
+    offset = 0
+    n = len(raw)
+    while offset < n:
+        if offset + 4 > n:
+            raise ValueError("truncated raw BYTES tensor")
+        (length,) = struct.unpack_from("<I", raw, offset)
+        offset += 4
+        if offset + length > n:
+            raise ValueError("truncated raw BYTES element")
+        out.append(raw[offset:offset + length])
+        offset += length
+    return out
+
+
+def _encode_raw_bytes(values) -> bytes:
+    import struct
+
+    parts = []
+    for v in values:
+        b = v.encode() if isinstance(v, str) else bytes(v)
+        parts.append(struct.pack("<I", len(b)) + b)
+    return b"".join(parts)
+
+
+def _tensor_to_numpy(tensor, raw: Optional[bytes]) -> np.ndarray:
+    shape = list(tensor.shape)
+    if raw is not None:
+        if tensor.datatype == "BYTES":
+            return np.array(_decode_raw_bytes(raw),
+                            dtype=np.object_).reshape(shape)
+        if tensor.datatype == "BF16":
+            import ml_dtypes
+
+            dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            dtype = np.dtype(_RAW_DTYPE[tensor.datatype])
+        return np.frombuffer(raw, dtype=dtype).reshape(shape)
+    field = _CONTENTS_FIELD.get(tensor.datatype)
+    if field is None:
+        raise ValueError(
+            f"datatype {tensor.datatype} requires raw_input_contents")
+    values = getattr(tensor.contents, field)
+    if tensor.datatype == "BYTES":
+        return np.array(list(values), dtype=np.object_).reshape(shape)
+    return np.asarray(values, dtype=_RAW_DTYPE[tensor.datatype]) \
+        .reshape(shape)
+
+
+def _request_to_infer(req) -> InferRequest:
+    raws: List[Optional[bytes]] = list(req.raw_input_contents) or \
+        [None] * len(req.inputs)
+    if len(raws) != len(req.inputs):
+        raise ValueError(
+            "raw_input_contents must carry one buffer per input")
+    inputs = []
+    for tensor, raw in zip(req.inputs, raws):
+        arr = _tensor_to_numpy(tensor, raw)
+        inputs.append(InferInput(tensor.name, list(tensor.shape),
+                                 tensor.datatype, arr))
+    return InferRequest(inputs, id=req.id or None)
+
+
+def _output_to_tensor(out: Dict[str, Any], response, use_raw: bool
+                      ) -> None:
+    tensor = response.outputs.add()
+    tensor.name = out["name"]
+    tensor.datatype = out["datatype"]
+    tensor.shape.extend(int(s) for s in out["shape"])
+    data = out["data"]
+    if use_raw:
+        if out["datatype"] == "BYTES":
+            values = data if isinstance(data, list) else \
+                np.asarray(data).ravel().tolist()
+            response.raw_output_contents.append(
+                _encode_raw_bytes(values))
+            return
+        if out["datatype"] == "BF16":
+            import ml_dtypes
+
+            dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            dtype = _RAW_DTYPE.get(out["datatype"])
+        arr = np.asarray(data, dtype=dtype)
+        response.raw_output_contents.append(arr.tobytes())
+        return
+    field = _CONTENTS_FIELD.get(out["datatype"])
+    if field is None:  # FP16/BF16 must go raw regardless
+        arr = np.asarray(data, dtype=np.float32)
+        tensor.datatype = "FP32"
+        tensor.ClearField("shape")
+        tensor.shape.extend(int(s) for s in out["shape"])
+        getattr(tensor.contents, "fp32_contents").extend(
+            arr.ravel().tolist())
+        return
+    values = data if isinstance(data, list) else \
+        np.asarray(data).ravel().tolist()
+    if out["datatype"] == "BYTES":
+        values = [v.encode() if isinstance(v, str) else bytes(v)
+                  for v in values]
+    getattr(tensor.contents, field).extend(values)
+
+
+_STATUS_BY_CODE = {404: "NOT_FOUND", 400: "INVALID_ARGUMENT",
+                   503: "UNAVAILABLE"}
+
+
+class GRPCServer:
+    """Async V2 gRPC front end over a DataPlane."""
+
+    def __init__(self, dataplane: DataPlane, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.dataplane = dataplane
+        self.port = port
+        self.host = host
+        self._server = None
+
+    # -- handlers -----------------------------------------------------------
+    async def _abort(self, context, e: Exception):
+        import grpc
+
+        if isinstance(e, ServingError):
+            name = _STATUS_BY_CODE.get(e.status_code, "INTERNAL")
+            code = getattr(grpc.StatusCode, name)
+            await context.abort(code, e.reason)
+        if isinstance(e, (ValueError, KeyError)):
+            await context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        logger.exception("grpc handler failed")
+        await context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    async def ServerLive(self, request, context):
+        return pb2.ServerLiveResponse(live=self.dataplane.live())
+
+    async def ServerReady(self, request, context):
+        return pb2.ServerReadyResponse(ready=self.dataplane.server_ready())
+
+    async def ModelReady(self, request, context):
+        try:
+            self.dataplane.model_ready(request.name)
+            return pb2.ModelReadyResponse(ready=True)
+        except ServingError:
+            return pb2.ModelReadyResponse(ready=False)
+
+    async def ServerMetadata(self, request, context):
+        meta = self.dataplane.server_metadata()
+        return pb2.ServerMetadataResponse(
+            name=meta["name"], version=meta["version"],
+            extensions=meta["extensions"])
+
+    async def ModelMetadata(self, request, context):
+        try:
+            meta = self.dataplane.model_metadata(request.name)
+        except ServingError as e:
+            await self._abort(context, e)
+        resp = pb2.ModelMetadataResponse(
+            name=meta.get("name", request.name),
+            platform=meta.get("platform", ""))
+        for io_key, target in (("inputs", resp.inputs),
+                               ("outputs", resp.outputs)):
+            for t in meta.get(io_key, []) or []:
+                tm = target.add()
+                tm.name = t.get("name", "")
+                tm.datatype = t.get("datatype", "")
+                tm.shape.extend(int(s) for s in t.get("shape", []))
+        return resp
+
+    async def ModelInfer(self, request, context):
+        try:
+            infer_req = _request_to_infer(request)
+            result = await self.dataplane.infer(
+                request.model_name, infer_req)
+        except Exception as e:
+            await self._abort(context, e)
+        response = pb2.ModelInferResponse(
+            model_name=result.get("model_name", request.model_name),
+            model_version=result.get("model_version", ""),
+            id=result.get("id", "") or request.id)
+        use_raw = bool(request.raw_input_contents)
+        for out in result.get("outputs", []):
+            _output_to_tensor(out, response, use_raw)
+        return response
+
+    async def RepositoryIndex(self, request, context):
+        resp = pb2.RepositoryIndexResponse()
+        for entry in self.dataplane.repository_index():
+            if request.ready and entry["state"] != "READY":
+                continue
+            m = resp.models.add()
+            m.name = entry["name"]
+            m.state = entry["state"]
+        return resp
+
+    async def RepositoryModelLoad(self, request, context):
+        try:
+            await self.dataplane.load(request.model_name)
+        except Exception as e:
+            await self._abort(context, e)
+        return pb2.RepositoryModelLoadResponse()
+
+    async def RepositoryModelUnload(self, request, context):
+        try:
+            await self.dataplane.unload(request.model_name)
+        except Exception as e:
+            await self._abort(context, e)
+        return pb2.RepositoryModelUnloadResponse()
+
+    # -- lifecycle ----------------------------------------------------------
+    def _handlers(self):
+        import grpc
+
+        def unary(fn, req_cls, resp_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=resp_cls.SerializeToString)
+
+        inference = grpc.method_handlers_generic_handler(
+            "inference.GRPCInferenceService", {
+                "ServerLive": unary(self.ServerLive,
+                                    pb2.ServerLiveRequest,
+                                    pb2.ServerLiveResponse),
+                "ServerReady": unary(self.ServerReady,
+                                     pb2.ServerReadyRequest,
+                                     pb2.ServerReadyResponse),
+                "ModelReady": unary(self.ModelReady,
+                                    pb2.ModelReadyRequest,
+                                    pb2.ModelReadyResponse),
+                "ServerMetadata": unary(self.ServerMetadata,
+                                        pb2.ServerMetadataRequest,
+                                        pb2.ServerMetadataResponse),
+                "ModelMetadata": unary(self.ModelMetadata,
+                                       pb2.ModelMetadataRequest,
+                                       pb2.ModelMetadataResponse),
+                "ModelInfer": unary(self.ModelInfer,
+                                    pb2.ModelInferRequest,
+                                    pb2.ModelInferResponse),
+            })
+        repository = grpc.method_handlers_generic_handler(
+            "inference.ModelRepositoryService", {
+                "RepositoryIndex": unary(
+                    self.RepositoryIndex,
+                    pb2.RepositoryIndexRequest,
+                    pb2.RepositoryIndexResponse),
+                "RepositoryModelLoad": unary(
+                    self.RepositoryModelLoad,
+                    pb2.RepositoryModelLoadRequest,
+                    pb2.RepositoryModelLoadResponse),
+                "RepositoryModelUnload": unary(
+                    self.RepositoryModelUnload,
+                    pb2.RepositoryModelUnloadRequest,
+                    pb2.RepositoryModelUnloadResponse),
+            })
+        return [inference, repository]
+
+    async def start(self) -> None:
+        import grpc.aio
+
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(tuple(self._handlers()))
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        await self._server.start()
+        logger.info("V2 gRPC server on %s:%d", self.host, self.port)
+
+    async def stop(self, grace: float = 5.0) -> None:
+        if self._server is not None:
+            await self._server.stop(grace)
+            self._server = None

@@ -130,7 +130,13 @@ class Storage:
             raise ValueError("No filename contained in URI: %s" % uri)
         mimetype, encoding = _guess_type(filename)
         local_path = os.path.join(out_dir, filename)
-        req = UrlRequest(uri, headers={"User-Agent": "kfserving-tpu/0.1"})
+        # Per-host credential headers (https secrets; reference
+        # pkg/credentials/https/https_secret.go).
+        from kfserving_tpu.storage.credentials import https_headers_for
+
+        headers = {"User-Agent": "kfserving-tpu/0.1"}
+        headers.update(https_headers_for(uri))
+        req = UrlRequest(uri, headers=headers)
         with urlopen(req) as response:
             if response.status != 200:
                 raise RuntimeError(
@@ -149,7 +155,9 @@ class Storage:
             os.remove(local_path)
         elif mimetype == "application/x-tar":
             with tarfile.open(local_path, "r") as tf:
-                tf.extractall(out_dir)  # noqa: S202 - trusted model artifact
+                # "data" filter: refuse absolute paths / traversal /
+                # device nodes in model archives.
+                tf.extractall(out_dir, filter="data")
             os.remove(local_path)
         return out_dir
 
@@ -191,12 +199,21 @@ class Storage:
         # against "0"; k8s users commonly set "False").
         use_ssl = os.getenv("S3_USE_HTTPS", "true").strip().lower() not in (
             "0", "false", "no")
+        verify_ssl = os.getenv("S3_VERIFY_SSL", "1").strip().lower() not \
+            in ("0", "false", "no")
+        http_client = None
+        if use_ssl and not verify_ssl:
+            # Honor the s3-verifyssl annotation (self-signed endpoints).
+            import urllib3
+
+            http_client = urllib3.PoolManager(cert_reqs="CERT_NONE")
         endpoint = re.sub(r"^https?://", "", endpoint)
         client = Minio(endpoint,
                        access_key=os.getenv("AWS_ACCESS_KEY_ID", ""),
                        secret_key=os.getenv("AWS_SECRET_ACCESS_KEY", ""),
                        region=os.getenv("AWS_REGION", ""),
-                       secure=use_ssl)
+                       secure=use_ssl,
+                       http_client=http_client)
         bucket_name, _, prefix = uri[len(_S3_PREFIX):].partition("/")
         for obj in client.list_objects(bucket_name, prefix=prefix,
                                        recursive=True):

@@ -1,0 +1,282 @@
+"""V2 gRPC protocol tests: full service surface over a real grpc.aio
+channel against the shared dataplane (reference
+docs/predict-api/v2/grpc_predict_v2.proto contract, incl. the
+repository extension needed for MMS)."""
+
+import json
+import os
+from contextlib import asynccontextmanager
+
+import numpy as np
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from kfserving_tpu.protocol.grpc import pb2  # noqa: E402
+from kfserving_tpu.server.app import ModelServer  # noqa: E402
+
+
+def _write_mlp_dir(tmp_path, name="m", num_classes=3):
+    from flax import serialization
+
+    from kfserving_tpu.models import create_model, init_params
+
+    model_dir = os.path.join(str(tmp_path), name)
+    os.makedirs(model_dir, exist_ok=True)
+    ak = {"input_dim": 4, "features": [8], "num_classes": num_classes}
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump({"architecture": "mlp", "arch_kwargs": ak,
+                   "max_latency_ms": 5, "warmup": False}, f)
+    spec = create_model("mlp", **ak)
+    with open(os.path.join(model_dir, "checkpoint.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(init_params(spec, seed=0)))
+    return model_dir
+
+
+@asynccontextmanager
+async def grpc_server(models, **kwargs):
+    server = ModelServer(http_port=0, grpc_port=0, **kwargs)
+    await server.start_async(models, host="127.0.0.1")
+    channel = grpc.aio.insecure_channel(f"127.0.0.1:{server.grpc_port}")
+    try:
+        yield server, channel
+    finally:
+        await channel.close()
+        await server.stop_async()
+
+
+def _method(channel, name, req_cls, resp_cls,
+            service="inference.GRPCInferenceService"):
+    return channel.unary_unary(
+        f"/{service}/{name}",
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=resp_cls.FromString)
+
+
+async def test_grpc_health_and_metadata(tmp_path):
+    from kfserving_tpu.predictors.jax_model import JaxModel
+
+    model = JaxModel("m", _write_mlp_dir(tmp_path))
+    model.load()
+    async with grpc_server([model]) as (server, channel):
+        live = await _method(channel, "ServerLive", pb2.ServerLiveRequest,
+                             pb2.ServerLiveResponse)(
+            pb2.ServerLiveRequest())
+        assert live.live
+
+        ready = await _method(channel, "ServerReady",
+                              pb2.ServerReadyRequest,
+                              pb2.ServerReadyResponse)(
+            pb2.ServerReadyRequest())
+        assert ready.ready
+
+        mready = await _method(channel, "ModelReady",
+                               pb2.ModelReadyRequest,
+                               pb2.ModelReadyResponse)(
+            pb2.ModelReadyRequest(name="m"))
+        assert mready.ready
+        missing = await _method(channel, "ModelReady",
+                                pb2.ModelReadyRequest,
+                                pb2.ModelReadyResponse)(
+            pb2.ModelReadyRequest(name="nope"))
+        assert not missing.ready
+
+        meta = await _method(channel, "ServerMetadata",
+                             pb2.ServerMetadataRequest,
+                             pb2.ServerMetadataResponse)(
+            pb2.ServerMetadataRequest())
+        assert meta.name == "kfserving-tpu"
+        assert "model_repository" in list(meta.extensions)
+
+        mmeta = await _method(channel, "ModelMetadata",
+                              pb2.ModelMetadataRequest,
+                              pb2.ModelMetadataResponse)(
+            pb2.ModelMetadataRequest(name="m"))
+        assert mmeta.name == "m"
+        assert mmeta.platform == "jax"
+
+
+async def test_grpc_infer_typed_contents(tmp_path):
+    from kfserving_tpu.predictors.jax_model import JaxModel
+
+    model = JaxModel("m", _write_mlp_dir(tmp_path))
+    model.load()
+    async with grpc_server([model]) as (server, channel):
+        req = pb2.ModelInferRequest(model_name="m", id="req-7")
+        t = req.inputs.add()
+        t.name = "input_0"
+        t.datatype = "FP32"
+        t.shape.extend([2, 4])
+        t.contents.fp32_contents.extend(
+            np.ones(8, np.float32).tolist())
+        resp = await _method(channel, "ModelInfer",
+                             pb2.ModelInferRequest,
+                             pb2.ModelInferResponse)(req)
+        assert resp.model_name == "m"
+        assert resp.id == "req-7"
+        assert len(resp.outputs) == 1
+        out = resp.outputs[0]
+        assert out.datatype == "FP32"
+        assert list(out.shape) == [2, 3]
+        assert len(out.contents.fp32_contents) == 6
+
+        # identical rows -> identical logits
+        vals = np.array(out.contents.fp32_contents).reshape(2, 3)
+        np.testing.assert_allclose(vals[0], vals[1], rtol=1e-6)
+
+
+async def test_grpc_infer_raw_contents_roundtrip(tmp_path):
+    """raw_input_contents in -> raw_output_contents out; parity with the
+    typed path."""
+    from kfserving_tpu.predictors.jax_model import JaxModel
+
+    model = JaxModel("m", _write_mlp_dir(tmp_path))
+    model.load()
+    async with grpc_server([model]) as (server, channel):
+        x = np.random.default_rng(0).normal(size=(2, 4)).astype(np.float32)
+
+        raw_req = pb2.ModelInferRequest(model_name="m")
+        t = raw_req.inputs.add()
+        t.name = "input_0"
+        t.datatype = "FP32"
+        t.shape.extend([2, 4])
+        raw_req.raw_input_contents.append(x.tobytes())
+
+        typed_req = pb2.ModelInferRequest(model_name="m")
+        t2 = typed_req.inputs.add()
+        t2.name = "input_0"
+        t2.datatype = "FP32"
+        t2.shape.extend([2, 4])
+        t2.contents.fp32_contents.extend(x.ravel().tolist())
+
+        infer = _method(channel, "ModelInfer", pb2.ModelInferRequest,
+                        pb2.ModelInferResponse)
+        raw_resp = await infer(raw_req)
+        typed_resp = await infer(typed_req)
+
+        assert len(raw_resp.raw_output_contents) == 1
+        raw_vals = np.frombuffer(
+            raw_resp.raw_output_contents[0], np.float32).reshape(2, 3)
+        typed_vals = np.array(
+            typed_resp.outputs[0].contents.fp32_contents).reshape(2, 3)
+        np.testing.assert_allclose(raw_vals, typed_vals, rtol=1e-5)
+
+
+async def test_grpc_infer_errors(tmp_path):
+    from kfserving_tpu.predictors.jax_model import JaxModel
+
+    model = JaxModel("m", _write_mlp_dir(tmp_path))
+    model.load()
+    async with grpc_server([model]) as (server, channel):
+        infer = _method(channel, "ModelInfer", pb2.ModelInferRequest,
+                        pb2.ModelInferResponse)
+        # unknown model -> NOT_FOUND
+        req = pb2.ModelInferRequest(model_name="ghost")
+        t = req.inputs.add()
+        t.name, t.datatype = "input_0", "FP32"
+        t.shape.extend([1, 4])
+        t.contents.fp32_contents.extend([1, 2, 3, 4])
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await infer(req)
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+        # shape/data mismatch -> INVALID_ARGUMENT
+        bad = pb2.ModelInferRequest(model_name="m")
+        t = bad.inputs.add()
+        t.name, t.datatype = "input_0", "FP32"
+        t.shape.extend([2, 4])
+        t.contents.fp32_contents.extend([1.0])  # 1 value for shape 2x4
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await infer(bad)
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+async def test_grpc_repository_extension(tmp_path):
+    """Load/unload/index over gRPC against the multi-model repository
+    (the MMS contract the agent puller drives)."""
+    from kfserving_tpu.predictors.jaxserver import JaxModelRepository
+
+    _write_mlp_dir(tmp_path, name="alpha")
+    _write_mlp_dir(tmp_path, name="beta")
+    repo = JaxModelRepository(models_dir=str(tmp_path))
+    async with grpc_server([], registered_models=repo) as (server, channel):
+        load = _method(channel, "RepositoryModelLoad",
+                       pb2.RepositoryModelLoadRequest,
+                       pb2.RepositoryModelLoadResponse,
+                       service="inference.ModelRepositoryService")
+        unload = _method(channel, "RepositoryModelUnload",
+                         pb2.RepositoryModelUnloadRequest,
+                         pb2.RepositoryModelUnloadResponse,
+                         service="inference.ModelRepositoryService")
+        index = _method(channel, "RepositoryIndex",
+                        pb2.RepositoryIndexRequest,
+                        pb2.RepositoryIndexResponse,
+                        service="inference.ModelRepositoryService")
+
+        await load(pb2.RepositoryModelLoadRequest(model_name="alpha"))
+        await load(pb2.RepositoryModelLoadRequest(model_name="beta"))
+        idx = await index(pb2.RepositoryIndexRequest())
+        assert sorted(m.name for m in idx.models) == ["alpha", "beta"]
+        assert all(m.state == "READY" for m in idx.models)
+
+        # infer against a repository-loaded model
+        infer = _method(channel, "ModelInfer", pb2.ModelInferRequest,
+                        pb2.ModelInferResponse)
+        req = pb2.ModelInferRequest(model_name="alpha")
+        t = req.inputs.add()
+        t.name, t.datatype = "input_0", "FP32"
+        t.shape.extend([1, 4])
+        t.contents.fp32_contents.extend([1, 2, 3, 4])
+        resp = await infer(req)
+        assert list(resp.outputs[0].shape) == [1, 3]
+
+        await unload(pb2.RepositoryModelUnloadRequest(model_name="beta"))
+        idx = await index(pb2.RepositoryIndexRequest(ready=True))
+        assert [m.name for m in idx.models] == ["alpha"]
+
+        with pytest.raises(grpc.aio.AioRpcError) as exc:
+            await unload(pb2.RepositoryModelUnloadRequest(
+                model_name="ghost"))
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+
+async def test_grpc_raw_bytes_length_prefixed():
+    """Raw BYTES tensors use the V2 4-byte-length-prefixed framing in
+    both directions."""
+    from kfserving_tpu.model.model import Model
+
+    class EchoBytes(Model):
+        def load(self):
+            self.ready = True
+            return True
+
+        async def predict(self, request):
+            named = request.named_numpy() if hasattr(
+                request, "named_numpy") else request
+            arr = named["text"]
+            import kfserving_tpu.protocol.v2 as v2
+
+            return v2.make_response("echo", {"text_out": arr})
+
+    model = EchoBytes("echo")
+    model.load()
+    async with grpc_server([model]) as (server, channel):
+        req = pb2.ModelInferRequest(model_name="echo")
+        t = req.inputs.add()
+        t.name, t.datatype = "text", "BYTES"
+        t.shape.extend([2])
+        import struct
+
+        payload = b"".join(
+            struct.pack("<I", len(s)) + s for s in (b"hello", b"wo"))
+        req.raw_input_contents.append(payload)
+        resp = await _method(channel, "ModelInfer",
+                             pb2.ModelInferRequest,
+                             pb2.ModelInferResponse)(req)
+        assert len(resp.raw_output_contents) == 1
+        raw = resp.raw_output_contents[0]
+        (l1,) = struct.unpack_from("<I", raw, 0)
+        first = raw[4:4 + l1]
+        (l2,) = struct.unpack_from("<I", raw, 4 + l1)
+        second = raw[8 + l1:8 + l1 + l2]
+        assert first == b"hello" and second == b"wo"
