@@ -79,7 +79,9 @@ class InProcessOrchestrator:
         # the per-service-account env lands in os.environ at build time
         # (single-host dev mode — subprocess replicas get isolated env).
         self.credentials = credentials
-        self._applied_cred_keys: set = set()
+        # Serializes credentialed builds: env mutation + load must not
+        # interleave across service accounts (shared os.environ).
+        self._cred_lock = asyncio.Lock()
         self.state: Dict[str, _ComponentState] = {}
 
     def replicas(self, component_id: str) -> List[Replica]:
@@ -95,16 +97,29 @@ class InProcessOrchestrator:
 
             env = self.credentials.build_env(
                 getattr(spec, "service_account_name", "default"))
-            # Clear keys a previous service account set but this one
-            # doesn't: stale AWS_* vars must not leak across accounts.
-            for stale in self._applied_cred_keys - set(env):
-                os.environ.pop(stale, None)
-            os.environ.update(env)
-            self._applied_cred_keys = set(env)
-        model = self.model_factory(component_id, spec)
-        if model is not None and not model.ready:
-            loop = asyncio.get_running_loop()
-            await loop.run_in_executor(None, model.load)
+            # Hold the lock across env-set + load so a concurrent build
+            # for another service account can't swap credentials out
+            # from under this model's storage download; restore the
+            # ambient values afterwards.
+            async with self._cred_lock:
+                saved = {k: os.environ.get(k) for k in env}
+                os.environ.update(env)
+                try:
+                    model = self.model_factory(component_id, spec)
+                    if model is not None and not model.ready:
+                        loop = asyncio.get_running_loop()
+                        await loop.run_in_executor(None, model.load)
+                finally:
+                    for k, old in saved.items():
+                        if old is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = old
+        else:
+            model = self.model_factory(component_id, spec)
+            if model is not None and not model.ready:
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(None, model.load)
         server = ModelServer(
             http_port=0, enable_docs=False,
             container_concurrency=getattr(

@@ -173,17 +173,25 @@ class IngressRouter:
         self.inflight[cid] = self.inflight.get(cid, 0) + 1
         self.request_count[cid] = self.request_count.get(cid, 0) + 1
         try:
+            from kfserving_tpu.tracing import REQUEST_ID_HEADER
+
             headers = {k: v for k, v in req.headers.items()
                        if k.lower() not in ("host", "content-length",
                                             "connection")}
+            # Mint the request id at ingress so router, replica, and
+            # engine spans all share one trace id.
+            if REQUEST_ID_HEADER not in headers:
+                import uuid
+
+                headers[REQUEST_ID_HEADER] = uuid.uuid4().hex[:16]
             async with self._session.request(
                     req.method, url, data=req.body or None,
                     headers=headers) as upstream:
                 body = await upstream.read()
                 resp_headers = {
                     k: v for k, v in upstream.headers.items()
-                    if k.lower() in ("content-type",) or
-                    k.lower().startswith("ce-")}
+                    if k.lower() in ("content-type", REQUEST_ID_HEADER)
+                    or k.lower().startswith("ce-")}
                 return Response(body=body, status=upstream.status,
                                 headers=resp_headers)
         except Exception as e:

@@ -19,7 +19,9 @@ new native heart:
 
 import asyncio
 import concurrent.futures
+import contextvars
 import logging
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -28,6 +30,48 @@ import numpy as np
 from kfserving_tpu.engine.buckets import BucketPolicy
 
 logger = logging.getLogger("kfserving_tpu.engine")
+
+
+def device_peak_flops() -> Optional[float]:
+    """Peak dense-matmul FLOP/s of the serving chip (bf16), for MFU.
+
+    Override with KFS_PEAK_FLOPS.  Returns None when unknown (CPU
+    backend) — stats then omit the MFU line rather than fake it.
+    """
+    env = os.getenv("KFS_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    for marker, peak in (("v5 lite", 197e12), ("v5e", 197e12),
+                        ("v5p", 459e12), ("v6", 918e12),
+                        ("v4", 275e12), ("v3", 123e12), ("v2", 45e12)):
+        if marker in kind:
+            return peak
+    return None
+
+
+def _params_on_single_device(jax, params) -> bool:
+    """True when every param leaf lives on one device — then the engine
+    issues an explicit async device_put so batch N+1's host->HBM
+    transfer overlaps batch N's compute.  Mesh-sharded params skip the
+    explicit put: jit handles SPMD placement."""
+    try:
+        for leaf in jax.tree.leaves(params):
+            sharding = getattr(leaf, "sharding", None)
+            device_set = getattr(sharding, "device_set", None)
+            if device_set is not None and len(device_set) > 1:
+                return False
+        return True
+    except Exception:
+        return False
 
 
 class JaxEngine:
@@ -77,6 +121,15 @@ class JaxEngine:
         self.execute_count = 0
         self.last_execute_ms = 0.0
         self.padded_waste_total = 0.0
+        # Device-vs-host breakdown (VERDICT r1 #3): where a request's
+        # milliseconds actually go, and achieved FLOP/s vs chip peak.
+        self.prepare_ms_total = 0.0   # host: pad/stack/dtype
+        self.device_ms_total = 0.0    # dispatch -> block_until_ready
+        self.fetch_ms_total = 0.0     # device -> host slice
+        self.flops_total = 0.0
+        self._flops_by_bucket: Dict[Any, float] = {}
+        self._explicit_transfer = _params_on_single_device(jax, params)
+        self._peak_flops = device_peak_flops()
 
     # -- shape plumbing ------------------------------------------------------
     def _pad_to_bucket(self, arr: np.ndarray) -> Tuple[np.ndarray, int]:
@@ -122,24 +175,50 @@ class JaxEngine:
 
     # -- execution -----------------------------------------------------------
     def _execute_sync(self, inputs: Any) -> Any:
-        padded, n = self._prepare(inputs)
-        start = time.perf_counter()
-        out = self._jitted(self.params, padded)
-        out = self._jax.block_until_ready(out)
-        bucket = (padded[next(iter(padded))] if isinstance(padded, dict)
-                  else padded).shape[0]
-        with self._stats_lock:
-            self.last_execute_ms = (time.perf_counter() - start) * 1000.0
-            self.execute_count += 1
-            self.padded_waste_total += (bucket - n) / bucket
-        # Slice back to the true batch size on host.
-        return self._jax.tree.map(lambda a: np.asarray(a)[:n], out)
+        from kfserving_tpu.tracing import tracer
+
+        with tracer.span("engine.execute") as span:
+            t0 = time.perf_counter()
+            padded, n = self._prepare(inputs)
+            t1 = time.perf_counter()
+            if self._explicit_transfer:
+                # Async H2D dispatch: with pipeline_depth worker threads,
+                # this thread's transfer overlaps another thread's
+                # in-flight compute (double buffering across the PCIe /
+                # tunnel hop).
+                padded = self._jax.device_put(padded)
+            out = self._jitted(self.params, padded)
+            out = self._jax.block_until_ready(out)
+            t2 = time.perf_counter()
+            result = self._jax.tree.map(lambda a: np.asarray(a)[:n], out)
+            t3 = time.perf_counter()
+            bucket = (padded[next(iter(padded))]
+                      if isinstance(padded, dict) else padded).shape[0]
+            span.update(batch=n, bucket=int(bucket),
+                        prepare_ms=round((t1 - t0) * 1e3, 3),
+                        device_ms=round((t2 - t1) * 1e3, 3),
+                        fetch_ms=round((t3 - t2) * 1e3, 3))
+            with self._stats_lock:
+                self.last_execute_ms = (t2 - t1) * 1000.0
+                self.execute_count += 1
+                self.padded_waste_total += (bucket - n) / bucket
+                self.prepare_ms_total += (t1 - t0) * 1e3
+                self.device_ms_total += (t2 - t1) * 1e3
+                self.fetch_ms_total += (t3 - t2) * 1e3
+                self.flops_total += self._flops_by_bucket.get(
+                    int(bucket), 0.0)
+        return result
 
     async def predict(self, inputs: Any) -> Any:
-        """Async batch predict: pads, executes on device off-loop, unpads."""
+        """Async batch predict: pads, executes on device off-loop, unpads.
+
+        The caller's context (request-id contextvar) rides into the
+        worker thread so engine spans attach to the request's trace.
+        """
         loop = asyncio.get_running_loop()
+        ctx = contextvars.copy_context()
         return await loop.run_in_executor(
-            self._executor, self._execute_sync, inputs)
+            self._executor, ctx.run, self._execute_sync, inputs)
 
     def predict_sync(self, inputs: Any) -> Any:
         return self._execute_sync(inputs)
@@ -158,10 +237,27 @@ class JaxEngine:
                 batch = np.stack([np.asarray(example)] * b)
             self._execute_sync(batch)
             self.compile_count += 1
+            self._record_flops(b, batch)
         dt = time.perf_counter() - start
         logger.info("warmup compiled %d buckets in %.1fs",
                     len(buckets or self.batch_buckets.buckets), dt)
         return dt
+
+    def _record_flops(self, bucket: int, batch: Any) -> None:
+        """XLA's cost model for this bucket's program (feeds the
+        achieved-FLOP/s / MFU stats).  Reads the analysis from the
+        *lowered* module — no backend compile, so warmup stays one
+        compile per bucket."""
+        try:
+            analysis = self._jitted.lower(
+                self.params, batch).cost_analysis()
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0] if analysis else {}
+            flops = float(analysis.get("flops", 0.0))
+            if flops > 0:
+                self._flops_by_bucket[int(bucket)] = flops
+        except Exception as exc:  # cost model optional, never fatal
+            logger.debug("cost_analysis unavailable: %s", exc)
 
     def param_bytes(self) -> int:
         """Total parameter bytes (HBM residency of this model's weights)."""
@@ -187,10 +283,22 @@ class JaxEngine:
         self.params = None
 
     def stats(self) -> Dict[str, float]:
-        return {
-            "execute_count": self.execute_count,
-            "compile_count": self.compile_count,
-            "last_execute_ms": self.last_execute_ms,
-            "avg_pad_waste": (self.padded_waste_total / self.execute_count
-                              if self.execute_count else 0.0),
-        }
+        with self._stats_lock:
+            n = self.execute_count
+            out = {
+                "execute_count": n,
+                "compile_count": self.compile_count,
+                "last_execute_ms": self.last_execute_ms,
+                "avg_pad_waste": (self.padded_waste_total / n
+                                  if n else 0.0),
+                "avg_prepare_ms": self.prepare_ms_total / n if n else 0.0,
+                "avg_device_ms": self.device_ms_total / n if n else 0.0,
+                "avg_fetch_ms": self.fetch_ms_total / n if n else 0.0,
+            }
+            device_s = self.device_ms_total / 1e3
+            if self.flops_total > 0 and device_s > 0:
+                achieved = self.flops_total / device_s
+                out["achieved_tflops"] = achieved / 1e12
+                if self._peak_flops:
+                    out["mfu"] = achieved / self._peak_flops
+        return out

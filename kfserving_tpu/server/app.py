@@ -190,6 +190,10 @@ class ModelServer:
         r.add("POST", "/v2/repository/models/{name}/unload", self._unload)
         r.add("GET", "/v2/repository/index", self._repository_index)
         r.add("GET", "/metrics", self._metrics)
+        # Tracing/profiling surface (SURVEY §5.1).
+        r.add("GET", "/debug/traces", self._traces)
+        r.add("POST", "/debug/profiler/start", self._profiler_start)
+        r.add("POST", "/debug/profiler/stop", self._profiler_stop)
 
     # -- handlers ----------------------------------------------------------
     async def _live(self, req: Request) -> Response:
@@ -236,7 +240,13 @@ class ModelServer:
         return await self._inference(req, "explain", self.dataplane.explain)
 
     async def _inference(self, req: Request, verb: str, op) -> Response:
+        from kfserving_tpu.tracing import (
+            REQUEST_ID_HEADER,
+            ensure_request_id,
+        )
+
         name = req.path_params["name"]
+        rid = ensure_request_id(req.headers)
         start = time.perf_counter()
         if self._admission is not None:
             if not await self._admission.enter():
@@ -251,21 +261,30 @@ class ModelServer:
                         hook(name, verb, req, resp, latency_ms)
                     except Exception:
                         logger.exception("request hook failed")
+                resp.headers[REQUEST_ID_HEADER] = rid
                 return resp
             try:
-                return await self._inference_inner(
+                resp = await self._inference_inner(
                     req, verb, op, name, start)
             finally:
                 self._admission.exit()
-        return await self._inference_inner(req, verb, op, name, start)
+        else:
+            resp = await self._inference_inner(req, verb, op, name, start)
+        resp.headers[REQUEST_ID_HEADER] = rid
+        return resp
 
     async def _inference_inner(self, req: Request, verb: str, op,
                                name: str, start: float) -> Response:
+        from kfserving_tpu.tracing import tracer
+
         status = 200
         try:
-            body = self.dataplane.decode_body(req.headers, req.body)
-            response = await op(name, body)
-            resp = self._encode_response(req, body, response)
+            with tracer.span("server.decode", model=name, verb=verb):
+                body = self.dataplane.decode_body(req.headers, req.body)
+            with tracer.span("server.infer", model=name, verb=verb):
+                response = await op(name, body)
+            with tracer.span("server.encode", model=name, verb=verb):
+                resp = self._encode_response(req, body, response)
         except ServingError as e:
             status = e.status_code
             resp = _error(e)
@@ -315,8 +334,48 @@ class ModelServer:
         return _json(self.dataplane.repository_index())
 
     async def _metrics(self, req: Request) -> Response:
+        # Engine gauges (device/host breakdown, MFU) refresh at scrape.
+        for model in self.repository.get_models():
+            engine_stats = getattr(model, "engine_stats", None)
+            if engine_stats is None:
+                continue
+            try:
+                for key, value in engine_stats().items():
+                    self.metrics.set_gauge(
+                        f"kfserving_tpu_engine_{key}", float(value),
+                        labels={"model": model.name})
+            except Exception:
+                logger.exception("engine stats for %s failed", model.name)
         return Response(self.metrics.render().encode("utf-8"),
                         content_type="text/plain; version=0.0.4")
+
+    async def _traces(self, req: Request) -> Response:
+        from kfserving_tpu.tracing import tracer
+
+        trace_id = req.query.get("trace_id")
+        limit = int(req.query.get("limit", "100"))
+        return _json({"spans": tracer.spans(trace_id, limit)})
+
+    async def _profiler_start(self, req: Request) -> Response:
+        from kfserving_tpu.tracing import profiler
+
+        try:
+            body = json.loads(req.body) if req.body else {}
+        except ValueError:
+            body = {}
+        log_dir = body.get("log_dir", "/tmp/kfs-profile")
+        if not profiler.start(log_dir):
+            return _json({"error": "profiler already active",
+                          "log_dir": profiler.active_dir}, status=409)
+        return _json({"profiling": True, "log_dir": log_dir})
+
+    async def _profiler_stop(self, req: Request) -> Response:
+        from kfserving_tpu.tracing import profiler
+
+        log_dir = profiler.stop()
+        if log_dir is None:
+            return _json({"error": "profiler not active"}, status=409)
+        return _json({"profiling": False, "log_dir": log_dir})
 
     # -- lifecycle ---------------------------------------------------------
     def register_model(self, model: Model) -> None:
@@ -338,8 +397,7 @@ class ModelServer:
             from kfserving_tpu.server.grpc_server import GRPCServer
 
             self.grpc_server = GRPCServer(
-                self.dataplane, port=self.grpc_port,
-                host=host if host != "0.0.0.0" else "[::]")
+                self.dataplane, port=self.grpc_port, host=host)
             await self.grpc_server.start()
             self.grpc_port = self.grpc_server.port
 

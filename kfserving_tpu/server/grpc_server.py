@@ -315,8 +315,15 @@ class GRPCServer:
 
         self._server = grpc.aio.server()
         self._server.add_generic_rpc_handlers(tuple(self._handlers()))
-        self.port = self._server.add_insecure_port(
+        bound = self._server.add_insecure_port(
             f"{self.host}:{self.port}")
+        if bound == 0:
+            # grpc reports bind failure as port 0, not an exception —
+            # indistinguishable from the ephemeral-port request, so
+            # surface it loudly instead of "starting" with no listener.
+            raise RuntimeError(
+                f"gRPC failed to bind {self.host}:{self.port}")
+        self.port = bound
         await self._server.start()
         logger.info("V2 gRPC server on %s:%d", self.host, self.port)
 

@@ -46,8 +46,14 @@ class Metrics:
             self.latency[hkey] = Histogram()
         self.latency[hkey].observe(latency_ms)
 
-    def set_gauge(self, name: str, value: float) -> None:
-        self.gauges[name] = value
+    def set_gauge(self, name: str, value: float,
+                  labels: Dict[str, str] = None) -> None:
+        if labels:
+            label_str = ",".join(
+                f'{k}="{v}"' for k, v in sorted(labels.items()))
+            self.gauges[f"{name}{{{label_str}}}"] = value
+        else:
+            self.gauges[name] = value
 
     def render(self) -> str:
         lines = [
@@ -78,8 +84,12 @@ class Metrics:
             lines.append(
                 f'kfserving_tpu_request_latency_ms_count{{model="{model}",'
                 f'verb="{verb}"}} {hist.total}')
+        typed = set()
         for name, value in sorted(self.gauges.items()):
-            lines.append(f"# TYPE {name} gauge")
+            base = name.split("{", 1)[0]
+            if base not in typed:
+                lines.append(f"# TYPE {base} gauge")
+                typed.add(base)
             lines.append(f"{name} {value}")
         lines.append(
             f"kfserving_tpu_uptime_seconds {time.time() - self.start_time}")

@@ -134,10 +134,19 @@ class Storage:
         # pkg/credentials/https/https_secret.go).
         from kfserving_tpu.storage.credentials import https_headers_for
 
+        cred_headers = https_headers_for(uri)
         headers = {"User-Agent": "kfserving-tpu/0.1"}
-        headers.update(https_headers_for(uri))
+        headers.update(cred_headers)
         req = UrlRequest(uri, headers=headers)
-        with urlopen(req) as response:
+        if cred_headers:
+            # Guarded opener: strips the injected auth on cross-host
+            # redirects.  Without credentials, urlopen's default
+            # redirect handling is fine (nothing to leak).
+            opener = _build_opener_with_safe_redirects(set(cred_headers))
+            response_cm = opener.open(req)
+        else:
+            response_cm = urlopen(req)
+        with response_cm as response:
             if response.status != 200:
                 raise RuntimeError(
                     "URI: %s returned a %s response code." % (uri, response.status))
@@ -240,6 +249,35 @@ class Storage:
             os.makedirs(os.path.dirname(dest) or out_dir, exist_ok=True)
             with open(dest, "wb") as f:
                 f.write(container_client.download_blob(blob.name).readall())
+
+
+def _build_opener_with_safe_redirects(credential_keys):
+    """urlopen forwards request headers across redirects, which would
+    leak a host's Authorization header to whatever host a 302 points at
+    (pre-signed CDN URLs are common for artifacts).  This opener strips
+    the injected credential headers on cross-host hops and re-evaluates
+    the https secrets for the new host."""
+    from urllib.request import HTTPRedirectHandler, build_opener
+
+    from kfserving_tpu.storage.credentials import https_headers_for
+
+    class SafeRedirectHandler(HTTPRedirectHandler):
+        def redirect_request(self, req, fp, code, msg, headers, newurl):
+            new = super().redirect_request(
+                req, fp, code, msg, headers, newurl)
+            if new is None:
+                return None
+            old_host = urlparse(req.full_url).hostname
+            new_host = urlparse(newurl).hostname
+            if old_host != new_host:
+                for key in credential_keys:
+                    new.remove_header(key.capitalize())
+                    new.remove_header(key)
+                for key, value in https_headers_for(newurl).items():
+                    new.add_header(key, value)
+            return new
+
+    return build_opener(SafeRedirectHandler())
 
 
 def _guess_type(filename: str):

@@ -1,0 +1,145 @@
+"""Request tracing: span IDs through router -> server -> engine.
+
+The reference delegates distributed tracing to the Istio/Knative mesh
+(queue-proxy emits request traces, reference test/benchmark/
+README.md:5-12); the TPU build is sidecar-free, so SURVEY §5.1 calls
+for its own spans plus `jax.profiler` hooks around compile/execute.
+
+Design: a process-wide ring buffer of completed spans plus a
+contextvar carrying the current request id.  The request id enters at
+the ingress router (or is minted at the server) via the
+``x-request-id`` header, rides the contextvar through the asyncio
+handler and — via ``contextvars.copy_context`` — into the engine's
+worker threads, so engine sub-spans (prepare/transfer/compute/fetch)
+attach to the request that caused them.  Spans are queryable at
+``GET /debug/traces`` and logged at DEBUG.
+
+The `jax.profiler` toggle (``POST /debug/profiler/start|stop``) wraps
+``jax.profiler.start_trace`` for on-demand XLA-level traces.
+"""
+
+import contextlib
+import contextvars
+import logging
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger("kfserving_tpu.tracing")
+
+REQUEST_ID_HEADER = "x-request-id"
+
+# Current request id; propagated into engine worker threads by running
+# the executor callable under contextvars.copy_context().
+current_request_id: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("kfs_request_id", default=None)
+
+
+@dataclass
+class Span:
+    trace_id: str
+    name: str
+    start: float          # time.time() epoch seconds
+    duration_ms: float
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"trace_id": self.trace_id, "name": self.name,
+                "start": self.start, "duration_ms": self.duration_ms,
+                "attrs": self.attrs}
+
+
+class Tracer:
+    """Process-wide completed-span ring buffer (bounded, lock-guarded)."""
+
+    def __init__(self, capacity: int = 512):
+        self._spans: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.enabled = True
+
+    def record(self, span: Span) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._spans.append(span)
+        logger.debug("span %s %s %.2fms %s", span.trace_id, span.name,
+                     span.duration_ms, span.attrs)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        """Time a block; attaches to the current request id (or 'untraced').
+        Yields a dict the block may add attributes to."""
+        trace_id = current_request_id.get() or "untraced"
+        start_wall = time.time()
+        start = time.perf_counter()
+        span_attrs: Dict[str, Any] = dict(attrs)
+        try:
+            yield span_attrs
+        finally:
+            self.record(Span(trace_id, name, start_wall,
+                             (time.perf_counter() - start) * 1000.0,
+                             span_attrs))
+
+    def spans(self, trace_id: Optional[str] = None,
+              limit: int = 100) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = list(self._spans)
+        if trace_id is not None:
+            items = [s for s in items if s.trace_id == trace_id]
+        return [s.to_dict() for s in items[-limit:]]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+# The process tracer (one serving process = one trace sink).
+tracer = Tracer()
+
+
+def ensure_request_id(headers: Dict[str, str]) -> str:
+    """Read (or mint) the request id for an incoming request and set the
+    contextvar.  Returns the id so responses can echo it."""
+    rid = headers.get(REQUEST_ID_HEADER) or uuid.uuid4().hex[:16]
+    current_request_id.set(rid)
+    return rid
+
+
+class ProfilerControl:
+    """On-demand jax.profiler trace capture (SURVEY §5.1)."""
+
+    def __init__(self):
+        self._active_dir: Optional[str] = None
+        self._lock = threading.Lock()
+
+    @property
+    def active_dir(self) -> Optional[str]:
+        return self._active_dir
+
+    def start(self, log_dir: str) -> bool:
+        import jax
+
+        with self._lock:
+            if self._active_dir is not None:
+                return False
+            jax.profiler.start_trace(log_dir)
+            self._active_dir = log_dir
+            logger.info("jax.profiler trace -> %s", log_dir)
+            return True
+
+    def stop(self) -> Optional[str]:
+        import jax
+
+        with self._lock:
+            if self._active_dir is None:
+                return None
+            jax.profiler.stop_trace()
+            out, self._active_dir = self._active_dir, None
+            logger.info("jax.profiler trace stopped (%s)", out)
+            return out
+
+
+profiler = ProfilerControl()

@@ -369,9 +369,10 @@ def test_s3_verify_ssl_disables_cert_check(tmp_path, fake_minio,
     assert fake_minio["http_client"] is not None  # cert check disabled
 
 
-def test_inprocess_orchestrator_clears_stale_cred_env(monkeypatch):
-    """SA 'a' sets AWS keys; a later replica under SA 'b' (no S3 secret)
-    must NOT inherit them (cross-account leak)."""
+def test_inprocess_orchestrator_scopes_cred_env(monkeypatch):
+    """Credential env is visible during the replica's build/load only,
+    and restored afterwards — SA 'a' keys never leak to a later build
+    under SA 'b', nor linger in the process env."""
     import asyncio
 
     from kfserving_tpu.control.orchestrator import InProcessOrchestrator
@@ -379,23 +380,81 @@ def test_inprocess_orchestrator_clears_stale_cred_env(monkeypatch):
     store = CredentialStore.from_dict({
         "serviceAccounts": {"a": ["my-s3"], "b": []},
         "secrets": {"my-s3": STORE["secrets"]["my-s3"]}})
-    orch = InProcessOrchestrator(
-        model_factory=lambda cid, spec: None, credentials=store)
+    seen = {}
+
+    def factory(cid, spec):
+        seen[cid] = os.environ.get("AWS_ACCESS_KEY_ID")
+        return None
+
+    orch = InProcessOrchestrator(model_factory=factory,
+                                 credentials=store)
 
     from kfserving_tpu.control.spec import PredictorSpec
 
-    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AMBIENT")
 
     async def run():
         ra = await orch.create_replica(
             "default/a/predictor", "r1",
             PredictorSpec(service_account_name="a"))
-        assert os.environ["AWS_ACCESS_KEY_ID"] == "AKID123"
+        # restored to the ambient value, not left at the secret's
+        assert os.environ["AWS_ACCESS_KEY_ID"] == "AMBIENT"
         rb = await orch.create_replica(
             "default/b/predictor", "r1",
             PredictorSpec(service_account_name="b"))
-        assert "AWS_ACCESS_KEY_ID" not in os.environ
         await orch.delete_replica(ra)
         await orch.delete_replica(rb)
 
     asyncio.run(run())
+    assert seen["default/a/predictor"] == "AKID123"   # during build
+    assert seen["default/b/predictor"] == "AMBIENT"   # no leak from a
+
+
+def test_redirect_strips_auth_cross_host(tmp_path, monkeypatch):
+    """A 302 from the configured host to another host must NOT carry
+    the Authorization header along (pre-signed CDN URL pattern)."""
+    import http.server
+    import threading
+
+    received = {}
+
+    class Target(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            received[self.server.server_port] = dict(self.headers)
+            if self.server.server_port == ports["origin"]:
+                self.send_response(302)
+                self.send_header(
+                    "Location",
+                    f"http://127.0.0.2:{ports['cdn']}{self.path}")
+                self.end_headers()
+            else:
+                payload = b"WEIGHTS"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    origin = http.server.HTTPServer(("127.0.0.1", 0), Target)
+    cdn = http.server.HTTPServer(("127.0.0.2", 0), Target)
+    ports = {"origin": origin.server_port, "cdn": cdn.server_port}
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in (origin, cdn)]
+    [t.start() for t in threads]
+    try:
+        monkeypatch.setenv(
+            "KFS_HTTPS_HEADERS",
+            json.dumps({"127.0.0.1": {"Authorization": "Bearer tok"}}))
+        out = Storage.download(
+            f"http://127.0.0.1:{ports['origin']}/model.bin",
+            str(tmp_path / "out"))
+        assert open(os.path.join(out, "model.bin"), "rb").read() == \
+            b"WEIGHTS"
+        assert received[ports["origin"]].get("Authorization") == \
+            "Bearer tok"
+        assert "Authorization" not in received[ports["cdn"]]
+    finally:
+        origin.shutdown()
+        cdn.shutdown()
