@@ -1,149 +1,100 @@
-"""Headline benchmark: ResNet-50 V1 predict throughput through the serving
-stack on one chip, vs the CPU torch predictor path it replaces.
+"""Benchmark entry: the full BASELINE.json matrix through the real
+HTTP serving stack, headline = ResNet-50 V1 predict req/s/chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints ONE JSON line:
+    {"metric", "value", "unit", "vs_baseline", ..., "configs": {...}}
+and writes the full detail to BENCH_DETAIL.json.
 
-What is measured (BASELINE.json north star): concurrent single-image V1
-predict requests flowing through the in-process dynamic batcher into the
-bucketed jit engine — i.e. the actual serving hot path, not a raw matmul
-loop.  The baseline is the reference's CPU pytorchserver execution model:
-torch ResNet-50, one `model(x)` per request (reference
-python/pytorchserver/pytorchserver/model.py predicts per-request with no
-batching).  Target: >= 10x at equal-or-better p99.
+All five BASELINE configs run end-to-end over live sockets (tensorjson
+parse, asyncio server, batcher, engine all in the measured path):
+  1 iris sklearn SVC      — fixed-rate sweep 5/50/500 QPS + peak
+  2 ResNet-50 jaxserver   — headline throughput, p50/p99, engine MFU
+  3 BERT seq-bucketed     — mixed-length fixed rate + peak
+  4 8-model hot-swap      — repository load/unload + round-robin
+  5 transformer->ViT      — chained through the ingress router
+
+vs_baseline: ResNet throughput vs the reference's CPU execution model
+(torch ResNet-50, per-request batch=1 — the pytorchserver pattern,
+reference python/pytorchserver/pytorchserver/model.py).
+
+Smoke mode (auto on CPU backend, or BENCH_SMOKE=1): tiny models, short
+runs — the same code paths hermetically in ~a minute.
 """
 
 import asyncio
 import json
 import os
-import statistics
-import time
-
-NUM_REQUESTS = int(os.environ.get("BENCH_REQUESTS", "512"))
-CONCURRENCY = int(os.environ.get("BENCH_CONCURRENCY", "64"))
-CPU_BASELINE_REQUESTS = int(os.environ.get("BENCH_CPU_REQUESTS", "20"))
-MAX_BATCH = int(os.environ.get("BENCH_MAX_BATCH", "32"))
-# BENCH_MODEL=mlp gives a seconds-long CPU smoke run of the same pipeline.
-MODEL = os.environ.get("BENCH_MODEL", "resnet50")
-IMAGE = (224, 224, 3)
+import sys
+import traceback
 
 
-def _tpu_serving_throughput():
-    import numpy as np
-
-    from kfserving_tpu.batching import DynamicBatcher
-    from kfserving_tpu.engine.buckets import BucketPolicy
-    from kfserving_tpu.engine.compile_cache import enable as enable_cache
-    from kfserving_tpu.engine.jax_engine import JaxEngine
-    from kfserving_tpu.models import apply_fn_for, create_model, init_params
-
-    import jax.numpy as jnp
-
-    enable_cache()
-    spec = create_model(MODEL)
-    variables = init_params(spec, seed=0)
-    apply = apply_fn_for(spec)
-    shape = tuple(int(d) for d in np.asarray(spec.example).shape[1:]) \
-        if not isinstance(spec.example, dict) else IMAGE
-
-    image_model = MODEL.startswith(("resnet", "vit"))
-    if image_model:
-        # Serving-shaped I/O: clients send uint8 pixels (4x fewer bytes on
-        # the host->HBM path than float32 — which dominates end-to-end cost);
-        # normalization runs on-device, and the response is the argmax label
-        # (4 bytes/instance down instead of the full logit row).
-        def serve_fn(v, x):
-            xf = x.astype(jnp.bfloat16) * (1.0 / 255.0)
-            return jnp.argmax(apply(v, xf), axis=-1).astype(jnp.int32)
-
-        example = np.zeros(shape, np.uint8)
-        rng = np.random.default_rng(0)
-        image = rng.integers(0, 256, size=shape).astype(np.uint8)
-    else:
-        serve_fn = apply
-        example = np.zeros(shape, np.float32)
-        rng = np.random.default_rng(0)
-        image = rng.normal(size=shape).astype("float32")
-
-    engine = JaxEngine(serve_fn, variables,
-                       batch_buckets=BucketPolicy.pow2(MAX_BATCH))
-    compile_s = engine.warmup(example)
-
-    async def batch_handler(instances):
-        out = await engine.predict(np.stack(instances))
-        return list(np.asarray(out))
-
-    async def run():
-        batcher = DynamicBatcher(batch_handler, max_batch_size=MAX_BATCH,
-                                 max_latency_ms=5)
-        latencies = []
-        sem = asyncio.Semaphore(CONCURRENCY)
-
-        async def one_request():
-            async with sem:
-                t0 = time.perf_counter()
-                result = await batcher.submit([image])
-                latencies.append((time.perf_counter() - t0) * 1000.0)
-                assert len(result.predictions) == 1
-
-        t0 = time.perf_counter()
-        await asyncio.gather(*[one_request() for _ in range(NUM_REQUESTS)])
-        wall = time.perf_counter() - t0
-        return wall, latencies, batcher
-
-    wall, latencies, batcher = asyncio.run(run())
-    latencies.sort()
-    import math
-
-    p99_idx = min(len(latencies) - 1,
-                  math.ceil(0.99 * len(latencies)) - 1)  # nearest-rank p99
-    return {
-        "req_per_s": NUM_REQUESTS / wall,
-        "p50_ms": statistics.median(latencies),
-        "p99_ms": latencies[p99_idx],
-        "mean_batch": (batcher.instances_batched
-                       / max(batcher.batches_flushed, 1)),
-        "compile_s": compile_s,
-        "backend": __import__("jax").default_backend(),
-    }
-
-
-def _cpu_torch_baseline():
-    """Reference execution model: torch ResNet-50, per-request batch=1 on
-    CPU (transformers' ResNetForImageClassification default config IS
-    ResNet-50: depths [3,4,6,3], hidden [256,512,1024,2048])."""
+def _detect_smoke() -> bool:
+    env = os.environ.get("BENCH_SMOKE")
+    if env is not None:
+        return env not in ("0", "false")
     try:
-        import torch
-        from transformers import ResNetConfig, ResNetForImageClassification
+        import jax
+
+        return jax.default_backend() != "tpu"
     except Exception:
-        return None
-    model = ResNetForImageClassification(ResNetConfig())
-    model.eval()
-    x = torch.randn(1, 3, 224, 224)
-    with torch.no_grad():
-        model(x)  # warm
-        t0 = time.perf_counter()
-        for _ in range(CPU_BASELINE_REQUESTS):
-            model(x)
-        wall = time.perf_counter() - t0
-    return CPU_BASELINE_REQUESTS / wall
+        return True
 
 
 def main():
-    tpu = _tpu_serving_throughput()
-    cpu_req_s = _cpu_torch_baseline()
-    vs = (tpu["req_per_s"] / cpu_req_s) if cpu_req_s else None
-    print(json.dumps({
-        "metric": f"{MODEL}_v1_predict_throughput",
-        "value": round(tpu["req_per_s"], 2),
+    from kfserving_tpu.engine.compile_cache import enable as enable_cache
+
+    enable_cache()
+    smoke = _detect_smoke()
+    only = [c for c in os.environ.get("BENCH_CONFIGS", "").split(",")
+            if c]
+
+    from benchmarks import configs as C
+
+    matrix = {
+        "resnet": C.bench_resnet,
+        "iris": C.bench_iris,
+        "bert": C.bench_bert,
+        "multimodel": C.bench_multimodel,
+        "chain": C.bench_chain,
+    }
+    results = {}
+    for name, fn in matrix.items():
+        if only and name not in only:
+            continue
+        try:
+            results[name] = asyncio.run(fn(smoke))
+        except Exception:
+            results[name] = {"error": traceback.format_exc(limit=4)}
+            print(f"bench config {name} failed", file=sys.stderr)
+            traceback.print_exc()
+
+    cpu = C.cpu_torch_resnet_baseline(smoke)
+    resnet = results.get("resnet", {})
+    peak = resnet.get("closed_loop", {})
+    value = peak.get("req_per_s")
+    vs = (value / cpu["req_per_s"]
+          if value and cpu.get("req_per_s") else None)
+
+    import jax
+
+    headline = {
+        "metric": "resnet50_v1_predict_http_throughput",
+        "value": round(value, 2) if value else None,
         "unit": "req/s/chip",
-        "vs_baseline": round(vs, 2) if vs is not None else None,
-        "p50_ms": round(tpu["p50_ms"], 2),
-        "p99_ms": round(tpu["p99_ms"], 2),
-        "mean_batch": round(tpu["mean_batch"], 1),
-        "compile_s": round(tpu["compile_s"], 1),
-        "cpu_baseline_req_per_s": round(cpu_req_s, 2) if cpu_req_s else None,
-        "backend": tpu["backend"],
-    }))
+        "vs_baseline": round(vs, 2) if vs else None,
+        "p50_ms": peak.get("p50_ms"),
+        "p99_ms": peak.get("p99_ms"),
+        "mfu": resnet.get("engine", {}).get("mfu"),
+        "compile_s": resnet.get("compile_s"),
+        "cpu_baseline": cpu,
+        "backend": jax.default_backend(),
+        "smoke": smoke,
+        "configs": results,
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_DETAIL.json"), "w") as f:
+        json.dump(headline, f, indent=2)
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,3 @@
+"""Benchmark harness for the TPU serving stack (reference
+test/benchmark equivalent: vegeta-style fixed-rate attacks + the §6
+latency tables, driven through the real HTTP data plane)."""
