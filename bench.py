@@ -31,7 +31,7 @@ import traceback
 def _detect_smoke() -> bool:
     env = os.environ.get("BENCH_SMOKE")
     if env is not None:
-        return env not in ("0", "false")
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
     try:
         import jax
 

@@ -243,9 +243,6 @@ async def bench_multimodel(smoke: bool) -> Dict[str, Any]:
             swap_ms = (time.perf_counter() - swap_t0) / swaps * 1000.0
 
         # round-robin inference across all 8 resident models
-        async def rr_body(i):
-            return body
-
         results = await asyncio.gather(*[
             closed_loop(server.http_port,
                         f"/v1/models/m{i}:predict", body,
@@ -253,7 +250,6 @@ async def bench_multimodel(smoke: bool) -> Dict[str, Any]:
                         concurrency=4)
             for i in range(n_models)])
         total_reqs = sum(r["requests"] for r in results)
-        agg_lat = []
         req_per_s = sum(r["req_per_s"] for r in results)
         p99 = max(r["p99_ms"] for r in results)
         return {"models": n_models,
@@ -306,8 +302,7 @@ async def bench_chain(smoke: bool) -> Dict[str, Any]:
             transformer=TransformerSpec())
         await controller.apply(isvc)
         # transformer proxies through the router's direct predictor lane
-        for comp in orch.state.get("default/vitchain/transformer",
-                                   None).replicas:
+        for comp in orch.state["default/vitchain/transformer"].replicas:
             comp.handle.repository.get_model("vitchain").predictor_host = \
                 f"127.0.0.1:{router.http_port}/direct/predictor"
 

@@ -33,10 +33,11 @@ def percentile(sorted_values: List[float], q: float) -> float:
 
 
 def summarize(latencies_ms: List[float], wall_s: float,
-              errors: int = 0) -> Dict[str, Any]:
+              errors: int = 0,
+              first_error: Optional[str] = None) -> Dict[str, Any]:
     lat = sorted(latencies_ms)
     n = len(lat)
-    return {
+    out = {
         "requests": n + errors,
         "errors": errors,
         "success_rate": n / (n + errors) if (n + errors) else 0.0,
@@ -46,6 +47,11 @@ def summarize(latencies_ms: List[float], wall_s: float,
         "p95_ms": round(percentile(lat, 0.95), 3) if lat else None,
         "p99_ms": round(percentile(lat, 0.99), 3) if lat else None,
     }
+    if first_error:
+        # A failing config must say WHY in the results JSON — an
+        # all-errors run once shipped as silent zeros.
+        out["first_error"] = first_error[:500]
+    return out
 
 
 async def closed_loop(port: int, path: str, body: bytes,
@@ -57,6 +63,7 @@ async def closed_loop(port: int, path: str, body: bytes,
 
     latencies: List[float] = []
     errors = 0
+    first_error: Optional[str] = None
     sem = asyncio.Semaphore(concurrency)
     url = f"http://{host}:{port}{path}"
     connector = aiohttp.TCPConnector(limit=concurrency)
@@ -65,25 +72,30 @@ async def closed_loop(port: int, path: str, body: bytes,
             timeout=aiohttp.ClientTimeout(total=120)) as session:
 
         async def one():
-            nonlocal errors
+            nonlocal errors, first_error
             async with sem:
                 t0 = time.perf_counter()
                 try:
                     async with session.post(
                             url, data=body, headers=headers) as resp:
-                        await resp.read()
+                        payload = await resp.read()
                         if resp.status != 200:
                             errors += 1
+                            if first_error is None:
+                                first_error = (f"HTTP {resp.status}: "
+                                               f"{payload[:300]!r}")
                             return
-                except Exception:
+                except Exception as exc:
                     errors += 1
+                    if first_error is None:
+                        first_error = f"{type(exc).__name__}: {exc}"
                     return
                 latencies.append((time.perf_counter() - t0) * 1000.0)
 
         t0 = time.perf_counter()
         await asyncio.gather(*[one() for _ in range(num_requests)])
         wall = time.perf_counter() - t0
-    return summarize(latencies, wall, errors)
+    return summarize(latencies, wall, errors, first_error)
 
 
 async def open_loop(port: int, path: str,
@@ -99,6 +111,7 @@ async def open_loop(port: int, path: str,
 
     latencies: List[float] = []
     errors = 0
+    first_error: Optional[str] = None
     total = max(1, int(rate_qps * duration_s))
     url = f"http://{host}:{port}{path}"
     connector = aiohttp.TCPConnector(limit=0)
@@ -107,17 +120,22 @@ async def open_loop(port: int, path: str,
             timeout=aiohttp.ClientTimeout(total=120)) as session:
 
         async def one(i: int):
-            nonlocal errors
+            nonlocal errors, first_error
             t0 = time.perf_counter()
             try:
                 async with session.post(
                         url, data=body_fn(i), headers=headers) as resp:
-                    await resp.read()
+                    payload = await resp.read()
                     if resp.status != 200:
                         errors += 1
+                        if first_error is None:
+                            first_error = (f"HTTP {resp.status}: "
+                                           f"{payload[:300]!r}")
                         return
-            except Exception:
+            except Exception as exc:
                 errors += 1
+                if first_error is None:
+                    first_error = f"{type(exc).__name__}: {exc}"
                 return
             latencies.append((time.perf_counter() - t0) * 1000.0)
 
@@ -131,7 +149,7 @@ async def open_loop(port: int, path: str,
             tasks.append(asyncio.ensure_future(one(i)))
         await asyncio.gather(*tasks)
         wall = time.perf_counter() - start
-    out = summarize(latencies, wall, errors)
+    out = summarize(latencies, wall, errors, first_error)
     out["rate_qps"] = rate_qps
     return out
 

@@ -30,6 +30,7 @@ class Replica:
     revision: str          # content hash of the component spec
     host: str              # "127.0.0.1:<port>" (in-process backend)
     handle: object = None  # backend-private
+    placement: object = None  # SlicePlacement for chip-owning replicas
 
 
 @dataclass
@@ -49,10 +50,10 @@ class FakeOrchestrator:
                                    _ComponentState()).replicas)
 
     async def create_replica(self, component_id: str, revision: str,
-                             spec) -> Replica:
+                             spec, placement=None) -> Replica:
         self._port += 1
         replica = Replica(component_id, revision,
-                          f"fake-host:{self._port}")
+                          f"fake-host:{self._port}", placement=placement)
         self.state.setdefault(component_id,
                               _ComponentState()).replicas.append(replica)
         return replica
@@ -89,7 +90,7 @@ class InProcessOrchestrator:
                                    _ComponentState()).replicas)
 
     async def create_replica(self, component_id: str, revision: str,
-                             spec) -> Replica:
+                             spec, placement=None) -> Replica:
         from kfserving_tpu.server.app import ModelServer
 
         if self.credentials is not None:
@@ -127,7 +128,8 @@ class InProcessOrchestrator:
         await server.start_async([model] if model is not None else [],
                                  host="127.0.0.1")
         replica = Replica(component_id, revision,
-                          f"127.0.0.1:{server.http_port}", handle=server)
+                          f"127.0.0.1:{server.http_port}", handle=server,
+                          placement=placement)
         self.state.setdefault(component_id,
                               _ComponentState()).replicas.append(replica)
         logger.info("replica up: %s rev=%s at %s",

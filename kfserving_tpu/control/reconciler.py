@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 
 from kfserving_tpu.control.defaults import apply_defaults
 from kfserving_tpu.control.spec import ComponentSpec, InferenceService
+from kfserving_tpu.control.topology import select_topology
 from kfserving_tpu.control.validation import validate
 
 logger = logging.getLogger("kfserving_tpu.control.reconciler")
@@ -55,6 +56,11 @@ class ComponentStatus:
     previous_revision: str = ""
     traffic: List[TrafficTarget] = field(default_factory=list)
     replicas: int = 0
+    placement: Optional[object] = None  # latest revision's SlicePlacement
+    # Placement per revision: during a canary the previous revision keeps
+    # the slice shape it was resolved with (its parallelism may differ
+    # from the latest spec's).
+    placements: Dict[str, object] = field(default_factory=dict)
 
 
 @dataclass
@@ -108,6 +114,11 @@ class InferenceServiceReconciler:
                                    cstatus: ComponentStatus) -> None:
         cid = self.component_id(isvc, cname)
         new_rev = revision_of(comp)
+        # Slice topology resolution (the accelerator-injector step,
+        # reference mutator.go:113-117 chain): chip-owning predictors get
+        # a placement, everything else None.
+        cstatus.placement = select_topology(comp, isvc.annotations)
+        cstatus.placements[new_rev] = cstatus.placement
 
         if cstatus.latest_revision and cstatus.latest_revision != new_rev:
             cstatus.previous_revision = cstatus.latest_revision
@@ -139,7 +150,11 @@ class InferenceServiceReconciler:
             if canary is None:
                 cstatus.previous_revision = ""
 
-        await self._scale_revisions(cid, desired, comp)
+        # Revisions no longer desired also drop their recorded placement.
+        for rev in set(cstatus.placements) - set(desired):
+            del cstatus.placements[rev]
+        await self._scale_revisions(cid, desired, comp,
+                                    placements=cstatus.placements)
         replicas = self.orchestrator.replicas(cid)
         cstatus.replicas = len(replicas)
         cstatus.ready = all(
@@ -149,8 +164,15 @@ class InferenceServiceReconciler:
 
     async def _scale_revisions(self, cid: str,
                                desired: Dict[str, int],
-                               comp: Optional[ComponentSpec]) -> None:
-        """Converge the orchestrator's replicas to `desired` (rev->count)."""
+                               comp: Optional[ComponentSpec],
+                               placements: Optional[Dict] = None) -> None:
+        """Converge the orchestrator's replicas to `desired` (rev->count).
+
+        placements maps revision -> SlicePlacement: a canary's previous
+        revision scales with the slice shape it was resolved with, never
+        the latest spec's.
+        """
+        placements = placements or {}
         current = self.orchestrator.replicas(cid)
         by_rev: Dict[str, List] = {}
         for r in current:
@@ -164,7 +186,8 @@ class InferenceServiceReconciler:
         for rev, want in desired.items():
             have = len(by_rev.get(rev, []))
             for _ in range(max(0, want - have)):
-                await self.orchestrator.create_replica(cid, rev, comp)
+                await self.orchestrator.create_replica(
+                    cid, rev, comp, placement=placements.get(rev))
 
     async def scale(self, isvc: InferenceService, cname: str,
                     replicas: int) -> None:
@@ -178,5 +201,6 @@ class InferenceServiceReconciler:
         desired = {t.revision: replicas for t in cstatus.traffic
                    if t.percent > 0}
         # revisions with zero traffic keep zero replicas
-        await self._scale_revisions(cid, desired, comp)
+        await self._scale_revisions(cid, desired, comp,
+                                    placements=cstatus.placements)
         cstatus.replicas = len(self.orchestrator.replicas(cid))

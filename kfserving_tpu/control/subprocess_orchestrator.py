@@ -107,7 +107,7 @@ class SubprocessOrchestrator:
 
     # -- lifecycle ----------------------------------------------------------
     async def create_replica(self, component_id: str, revision: str,
-                             spec) -> Replica:
+                             spec, placement=None) -> Replica:
         port = _free_port(self.host)
         argv = self._command(component_id, spec, port)
         env = dict(os.environ)
@@ -121,6 +121,10 @@ class SubprocessOrchestrator:
         if self.credentials is not None:
             env.update(self.credentials.build_env(
                 getattr(spec, "service_account_name", "default")))
+        if placement is not None:
+            # Slice discovery env — the TPU analogue of the reference's
+            # injected nodeSelector (accelerator_injector.go:38-44).
+            env.update(placement.env())
         env.update(self.env_overrides)
         logger.info("spawning replica %s rev=%s: %s",
                     component_id, revision[:8], " ".join(argv))
@@ -135,7 +139,7 @@ class SubprocessOrchestrator:
             await self._terminate(process)
             raise
         replica = Replica(component_id, revision, host,
-                          handle=_Proc(process, port))
+                          handle=_Proc(process, port), placement=placement)
         self.state.setdefault(component_id,
                               _ComponentState()).replicas.append(replica)
         return replica

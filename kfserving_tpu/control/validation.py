@@ -67,6 +67,18 @@ def validate(isvc: InferenceService) -> None:
     par = pred.parallelism
     if par is not None and (par.dp < 1 or par.tp < 1 or par.sp < 1):
         errors.append("parallelism axes must be >= 1")
+    else:
+        # The mesh must land on a real slice shape (TPU analogue of the
+        # reference's accelerator annotation being resolvable).
+        from kfserving_tpu.control.topology import (
+            TopologyError,
+            select_topology,
+        )
+
+        try:
+            select_topology(pred, isvc.annotations)
+        except TopologyError as e:
+            errors.append(str(e))
     if errors:
         raise ValidationError("; ".join(errors))
 

@@ -73,15 +73,21 @@ def init_params(spec: ModelSpec, seed: int = 0):
 
 def apply_fn_for(spec: ModelSpec) -> Callable:
     """A (variables, batch) -> output function in the JaxEngine calling
-    convention (engine/jax_engine.py:34-44): dict inputs are splatted as
-    kwargs, array inputs positionally."""
+    convention (engine/jax_engine.py:34-44): dict batches are splatted as
+    kwargs, array batches positionally.
+
+    Dispatch is on the *runtime* batch type, not the example's: a
+    dict-example model (e.g. BERT with optional attention_mask) must
+    still accept a bare array when a V1 request carries only the primary
+    input — the array binds to the module's first positional arg.  The
+    isinstance check is static under jit tracing (it runs once per
+    compiled signature)."""
     module = spec.module
-    if isinstance(spec.example, dict):
-        def apply(variables, batch):
+
+    def apply(variables, batch):
+        if isinstance(batch, dict):
             return module.apply(variables, **batch)
-    else:
-        def apply(variables, batch):
-            return module.apply(variables, batch)
+        return module.apply(variables, batch)
     return apply
 
 

@@ -95,6 +95,84 @@ def test_trained_model_validation():
             name="m", inference_service="svc", storage_uri="bogus"))
 
 
+# -------------------------------------------------------------- topology --
+def test_topology_cpu_frameworks_get_no_placement():
+    from kfserving_tpu.control.topology import select_topology
+
+    assert select_topology(PredictorSpec(framework="sklearn")) is None
+
+
+def test_topology_smallest_fitting_slice():
+    from kfserving_tpu.control.spec import ParallelismSpec
+    from kfserving_tpu.control.topology import select_topology
+
+    p = select_topology(PredictorSpec(framework="jax"))
+    assert (p.topology, p.chips, p.accelerator_type) == \
+        ("1x1", 1, "v5litepod-1")
+    p = select_topology(PredictorSpec(
+        framework="jax", parallelism=ParallelismSpec(dp=2, tp=2, sp=2)))
+    assert (p.topology, p.chips, p.hosts) == ("2x4", 8, 1)
+    assert p.spare_chips == 0
+    # 6 chips rounds up to the 2x4 slice, spare recorded not hidden
+    p = select_topology(PredictorSpec(
+        framework="jax", parallelism=ParallelismSpec(dp=3, tp=2)))
+    assert (p.topology, p.spare_chips) == ("2x4", 2)
+
+
+def test_topology_annotation_overrides_and_errors():
+    from kfserving_tpu.control.spec import ParallelismSpec
+    from kfserving_tpu.control.topology import (
+        ANNOTATION_GENERATION,
+        ANNOTATION_TOPOLOGY,
+        TopologyError,
+        select_topology,
+    )
+
+    spec = PredictorSpec(framework="jax",
+                         parallelism=ParallelismSpec(dp=4, tp=2))
+    p = select_topology(spec, {ANNOTATION_GENERATION: "v4"})
+    assert (p.generation, p.topology, p.accelerator_type) == \
+        ("v4", "2x2x2", "v4-16")
+    p = select_topology(spec, {ANNOTATION_TOPOLOGY: "4x4"})
+    assert (p.chips, p.mesh_chips, p.spare_chips) == (16, 8, 8)
+    with pytest.raises(TopologyError, match="has 4 chips"):
+        select_topology(spec, {ANNOTATION_TOPOLOGY: "2x2"})
+    with pytest.raises(TopologyError, match="unknown TPU generation"):
+        select_topology(spec, {ANNOTATION_GENERATION: "v9"})
+    with pytest.raises(TopologyError, match="largest"):
+        select_topology(PredictorSpec(
+            framework="jax",
+            parallelism=ParallelismSpec(dp=1024, tp=1)))
+
+
+def test_topology_validation_rejects_unplaceable_mesh():
+    from kfserving_tpu.control.spec import ParallelismSpec
+
+    isvc = _isvc(framework="jax", parallelism=ParallelismSpec(dp=1024))
+    with pytest.raises(ValidationError, match="largest"):
+        validate(isvc)
+
+
+@pytest.mark.asyncio
+async def test_reconcile_attaches_placement_to_replicas():
+    from kfserving_tpu.control.spec import ParallelismSpec
+
+    orch = FakeOrchestrator()
+    controller = Controller(orch)
+    isvc = _isvc(framework="jax",
+                 storage_uri="file:///models/m",
+                 parallelism=ParallelismSpec(dp=2, tp=2))
+    status = await controller.apply(isvc)
+    cstatus = status.components["predictor"]
+    assert cstatus.placement is not None
+    assert cstatus.placement.accelerator_type == "v5litepod-4"
+    replica = orch.replicas("default/svc/predictor")[0]
+    assert replica.placement is cstatus.placement
+    env = replica.placement.env()
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5litepod-4"
+    assert env["TPU_CHIPS_PER_REPLICA"] == "4"
+
+
 # -------------------------------------------------------------- sharding --
 def test_shard_packing_first_fit_decreasing():
     s = HBMShardStrategy(shard_budget_bytes=100, max_shards=3)

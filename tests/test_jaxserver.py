@@ -147,6 +147,26 @@ def test_seq_buckets_bert(tmp_path):
     assert arr.shape == (8, 1024)
 
 
+def test_bert_accepts_bare_token_rows(tmp_path):
+    """V1 instances as plain int rows (no dict) must work for
+    dict-example models — the array binds to input_ids positionally.
+    Regression: this path 500ed ('apply() argument after ** must be a
+    mapping') and zeroed the BERT bench config."""
+    model_dir = _write_model_dir(
+        tmp_path, arch="bert_tiny", arch_kwargs={"seq_len": 16},
+        config_extra={"seq_buckets": [8, 16], "max_latency_ms": 5})
+    m = JaxModel("m", model_dir)
+    m.load()
+
+    async def run():
+        ids = np.ones((2, 5), "int32")
+        return await m.predict({"instances": ids.tolist()})
+
+    resp = asyncio.run(run())
+    arr = np.asarray(resp["predictions"])
+    assert arr.shape == (2, 8, 1024)
+
+
 def test_seq_too_long_rejected(tmp_path):
     model_dir = _write_model_dir(
         tmp_path, arch="bert_tiny", arch_kwargs={"seq_len": 16},
