@@ -26,6 +26,15 @@ Differences, by design (SURVEY.md §7.3):
 - **Shape bucketing.**  A `key_fn` partitions requests into independent
   batches (e.g. by padded sequence-length bucket) so one XLA-compiled shape
   serves each batch — the TPU-native concern the reference never had.
+- **Engine-aware flushing** (`max_inflight`).  Device execution has a high
+  fixed cost per call (runtime round trips dominate small batches), so
+  flushing a 3-instance batch every few ms while the engine is busy only
+  queues tiny executions.  With `max_inflight=N`, at most N batches are in
+  flight; further flush triggers leave the batch accumulating (up to chunk
+  limits) and it flushes the moment a slot frees.  Deadline semantics are
+  preserved: a request never waits past max_latency once a slot is free,
+  and under light load (slots free) the timer flush fires exactly as
+  before.
 """
 
 import asyncio
@@ -57,6 +66,7 @@ class _Pending:
     instances: List[Any] = field(default_factory=list)
     waiters: List = field(default_factory=list)  # (start, count, future)
     timer: Optional[asyncio.TimerHandle] = None
+    ripe: bool = False  # flush requested but deferred (no inflight slot)
 
 
 BatchHandler = Callable[[List[Any]], Awaitable[List[Any]]]
@@ -75,7 +85,8 @@ class DynamicBatcher:
     def __init__(self, handler: BatchHandler,
                  max_batch_size: int = DEFAULT_MAX_BATCH_SIZE,
                  max_latency_ms: float = DEFAULT_MAX_LATENCY_MS,
-                 key_fn: Optional[Callable[[Any], Hashable]] = None):
+                 key_fn: Optional[Callable[[Any], Hashable]] = None,
+                 max_inflight: Optional[int] = None):
         if max_batch_size <= 0:
             max_batch_size = DEFAULT_MAX_BATCH_SIZE
         if max_latency_ms <= 0:
@@ -84,6 +95,8 @@ class DynamicBatcher:
         self.max_batch_size = max_batch_size
         self.max_latency_ms = max_latency_ms
         self.key_fn = key_fn
+        self.max_inflight = max_inflight
+        self._inflight = 0
         self._pending: Dict[Hashable, _Pending] = {}
         # Strong refs to in-flight batch tasks: the event loop holds only
         # weak refs, so an unreferenced task can be GC'd mid-batch.
@@ -120,14 +133,31 @@ class DynamicBatcher:
             self._begin_flush(key)
 
     def _begin_flush(self, key: Hashable):
-        pending = self._pending.pop(key, None)
+        pending = self._pending.get(key)
         if pending is None:
             return
+        if self.max_inflight is not None and \
+                self._inflight >= self.max_inflight:
+            # Engine busy: keep the batch open so more instances coalesce;
+            # _on_batch_done flushes it the moment a slot frees.
+            pending.ripe = True
+            return
+        self._pending.pop(key)
         if pending.timer is not None:
             pending.timer.cancel()
+        self._inflight += 1
         task = asyncio.ensure_future(self._run_batch(key, pending))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
+
+    def _on_batch_done(self):
+        self._inflight -= 1
+        # Flush the ripest (largest) deferred batch into the freed slot.
+        ripe = [(len(p.instances), k) for k, p in self._pending.items()
+                if p.ripe and p.instances]
+        if ripe:
+            ripe.sort(reverse=True)
+            self._begin_flush(ripe[0][1])
 
     async def _run_batch(self, key: Hashable, pending: _Pending):
         batch_id = str(uuid.uuid4())
@@ -139,6 +169,8 @@ class DynamicBatcher:
                     future.set_exception(
                         e if len(pending.waiters) == 1 else _clone_exc(e))
             return
+        finally:
+            self._on_batch_done()
         self.batches_flushed += 1
         self.instances_batched += len(pending.instances)
         self.last_batch_size = len(pending.instances)

@@ -64,15 +64,20 @@ CHECKPOINT_NAME = "checkpoint.msgpack"
 class JaxModelConfig:
     def __init__(self, architecture: str, arch_kwargs: Optional[Dict] = None,
                  max_batch_size: int = 32, max_latency_ms: float = 5.0,
+                 batch_buckets: Optional[List[int]] = None,
                  seq_buckets: Optional[List[int]] = None,
                  input_dtype: str = "float32", scale: Optional[float] = None,
                  output: str = "logits", topk: int = 5,
                  mesh: Optional[Dict[str, int]] = None,
-                 warmup: bool = True, **_ignored):
+                 warmup: bool = True, pipeline_depth: int = 2,
+                 **_ignored):
         self.architecture = architecture
         self.arch_kwargs = arch_kwargs or {}
         self.max_batch_size = max_batch_size
         self.max_latency_ms = max_latency_ms
+        # Explicit batch buckets bound compile count (each bucket is one
+        # XLA program); default pow2 ladder up to max_batch_size.
+        self.batch_buckets = batch_buckets
         self.seq_buckets = seq_buckets
         self.input_dtype = input_dtype
         self.scale = scale
@@ -80,6 +85,7 @@ class JaxModelConfig:
         self.topk = topk
         self.mesh = mesh or {}
         self.warmup = warmup
+        self.pipeline_depth = pipeline_depth
 
     @classmethod
     def from_file(cls, path: str) -> "JaxModelConfig":
@@ -232,8 +238,11 @@ class JaxModel(Model):
                        if cfg.seq_buckets else None)
         engine = JaxEngine(
             serve_fn, variables,
-            batch_buckets=BucketPolicy.pow2(cfg.max_batch_size),
-            seq_buckets=seq_buckets)
+            batch_buckets=(BucketPolicy(cfg.batch_buckets)
+                           if cfg.batch_buckets
+                           else BucketPolicy.pow2(cfg.max_batch_size)),
+            seq_buckets=seq_buckets,
+            pipeline_depth=cfg.pipeline_depth)
         try:
             if cfg.warmup:
                 example = self._example_instance(spec)
@@ -244,9 +253,17 @@ class JaxModel(Model):
 
         batcher = DynamicBatcher(
             self._batch_handler,
-            max_batch_size=cfg.max_batch_size,
+            # Chunk limit = the largest compiled bucket, so a flush never
+            # exceeds what the engine can execute in one call.
+            max_batch_size=(max(cfg.batch_buckets) if cfg.batch_buckets
+                            else cfg.max_batch_size),
             max_latency_ms=cfg.max_latency_ms,
-            key_fn=self._bucket_key if seq_buckets else None)
+            key_fn=self._bucket_key if seq_buckets else None,
+            # One more than the engine's worker threads so a fresh batch
+            # is always staged when a thread frees (the batcher defers
+            # flushes past this — small batches coalesce while the
+            # engine is busy instead of queueing tiny executions).
+            max_inflight=cfg.pipeline_depth + 1)
         return engine, batcher
 
     def _example_instance(self, spec):

@@ -12,6 +12,14 @@ Implements the JSON tensor format of the reference spec
 
 Tensors are encoded/decoded to numpy with an explicit dtype table, including
 BF16 (served models are bfloat16 on TPU; JSON carries floats either way).
+
+Also implements the HTTP **binary data extension** (the HTTP twin of the
+proto's `raw_input_contents`, reference grpc_predict_v2.proto:664-676):
+body = JSON header + concatenated raw tensor bytes, split by the
+`Inference-Header-Content-Length` header; each binary input declares
+`parameters: {"binary_data_size": N}` and omits "data".  On a one-core
+serving host this is the difference between ~5ms of JSON number parsing
+per image and a memcpy — the wire format for TPU-bound dense tensors.
 """
 
 from typing import Any, Dict, List, Optional
@@ -78,30 +86,65 @@ def datatype_of(arr: np.ndarray) -> str:
         raise InvalidInput(f"Unsupported numpy dtype {dt}")
 
 
+def decode_raw_bytes(raw: bytes) -> List[bytes]:
+    """V2 raw BYTES framing: 4-byte little-endian length before each
+    element (required_api.md binary data / raw_input_contents)."""
+    import struct
+
+    out: List[bytes] = []
+    offset, n = 0, len(raw)
+    while offset < n:
+        if offset + 4 > n:
+            raise InvalidInput("truncated raw BYTES tensor")
+        (length,) = struct.unpack_from("<I", raw, offset)
+        offset += 4
+        if offset + length > n:
+            raise InvalidInput("truncated raw BYTES element")
+        out.append(raw[offset:offset + length])
+        offset += length
+    return out
+
+
 class InferInput:
     """One named input tensor of a V2 inference request."""
 
     def __init__(self, name: str, shape: List[int], datatype: str,
-                 data: Any, parameters: Optional[Dict] = None):
+                 data: Any, parameters: Optional[Dict] = None,
+                 raw: Optional[bytes] = None):
         self.name = name
         self.shape = list(shape)
         self.datatype = datatype
         self.data = data
         self.parameters = parameters or {}
+        self.raw = raw
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "InferInput":
-        for field in ("name", "shape", "datatype", "data"):
+        required = ("name", "shape", "datatype")
+        for field in required:
             if field not in d:
                 raise InvalidInput(f'Input tensor missing required field "{field}"')
+        params = d.get("parameters") or {}
+        if "data" not in d and not params.get("binary_data_size"):
+            raise InvalidInput('Input tensor missing required field "data"')
         if not isinstance(d["shape"], list):
             raise InvalidInput('Input tensor "shape" must be a list')
-        return cls(d["name"], d["shape"], d["datatype"], d["data"],
-                   d.get("parameters"))
+        return cls(d["name"], d["shape"], d["datatype"], d.get("data"),
+                   params)
+
+    @property
+    def binary_data_size(self) -> int:
+        return int(self.parameters.get("binary_data_size") or 0)
 
     def as_numpy(self) -> np.ndarray:
         dtype = _numpy_dtype(self.datatype)
-        if self.datatype == "BYTES":
+        if self.raw is not None:
+            if self.datatype == "BYTES":
+                arr = np.array(decode_raw_bytes(self.raw),
+                               dtype=np.object_)
+            else:
+                arr = np.frombuffer(self.raw, dtype=dtype)
+        elif self.datatype == "BYTES":
             arr = np.array(self.data, dtype=np.object_)
         else:
             arr = np.asarray(self.data, dtype=dtype)
@@ -141,6 +184,42 @@ class InferRequest:
         return cls(inputs, body.get("id"), body.get("parameters"),
                    body.get("outputs"))
 
+    @classmethod
+    def from_binary(cls, body: bytes, header_length: int) -> "InferRequest":
+        """Decode a binary-extension request: JSON header in
+        body[:header_length], then each binary input's raw bytes in
+        input order (the HTTP form of raw_input_contents,
+        grpc_predict_v2.proto:664-676)."""
+        import json as _json
+
+        if header_length <= 0 or header_length > len(body):
+            raise InvalidInput(
+                f"Inference-Header-Content-Length {header_length} out of "
+                f"range for body of {len(body)} bytes")
+        try:
+            header = _json.loads(body[:header_length])
+        except ValueError as e:
+            raise InvalidInput(f"invalid V2 binary header: {e}")
+        req = cls.from_dict(header)
+        offset = header_length
+        for inp in req.inputs:
+            size = inp.binary_data_size
+            if not size:
+                continue
+            if offset + size > len(body):
+                raise InvalidInput(
+                    f"binary data for input {inp.name!r} overruns the "
+                    f"request body")
+            # Zero-copy view of the request buffer; np.frombuffer in
+            # as_numpy never touches the bytes again.
+            inp.raw = body[offset:offset + size]
+            offset += size
+        if offset != len(body):
+            raise InvalidInput(
+                f"{len(body) - offset} trailing bytes after the last "
+                f"binary input")
+        return req
+
     def named_numpy(self) -> Dict[str, np.ndarray]:
         return {i.name: i.as_numpy() for i in self.inputs}
 
@@ -153,6 +232,34 @@ class InferRequest:
         if self.outputs:
             out["outputs"] = self.outputs
         return out
+
+
+def make_binary_request(tensors: Dict[str, np.ndarray],
+                        id: Optional[str] = None) -> "tuple[bytes, int]":
+    """Client-side encoder for the binary extension: returns
+    (body, header_length) ready to POST with the
+    Inference-Header-Content-Length header set."""
+    import json as _json
+
+    inputs = []
+    raws = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        raws.append(raw)
+        inputs.append({
+            "name": name, "shape": list(arr.shape),
+            "datatype": datatype_of(arr),
+            "parameters": {"binary_data_size": len(raw)},
+        })
+    header: Dict[str, Any] = {"inputs": inputs}
+    if id is not None:
+        header["id"] = id
+    hbytes = _json.dumps(header).encode()
+    return hbytes + b"".join(raws), len(hbytes)
+
+
+INFERENCE_HEADER_CONTENT_LENGTH = "inference-header-content-length"
 
 
 def tensor_to_output(name: str, arr: np.ndarray) -> Dict[str, Any]:

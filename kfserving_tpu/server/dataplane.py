@@ -12,7 +12,7 @@ from typing import Any, Dict, List
 from kfserving_tpu import __version__ as SERVER_VERSION
 from kfserving_tpu.model.model import Model
 from kfserving_tpu.model.repository import ModelRepository, maybe_await
-from kfserving_tpu.protocol import cloudevents, native, v1
+from kfserving_tpu.protocol import cloudevents, native, v1, v2
 from kfserving_tpu.protocol.errors import (
     InvalidInput,
     ModelNotFound,
@@ -83,6 +83,13 @@ class DataPlane:
                 return cloudevents.from_http(headers, body)
             except ValueError as e:
                 raise InvalidInput(f"Cloud Event Exceptions: {e}")
+        header_len = headers.get(v2.INFERENCE_HEADER_CONTENT_LENGTH)
+        if header_len is not None:
+            # V2 binary data extension: JSON header + raw tensor bytes.
+            try:
+                return InferRequest.from_binary(body, int(header_len))
+            except ValueError as e:
+                raise InvalidInput(str(e))
         if body[:1] == b"{" and b'"datatype"' not in body:
             fast = native.parse_v1(body)
             if fast is not None:

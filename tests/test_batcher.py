@@ -220,3 +220,61 @@ async def test_mismatch_type_preserved_across_waiters():
     assert len(results) == 2
     for r in results:
         assert isinstance(r, BatchSizeMismatch), r
+
+
+async def test_inflight_cap_coalesces_while_engine_busy():
+    """With max_inflight=1 a slow in-flight batch makes later arrivals
+    coalesce into ONE deferred batch that flushes when the slot frees —
+    not a stream of tiny timer flushes."""
+    release = asyncio.Event()
+    calls = []
+
+    async def handler(instances):
+        calls.append(list(instances))
+        if len(calls) == 1:
+            await release.wait()
+        return instances
+
+    b = DynamicBatcher(handler, max_batch_size=32, max_latency_ms=5,
+                       max_inflight=1)
+    first = asyncio.ensure_future(b.submit([0]))
+    await asyncio.sleep(0.02)  # first batch flushed by timer, now blocked
+    laters = [asyncio.ensure_future(b.submit([i])) for i in range(1, 6)]
+    await asyncio.sleep(0.05)  # timers fire but the slot is taken
+    assert len(calls) == 1  # nothing else executed yet
+    release.set()
+    results = await asyncio.gather(first, *laters)
+    assert [r.predictions for r in results] == [[i] for i in range(6)]
+    # the five deferred arrivals rode in a single coalesced batch
+    assert len(calls) == 2
+    assert calls[1] == [1, 2, 3, 4, 5]
+
+
+async def test_inflight_cap_light_load_unaffected():
+    """Under light load (slots free) the deadline flush fires as before."""
+    async def handler(instances):
+        return instances
+
+    b = DynamicBatcher(handler, max_batch_size=32, max_latency_ms=5,
+                       max_inflight=2)
+    r = await asyncio.wait_for(b.submit([7]), timeout=1.0)
+    assert r.predictions == [7]
+
+
+async def test_inflight_cap_shutdown_drains_deferred():
+    """flush() resolves deferred-ripe batches too."""
+    release = asyncio.Event()
+
+    async def handler(instances):
+        if not release.is_set():
+            release.set()
+            await asyncio.sleep(0.03)
+        return instances
+
+    b = DynamicBatcher(handler, max_batch_size=32, max_latency_ms=1,
+                       max_inflight=1)
+    futs = [asyncio.ensure_future(b.submit([i])) for i in range(4)]
+    await asyncio.sleep(0.01)
+    await b.flush()
+    results = await asyncio.gather(*futs)
+    assert sorted(p for r in results for p in r.predictions) == [0, 1, 2, 3]

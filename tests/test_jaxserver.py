@@ -300,3 +300,36 @@ def test_reload_zero_downtime_accounting(tmp_path):
     assert m.load()
     assert hbm.resident_models() == ["m"]
     assert hbm.used_bytes == used_after_first
+
+
+def test_v2_binary_wire_through_server(tmp_path):
+    """Binary-extension request against a live server: raw uint8 tensor,
+    Inference-Header-Content-Length set, JSON response."""
+    import json as _json
+
+    from kfserving_tpu.protocol import v2
+    from tests.utils import http_request, running_server
+
+    model_dir = _write_model_dir(
+        tmp_path, arch="mlp",
+        arch_kwargs={"input_dim": 8, "features": [16], "num_classes": 4},
+        config_extra={"max_latency_ms": 2, "output": "argmax"})
+    m = JaxModel("m", model_dir)
+    m.load()
+
+    async def run():
+        async with running_server([m]) as server:
+            x = np.random.default_rng(0).normal(
+                size=(3, 8)).astype(np.float32)
+            body, hlen = v2.make_binary_request({"input_0": x})
+            status, _, raw = await http_request(
+                server.http_port, "POST", "/v2/models/m/infer", body,
+                headers={"Inference-Header-Content-Length": str(hlen),
+                         "Content-Type": "application/octet-stream"})
+            assert status == 200, raw
+            resp = _json.loads(raw)
+            out = resp["outputs"][0]
+            assert out["shape"] == [3]
+            assert out["datatype"] == "INT32"
+
+    asyncio.run(run())

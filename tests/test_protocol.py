@@ -116,3 +116,59 @@ class TestCloudEvents:
     def test_missing_required(self):
         with pytest.raises(ValueError):
             cloudevents.from_http({"ce-specversion": "1.0"}, b"")
+
+
+class TestV2BinaryExtension:
+    def test_binary_request_round_trip(self):
+        arr = np.arange(24, dtype=np.uint8).reshape(2, 3, 4)
+        body, hlen = v2.make_binary_request({"input_0": arr})
+        req = v2.InferRequest.from_binary(body, hlen)
+        out = req.inputs[0].as_numpy()
+        np.testing.assert_array_equal(out, arr)
+        assert out.dtype == np.uint8
+
+    def test_binary_mixed_with_json_data(self):
+        import json as _json
+
+        raw = np.ones((2, 2), np.float32)
+        header = {"inputs": [
+            {"name": "a", "shape": [2, 2], "datatype": "FP32",
+             "parameters": {"binary_data_size": raw.nbytes}},
+            {"name": "b", "shape": [2], "datatype": "INT32",
+             "data": [7, 8]},
+        ]}
+        hbytes = _json.dumps(header).encode()
+        req = v2.InferRequest.from_binary(hbytes + raw.tobytes(),
+                                          len(hbytes))
+        np.testing.assert_array_equal(req.inputs[0].as_numpy(), raw)
+        np.testing.assert_array_equal(req.inputs[1].as_numpy(),
+                                      np.array([7, 8], np.int32))
+
+    def test_binary_bytes_tensor(self):
+        import json as _json
+        import struct
+
+        elems = [b"ab", b"cdef"]
+        raw = b"".join(struct.pack("<I", len(e)) + e for e in elems)
+        header = {"inputs": [{"name": "s", "shape": [2],
+                              "datatype": "BYTES",
+                              "parameters": {"binary_data_size": len(raw)}}]}
+        hbytes = _json.dumps(header).encode()
+        req = v2.InferRequest.from_binary(hbytes + raw, len(hbytes))
+        assert list(req.inputs[0].as_numpy()) == elems
+
+    def test_binary_truncated_rejected(self):
+        arr = np.ones((4,), np.float32)
+        body, hlen = v2.make_binary_request({"x": arr})
+        with pytest.raises(InvalidInput, match="overruns"):
+            v2.InferRequest.from_binary(body[:-2], hlen)
+
+    def test_trailing_garbage_rejected(self):
+        arr = np.ones((4,), np.float32)
+        body, hlen = v2.make_binary_request({"x": arr})
+        with pytest.raises(InvalidInput, match="trailing"):
+            v2.InferRequest.from_binary(body + b"xx", hlen)
+
+    def test_header_length_out_of_range(self):
+        with pytest.raises(InvalidInput, match="out of range"):
+            v2.InferRequest.from_binary(b"{}", 10)
