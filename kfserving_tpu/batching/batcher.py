@@ -91,6 +91,10 @@ class DynamicBatcher:
             max_batch_size = DEFAULT_MAX_BATCH_SIZE
         if max_latency_ms <= 0:
             max_latency_ms = DEFAULT_MAX_LATENCY_MS
+        if max_inflight is not None and max_inflight <= 0:
+            # 0 would deadlock (every flush defers, nothing ever frees a
+            # slot); clamp like the other knobs.
+            max_inflight = 1
         self.handler = handler
         self.max_batch_size = max_batch_size
         self.max_latency_ms = max_latency_ms

@@ -1,5 +1,11 @@
 """lightgbm predictor (reference python/lgbserver/lgbserver/model.py:
-Booster(model_file=...) then predict).  Import-gated like xgbserver."""
+Booster(model_file=...) then predict).
+
+Like xgbserver, the library is optional: LightGBM's text model format
+(.txt, `booster.save_model`) is documented and stable, so without the
+library the native evaluator (predictors/trees.py) parses and serves it
+with numpy only.
+"""
 
 from kfserving_tpu.predictors.tabular import TabularModel
 
@@ -10,12 +16,20 @@ class LightGBMModel(TabularModel):
     def __init__(self, name: str, model_dir: str, nthread: int = 1):
         super().__init__(name, model_dir)
         self.nthread = nthread
+        self._native = None
 
     def _load_artifact(self, path: str):
-        import lightgbm as lgb
+        try:
+            import lightgbm as lgb
+        except ImportError:
+            from kfserving_tpu.predictors.trees import LightGBMEnsemble
 
+            self._native = LightGBMEnsemble.from_file(path)
+            return self._native
         return lgb.Booster(params={"num_threads": self.nthread},
                            model_file=path)
 
     def _predict_batch(self, batch):
+        if self._native is not None:
+            return self._native.predict(batch)
         return self._model.predict(batch)

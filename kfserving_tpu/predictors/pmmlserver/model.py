@@ -1,5 +1,11 @@
 """PMML predictor (reference python/pmmlserver/pmmlserver/model.py: pypmml
-Model.load then evaluate row-wise).  Import-gated like xgbserver."""
+Model.load then evaluate row-wise).
+
+pypmml is a JVM bridge and optional; without it the native evaluator
+(predictors/pmml_eval.py) parses TreeModel/RegressionModel PMML directly,
+returning the same row-wise list(outputs.values()) shape the reference
+produces.
+"""
 
 from kfserving_tpu.predictors.tabular import TabularModel
 
@@ -7,12 +13,25 @@ from kfserving_tpu.predictors.tabular import TabularModel
 class PMMLModel(TabularModel):
     ARTIFACT_EXTENSIONS = (".pmml", ".xml")
 
-    def _load_artifact(self, path: str):
-        from pypmml import Model as PmmlModel
+    def __init__(self, name: str, model_dir: str):
+        super().__init__(name, model_dir)
+        self._native = None
 
-        return PmmlModel.load(path)
+    def _load_artifact(self, path: str):
+        try:
+            from pypmml import Model as PyPmmlModel
+        except ImportError:
+            from kfserving_tpu.predictors.pmml_eval import PMMLModel as Native
+
+            self._native = Native(path)
+            return self._native
+        return PyPmmlModel.load(path)
 
     def _predict_batch(self, batch):
-        # pypmml evaluates row-by-row (reference model.py does the same).
+        # Row-by-row evaluation either way (reference model.py does the
+        # same); outputs flatten to list(values()) per row.
+        if self._native is not None:
+            return [list(out.values())
+                    for out in self._native.predict(batch)]
         return [list(self._model.predict(list(row)).values())
                 for row in batch]

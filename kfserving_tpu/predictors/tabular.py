@@ -85,4 +85,11 @@ class TabularModel(Model):
             result = self._predict_batch(batch)
         except Exception as e:
             raise InferenceError(f"Failed to predict: {e}")
-        return v1.make_response(np.asarray(result).tolist())
+        if isinstance(result, np.ndarray):
+            payload = result.tolist()
+        else:
+            # Mixed-type rows (e.g. PMML [label, prob, ...]) must not go
+            # through np.asarray — it would coerce numbers to strings.
+            payload = [r.tolist() if isinstance(r, np.ndarray) else r
+                       for r in result]
+        return v1.make_response(payload)

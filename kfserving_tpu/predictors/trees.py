@@ -100,14 +100,33 @@ class XGBoostEnsemble:
         with open(path) as f:
             return cls.from_dict(json.load(f))
 
+    # Objectives whose output transform the evaluator implements.  Ranker
+    # and squared-error objectives are identity in margin space; anything
+    # with another inverse link (poisson/gamma/tweedie exp, etc.) must
+    # raise at load rather than silently return link-space numbers.
+    SUPPORTED_OBJECTIVES = (
+        "binary:logistic", "reg:logistic", "multi:softprob",
+        "multi:softmax", "reg:squarederror", "reg:squaredlogerror",
+        "reg:linear", "reg:absoluteerror", "reg:pseudohubererror",
+        "rank:pairwise", "rank:ndcg", "rank:map",
+    )
+
     @classmethod
     def from_dict(cls, model: Dict[str, Any]) -> "XGBoostEnsemble":
         learner = model["learner"]
         booster = learner["gradient_booster"]
-        if booster.get("name") not in (None, "gbtree", "dart"):
+        if booster.get("name") not in (None, "gbtree"):
+            # dart's JSON nests trees differently and needs weight_drop
+            # scaling — reject rather than misparse.
             raise ValueError(
                 f"unsupported booster {booster.get('name')!r} "
                 f"(native evaluator handles gbtree)")
+        objective = learner.get("objective", {}).get("name", "")
+        if objective and objective not in cls.SUPPORTED_OBJECTIVES:
+            raise ValueError(
+                f"unsupported objective {objective!r}; native evaluator "
+                f"handles {list(cls.SUPPORTED_OBJECTIVES)} — install "
+                f"xgboost for others")
         gmodel = booster["model"]
         trees = []
         for t in gmodel["trees"]:
@@ -129,7 +148,7 @@ class XGBoostEnsemble:
                 "tree_info", [0] * len(trees))],
             num_class=int(params.get("num_class", "0") or 0),
             base_score=float(params.get("base_score", "0.5")),
-            objective=learner.get("objective", {}).get("name", ""),
+            objective=objective,
         )
 
     def margin(self, X: np.ndarray) -> np.ndarray:
@@ -184,11 +203,22 @@ class LightGBMEnsemble:
                                    [leaf_value[0]]))
                 return
             feat = [int(v) for v in block["split_feature"].split()]
-            thresh = [float(v) for v in block["threshold"].split()]
+            # LightGBM numerical splits are `x <= threshold -> left`;
+            # _Tree tests `x < threshold` (xgboost semantics), so nudge
+            # each threshold up one ULP at parse time.
+            thresh = [float(np.nextafter(float(v), np.inf))
+                      for v in block["threshold"].split()]
             lc = [int(v) for v in block["left_child"].split()]
             rc = [int(v) for v in block["right_child"].split()]
             dt = [int(v) for v in block.get(
                 "decision_type", " ".join(["2"] * len(feat))).split()]
+            if any(d & 1 for d in dt):
+                # Bit 0 = categorical split: thresholds are
+                # cat_boundaries indices, not comparable values.
+                raise ValueError(
+                    "model uses categorical splits; the native "
+                    "evaluator handles numerical splits only — install "
+                    "lightgbm for categorical models")
             n_internal = len(feat)
             # Flatten internal nodes then leaves into one array; child id
             # c >= 0 is internal node c, c < 0 is leaf ~c (= -(c)-1).
@@ -238,8 +268,3 @@ class LightGBMEnsemble:
         if self.objective.startswith(("multiclass", "softmax")):
             return _softmax(out)
         return out[:, 0] if self.num_class == 1 else out
-
-    # LightGBM semantics: numerical splits are `x <= threshold -> left`,
-    # xgboost's are `x < threshold`.  _Tree uses `<`; nudge thresholds up
-    # by the smallest representable step at parse time instead of
-    # branching in the hot loop.

@@ -1,28 +1,50 @@
 """xgboost predictor (reference python/xgbserver/xgbserver/model.py:
-booster load from .bst, DMatrix predict).  Import-gated: xgboost is not in
-the hermetic image; the module loads and errors helpfully without it."""
+booster load from .bst, DMatrix predict).
+
+Two execution paths:
+- with the xgboost library installed: exact reference behavior
+  (Booster + DMatrix) for any artifact format;
+- without it: the native evaluator (predictors/trees.py) parses the
+  documented JSON model format directly — .json artifacts serve with
+  numpy only, so the predictor works in hermetic TPU images where
+  xgboost isn't installed.
+"""
 
 from kfserving_tpu.predictors.tabular import TabularModel
 
 
 class XGBoostModel(TabularModel):
-    # .json deliberately excluded: model dirs routinely carry JSON sidecars
-    # (this repo's own config.json layout) that would trip the exactly-one-
-    # artifact check.
-    ARTIFACT_EXTENSIONS = (".bst", ".ubj")
+    # .bst/.ubj are binary formats only the library reads; model JSON is
+    # matched by name (model dirs routinely carry other JSON sidecars —
+    # this repo's own config.json layout — that would trip the
+    # exactly-one-artifact check).
+    ARTIFACT_EXTENSIONS = (".bst", ".ubj", "model.json")
 
     def __init__(self, name: str, model_dir: str, nthread: int = 1):
         super().__init__(name, model_dir)
         self.nthread = nthread
+        self._native = None
 
     def _load_artifact(self, path: str):
-        import xgboost as xgb
+        try:
+            import xgboost as xgb
+        except ImportError:
+            if not path.endswith(".json"):
+                raise ImportError(
+                    "xgboost is not installed and the native evaluator "
+                    "reads only the JSON model format; save with "
+                    "booster.save_model('model.json')")
+            from kfserving_tpu.predictors.trees import XGBoostEnsemble
 
+            self._native = XGBoostEnsemble.from_file(path)
+            return self._native
         booster = xgb.Booster(params={"nthread": self.nthread},
                               model_file=path)
         return booster
 
     def _predict_batch(self, batch):
+        if self._native is not None:
+            return self._native.predict(batch)
         import xgboost as xgb
 
         dmatrix = xgb.DMatrix(batch, nthread=self.nthread)

@@ -143,7 +143,21 @@ class InferInput:
                 arr = np.array(decode_raw_bytes(self.raw),
                                dtype=np.object_)
             else:
-                arr = np.frombuffer(self.raw, dtype=dtype)
+                try:
+                    arr = np.frombuffer(self.raw, dtype=dtype)
+                except ValueError as e:
+                    raise InvalidInput(
+                        f"Input {self.name}: binary data of "
+                        f"{len(self.raw)} bytes does not fit datatype "
+                        f"{self.datatype}: {e}")
+        elif self.data is None:
+            # binary_data_size declared but the request carried no
+            # binary body (plain JSON POST) — a client error, not a
+            # server crash.
+            raise InvalidInput(
+                f"Input {self.name} declares binary_data_size but the "
+                f"request has no binary body (missing "
+                f"Inference-Header-Content-Length?)")
         elif self.datatype == "BYTES":
             arr = np.array(self.data, dtype=np.object_)
         else:

@@ -278,3 +278,15 @@ async def test_inflight_cap_shutdown_drains_deferred():
     await b.flush()
     results = await asyncio.gather(*futs)
     assert sorted(p for r in results for p in r.predictions) == [0, 1, 2, 3]
+
+
+async def test_nonpositive_max_inflight_clamped():
+    """max_inflight <= 0 would deadlock every submit; it clamps to 1."""
+    async def handler(instances):
+        return instances
+
+    b = DynamicBatcher(handler, max_batch_size=4, max_latency_ms=5,
+                       max_inflight=0)
+    assert b.max_inflight == 1
+    r = await asyncio.wait_for(b.submit([1]), timeout=1.0)
+    assert r.predictions == [1]

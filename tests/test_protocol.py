@@ -172,3 +172,24 @@ class TestV2BinaryExtension:
     def test_header_length_out_of_range(self):
         with pytest.raises(InvalidInput, match="out of range"):
             v2.InferRequest.from_binary(b"{}", 10)
+
+
+class TestV2BinaryErrorPaths:
+    def test_binary_size_without_body_is_client_error(self):
+        req = v2.InferRequest.from_dict({"inputs": [
+            {"name": "x", "shape": [4], "datatype": "FP32",
+             "parameters": {"binary_data_size": 16}}]})
+        with pytest.raises(InvalidInput, match="no binary body"):
+            req.inputs[0].as_numpy()
+
+    def test_binary_size_not_multiple_of_itemsize(self):
+        import json as _json
+
+        header = {"inputs": [{"name": "x", "shape": [1],
+                              "datatype": "FP32",
+                              "parameters": {"binary_data_size": 5}}]}
+        hbytes = _json.dumps(header).encode()
+        req = v2.InferRequest.from_binary(hbytes + b"\x00" * 5,
+                                          len(hbytes))
+        with pytest.raises(InvalidInput, match="does not fit datatype"):
+            req.inputs[0].as_numpy()

@@ -1,0 +1,329 @@
+"""Native tree-ensemble evaluator tests.
+
+Fixtures are hand-authored in the *public artifact formats* (xgboost
+JSON save_model schema, LightGBM text save_model, PMML 4.x XML) with
+expected outputs computed by hand — the framework libraries are absent
+from this image by design (the evaluators exist so the predictors serve
+without them; reference python/xgbserver, python/lgbserver,
+python/pmmlserver are the behavioral contracts).
+"""
+
+import asyncio
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.predictors.lgbserver import LightGBMModel
+from kfserving_tpu.predictors.pmml_eval import PMMLModel as NativePMML
+from kfserving_tpu.predictors.pmmlserver import PMMLModel
+from kfserving_tpu.predictors.trees import (
+    LightGBMEnsemble,
+    XGBoostEnsemble,
+)
+from kfserving_tpu.predictors.xgbserver import XGBoostModel
+
+
+def _sigmoid(z):
+    return 1.0 / (1.0 + math.exp(-z))
+
+
+# One tree: root splits on f0 < 1.0 (default right for NaN);
+# left leaf -> +0.4, right node splits on f1 < 2.0 -> leaves -0.3 / +0.1.
+_XGB_TREE = {
+    "split_indices": [0, 0, 1, 0, 0, 0, 0],
+    "split_conditions": [1.0, 0.4, 2.0, 0.0, 0.0, -0.3, 0.1],
+    "left_children": [1, -1, 5, -1, -1, -1, -1],
+    "right_children": [2, -1, 6, -1, -1, -1, -1],
+    "default_left": [0, 0, 1, 0, 0, 0, 0],
+    "base_weights": [0.0] * 7,
+}
+
+
+def _xgb_model(objective="binary:logistic", base_score="0.5",
+               num_class="0", trees=None, tree_info=None):
+    trees = trees if trees is not None else [_XGB_TREE]
+    return {
+        "learner": {
+            "gradient_booster": {
+                "name": "gbtree",
+                "model": {
+                    "trees": trees,
+                    "tree_info": tree_info or [0] * len(trees),
+                },
+            },
+            "learner_model_param": {
+                "base_score": base_score,
+                "num_class": num_class,
+                "num_feature": "2",
+            },
+            "objective": {"name": objective},
+        },
+        "version": [2, 0, 0],
+    }
+
+
+class TestXGBoostEnsemble:
+    def test_binary_logistic(self):
+        ens = XGBoostEnsemble.from_dict(_xgb_model())
+        X = np.array([[0.5, 0.0],   # f0<1 -> leaf +0.4
+                      [1.5, 1.0],   # right, f1<2 -> -0.3
+                      [1.5, 3.0]])  # right, f1>=2 -> +0.1
+        out = ens.predict(X)
+        # base_score 0.5 -> margin 0
+        expected = [_sigmoid(0.4), _sigmoid(-0.3), _sigmoid(0.1)]
+        np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+    def test_margin_output(self):
+        ens = XGBoostEnsemble.from_dict(_xgb_model())
+        out = ens.predict(np.array([[0.5, 0.0]]), output_margin=True)
+        np.testing.assert_allclose(out, [0.4], rtol=1e-6)
+
+    def test_missing_values_follow_default(self):
+        ens = XGBoostEnsemble.from_dict(_xgb_model())
+        # f0=NaN at root: default_left=0 -> right; f1=NaN: default_left=1
+        # -> left leaf -0.3
+        out = ens.predict(np.array([[np.nan, np.nan]]),
+                          output_margin=True)
+        np.testing.assert_allclose(out, [-0.3], rtol=1e-6)
+
+    def test_multiclass_softprob(self):
+        # Three stump trees, one per class: leaf values 0.2 / 0.5 / -0.1.
+        def stump(v):
+            return {"split_indices": [0], "split_conditions": [v],
+                    "left_children": [-1], "right_children": [-1],
+                    "default_left": [0], "base_weights": [0.0]}
+        model = _xgb_model(objective="multi:softprob", base_score="0.0",
+                           num_class="3",
+                           trees=[stump(0.2), stump(0.5), stump(-0.1)],
+                           tree_info=[0, 1, 2])
+        ens = XGBoostEnsemble.from_dict(model)
+        out = ens.predict(np.zeros((1, 2)))
+        z = np.array([0.2, 0.5, -0.1])
+        e = np.exp(z - z.max())
+        np.testing.assert_allclose(out[0], e / e.sum(), rtol=1e-6)
+        assert abs(out[0].sum() - 1.0) < 1e-9
+
+    def test_rejects_gblinear(self):
+        model = _xgb_model()
+        model["learner"]["gradient_booster"]["name"] = "gblinear"
+        with pytest.raises(ValueError, match="unsupported booster"):
+            XGBoostEnsemble.from_dict(model)
+
+
+_LGB_TEXT = """tree
+version=v4
+objective=binary sigmoid:1
+feature_names=f0 f1
+Tree=0
+num_leaves=3
+num_cat=0
+split_feature=0 1
+split_gain=1 1
+threshold=1.0 2.0
+decision_type=2 2
+left_child=-1 -2
+right_child=1 -3
+leaf_value=0.4 -0.3 0.1
+leaf_weight=1 1 1
+leaf_count=1 1 1
+internal_value=0 0
+internal_weight=0 0
+internal_count=2 2
+is_linear=0
+shrinkage=1
+
+end of trees
+
+end of parameters
+"""
+
+
+class TestLightGBMEnsemble:
+    def test_binary(self):
+        ens = LightGBMEnsemble.from_text(_LGB_TEXT)
+        # node0: f0 <= 1.0 -> leaf0 (+0.4); else node1: f1 <= 2.0 ->
+        # leaf1 (-0.3) else leaf2 (+0.1)
+        X = np.array([[1.0, 0.0],   # boundary: <= goes left -> +0.4
+                      [1.5, 2.0],   # right, f1<=2 -> -0.3
+                      [1.5, 3.0]])  # right, f1>2 -> +0.1
+        out = ens.predict(X)
+        expected = [_sigmoid(0.4), _sigmoid(-0.3), _sigmoid(0.1)]
+        np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+    def test_raw_score(self):
+        ens = LightGBMEnsemble.from_text(_LGB_TEXT)
+        out = ens.predict(np.array([[1.0, 0.0]]), raw_score=True)
+        np.testing.assert_allclose(out, [0.4], rtol=1e-6)
+
+    def test_stump_tree(self):
+        text = _LGB_TEXT.replace(
+            "objective=binary sigmoid:1", "objective=regression")
+        stump = ("Tree=1\nnum_leaves=1\nnum_cat=0\nleaf_value=2.5\n\n"
+                 "end of trees")
+        text = text.replace("end of trees", stump, 1)
+        ens = LightGBMEnsemble.from_text(text)
+        out = ens.predict(np.array([[1.0, 0.0]]))
+        np.testing.assert_allclose(out, [0.4 + 2.5], rtol=1e-6)
+
+
+_PMML_TREE = """<?xml version="1.0"?>
+<PMML xmlns="http://www.dmg.org/PMML-4_4" version="4.4">
+  <DataDictionary numberOfFields="3">
+    <DataField name="f0" optype="continuous" dataType="double"/>
+    <DataField name="f1" optype="continuous" dataType="double"/>
+    <DataField name="class" optype="categorical" dataType="string"/>
+  </DataDictionary>
+  <TreeModel modelName="t" functionName="classification">
+    <MiningSchema>
+      <MiningField name="f0"/>
+      <MiningField name="f1"/>
+      <MiningField name="class" usageType="target"/>
+    </MiningSchema>
+    <Node score="a">
+      <True/>
+      <Node score="a">
+        <SimplePredicate field="f0" operator="lessThan" value="1.0"/>
+        <ScoreDistribution value="a" recordCount="8"/>
+        <ScoreDistribution value="b" recordCount="2"/>
+      </Node>
+      <Node score="b">
+        <CompoundPredicate booleanOperator="and">
+          <SimplePredicate field="f0" operator="greaterOrEqual" value="1.0"/>
+          <SimplePredicate field="f1" operator="greaterThan" value="2.0"/>
+        </CompoundPredicate>
+        <ScoreDistribution value="a" recordCount="1"/>
+        <ScoreDistribution value="b" recordCount="9"/>
+      </Node>
+    </Node>
+  </TreeModel>
+</PMML>
+"""
+
+_PMML_REG = """<?xml version="1.0"?>
+<PMML xmlns="http://www.dmg.org/PMML-4_4" version="4.4">
+  <DataDictionary numberOfFields="3">
+    <DataField name="x0" optype="continuous" dataType="double"/>
+    <DataField name="x1" optype="continuous" dataType="double"/>
+    <DataField name="y" optype="continuous" dataType="double"/>
+  </DataDictionary>
+  <RegressionModel functionName="regression">
+    <MiningSchema>
+      <MiningField name="x0"/>
+      <MiningField name="x1"/>
+      <MiningField name="y" usageType="target"/>
+    </MiningSchema>
+    <RegressionTable intercept="1.5">
+      <NumericPredictor name="x0" coefficient="2.0"/>
+      <NumericPredictor name="x1" coefficient="-0.5"/>
+    </RegressionTable>
+  </RegressionModel>
+</PMML>
+"""
+
+
+class TestNativePMML:
+    def test_tree_classification(self, tmp_path):
+        p = tmp_path / "model.pmml"
+        p.write_text(_PMML_TREE)
+        m = NativePMML(str(p))
+        out = m.predict(np.array([[0.5, 0.0], [1.5, 3.0]]))
+        assert out[0]["predicted"] == "a"
+        assert out[0]["probability_a"] == pytest.approx(0.8)
+        assert out[1]["predicted"] == "b"
+        assert out[1]["probability_b"] == pytest.approx(0.9)
+
+    def test_tree_no_matching_child_returns_node_score(self, tmp_path):
+        p = tmp_path / "model.pmml"
+        p.write_text(_PMML_TREE)
+        m = NativePMML(str(p))
+        # f0>=1 but f1<=2: neither child matches -> root's own score
+        out = m.predict(np.array([[1.5, 1.0]]))
+        assert out[0]["predicted"] == "a"
+
+    def test_regression(self, tmp_path):
+        p = tmp_path / "model.pmml"
+        p.write_text(_PMML_REG)
+        m = NativePMML(str(p))
+        out = m.predict(np.array([[2.0, 4.0]]))
+        assert out[0]["predicted"] == pytest.approx(1.5 + 4.0 - 2.0)
+
+    def test_unsupported_model_kind_raises(self, tmp_path):
+        p = tmp_path / "model.pmml"
+        p.write_text(_PMML_REG.replace("RegressionModel",
+                                       "NeuralNetwork"))
+        with pytest.raises(ValueError, match="no supported model"):
+            NativePMML(str(p))
+
+
+class TestPredictorsServeWithoutLibs:
+    """The three predictors end-to-end through the Model contract with
+    native evaluation (un-skips what used to be import-gated)."""
+
+    def _serve(self, model):
+        model.load()
+
+        async def run(instances):
+            return await model.predict({"instances": instances})
+        return run
+
+    def test_xgbserver(self, tmp_path):
+        d = tmp_path / "xgb"
+        d.mkdir()
+        (d / "model.json").write_text(json.dumps(_xgb_model()))
+        run = self._serve(XGBoostModel("m", f"file://{d}"))
+        resp = asyncio.run(run([[0.5, 0.0], [1.5, 3.0]]))
+        np.testing.assert_allclose(
+            resp["predictions"],
+            [_sigmoid(0.4), _sigmoid(0.1)], rtol=1e-6)
+
+    def test_lgbserver(self, tmp_path):
+        d = tmp_path / "lgb"
+        d.mkdir()
+        (d / "model.txt").write_text(_LGB_TEXT)
+        run = self._serve(LightGBMModel("m", f"file://{d}"))
+        resp = asyncio.run(run([[1.0, 0.0]]))
+        np.testing.assert_allclose(
+            resp["predictions"], [_sigmoid(0.4)], rtol=1e-6)
+
+    def test_pmmlserver(self, tmp_path):
+        d = tmp_path / "pmml"
+        d.mkdir()
+        (d / "model.pmml").write_text(_PMML_TREE)
+        run = self._serve(PMMLModel("m", f"file://{d}"))
+        resp = asyncio.run(run([[0.5, 0.0]]))
+        row = resp["predictions"][0]
+        assert row[0] == "a"  # predicted label, not stringified floats
+        assert row[1] == pytest.approx(0.8)
+
+
+class TestNativeEvaluatorGuards:
+    """Unsupported constructs must raise at load, never mispredict."""
+
+    def test_dart_rejected(self):
+        model = _xgb_model()
+        model["learner"]["gradient_booster"]["name"] = "dart"
+        with pytest.raises(ValueError, match="unsupported booster"):
+            XGBoostEnsemble.from_dict(model)
+
+    def test_poisson_objective_rejected(self):
+        model = _xgb_model(objective="count:poisson", base_score="1.0")
+        with pytest.raises(ValueError, match="unsupported objective"):
+            XGBoostEnsemble.from_dict(model)
+
+    def test_lgb_categorical_split_rejected(self):
+        text = _LGB_TEXT.replace("decision_type=2 2", "decision_type=1 2")
+        with pytest.raises(ValueError, match="categorical"):
+            LightGBMEnsemble.from_text(text)
+
+    def test_pmml_normalization_rejected(self, tmp_path):
+        bad = _PMML_REG.replace(
+            '<RegressionModel functionName="regression">',
+            '<RegressionModel functionName="classification" '
+            'normalizationMethod="simplemax">')
+        p = tmp_path / "model.pmml"
+        p.write_text(bad)
+        with pytest.raises(ValueError, match="normalizationMethod"):
+            NativePMML(str(p))
