@@ -49,6 +49,11 @@ DEFAULT_PREDICTOR_RUNTIMES = {
         "multiModel": False,
         "defaultTimeout": 60,
     },
+    "pytorch": {
+        "module": "kfserving_tpu.predictors.torchserver",
+        "multiModel": False,
+        "defaultTimeout": 60,
+    },
 }
 
 

@@ -187,6 +187,10 @@ def default_model_factory(component_id: str, spec):
             from kfserving_tpu.predictors.pmmlserver import PMMLModel
 
             return PMMLModel(isvc_name, spec.storage_uri)
+        if spec.framework == "pytorch":
+            from kfserving_tpu.predictors.torchserver import PyTorchModel
+
+            return PyTorchModel(isvc_name, spec.storage_uri)
         raise ValueError(
             f"in-process orchestrator cannot run framework "
             f"{spec.framework!r}")

@@ -18,10 +18,11 @@ from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, List, Optional
 
 # Frameworks the predictor one-of accepts (reference predictor.go:33-59
-# lists 8 + custom; 'jax' is the TPU-native addition replacing pytorch/
-# triton/tfserving — those artifacts convert offline).
+# lists 8 + custom; 'jax' is the TPU-native addition replacing triton/
+# tfserving — those artifacts convert offline; 'pytorch' serves the
+# reference's pytorchserver contract on the host CPU for migration).
 PREDICTOR_FRAMEWORKS = (
-    "jax", "sklearn", "xgboost", "lightgbm", "pmml", "custom")
+    "jax", "sklearn", "xgboost", "lightgbm", "pmml", "pytorch", "custom")
 
 NAME_REGEX = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")  # k8s DNS-1035
 STORAGE_URI_PREFIXES = (

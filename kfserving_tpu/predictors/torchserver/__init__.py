@@ -1,0 +1,3 @@
+from kfserving_tpu.predictors.torchserver.model import PyTorchModel
+
+__all__ = ["PyTorchModel"]

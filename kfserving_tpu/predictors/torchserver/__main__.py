@@ -1,0 +1,25 @@
+"""`python -m kfserving_tpu.predictors.torchserver` — args as the
+reference server (`--model_name --model_dir --model_class_name`,
+reference python/pytorchserver/pytorchserver/__main__.py)."""
+
+import argparse
+import logging
+
+from kfserving_tpu.predictors.torchserver.model import PyTorchModel
+from kfserving_tpu.server.app import ModelServer, parser as server_parser
+
+logging.basicConfig(level=logging.INFO)
+
+parser = argparse.ArgumentParser(parents=[server_parser])
+parser.add_argument("--model_name", default="model")
+parser.add_argument("--model_dir", required=True)
+parser.add_argument("--model_class_name", default="PyTorchModel")
+
+if __name__ == "__main__":
+    args, _ = parser.parse_known_args()
+    model = PyTorchModel(args.model_name, args.model_dir,
+                         args.model_class_name)
+    model.load()
+    ModelServer(http_port=args.http_port,
+                container_concurrency=args.container_concurrency
+                ).start([model])

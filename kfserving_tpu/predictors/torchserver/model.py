@@ -1,0 +1,96 @@
+"""PyTorch predictor (reference python/pytorchserver/pytorchserver/
+model.py): one user-supplied .py file defines the model class, a
+`model.pt` state dict restores its weights, V1 instances predict as a
+torch batch.
+
+In the TPU build this predictor exists for migration parity — torch
+models serve on the host CPU exactly like the reference's CPU path (the
+reference's `cuda:0` branch maps to nothing here: accelerated serving
+is the jax predictor's job, and torch artifacts convert offline,
+SURVEY.md §2.2 "replaced by jaxserver").  The serving semantics match
+the reference: exactly one .py file in the model dir, class name from
+config (default "PyTorchModel"), strict state-dict load, eval() mode.
+"""
+
+import importlib
+import logging
+import os
+import sys
+from typing import Any
+
+import numpy as np
+
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.protocol import v1
+from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
+from kfserving_tpu.storage import Storage
+
+logger = logging.getLogger("kfserving_tpu.predictors.torchserver")
+
+PYTORCH_FILE = "model.pt"
+
+
+class PyTorchModel(Model):
+    def __init__(self, name: str, model_dir: str,
+                 model_class_name: str = "PyTorchModel"):
+        super().__init__(name)
+        self.model_dir = model_dir
+        self.model_class_name = model_class_name
+        self._model = None
+
+    def load(self) -> bool:
+        import torch
+
+        local_dir = Storage.download(self.model_dir)
+        model_file = os.path.join(local_dir, PYTORCH_FILE)
+        if not os.path.exists(model_file):
+            raise InvalidInput(f"missing {PYTORCH_FILE} under {local_dir}")
+        py_files = [f for f in os.listdir(local_dir) if f.endswith(".py")]
+        if len(py_files) == 0:
+            raise InvalidInput("Missing PyTorch Model Class File.")
+        if len(py_files) > 1:
+            # Reference contract: exactly one Python file per model dir.
+            raise InvalidInput(
+                f"More than one Python file is detected: {sorted(py_files)}")
+        module_name = py_files[0][:-3].replace("-", "_")
+        if local_dir not in sys.path:
+            sys.path.append(local_dir)
+        module = importlib.import_module(module_name)
+        # The module may be cached from a previous load of a different
+        # revision in the same dir; reload to pick up edits.
+        module = importlib.reload(module)
+        model_class = getattr(module, self.model_class_name)
+        self._model = model_class()
+        self._model.load_state_dict(
+            torch.load(model_file, map_location="cpu",
+                       weights_only=True))
+        self._model.eval()
+        logger.info("loaded torch model %s (%s) from %s",
+                    self.name, self.model_class_name, local_dir)
+        self.ready = True
+        return True
+
+    def unload(self) -> None:
+        self._model = None
+        self.ready = False
+
+    async def predict(self, request: Any) -> Any:
+        if self.predictor_host:
+            return await super().predict(request)
+        import torch
+
+        if self._model is None:
+            raise InferenceError(f"model {self.name} not loaded")
+        instances = v1.get_instances(request)
+        try:
+            batch = torch.as_tensor(np.asarray(instances,
+                                               dtype=np.float32))
+        except Exception as e:
+            raise InvalidInput(
+                f"Failed to initialize Torch Tensor from inputs: {e}")
+        try:
+            with torch.no_grad():
+                out = self._model(batch)
+        except Exception as e:
+            raise InferenceError(f"Failed to predict: {e}")
+        return v1.make_response(out.numpy().tolist())

@@ -1,0 +1,209 @@
+"""jax2openapi: OpenAPI 3.0 request/response schemas from a JAX model.
+
+The reference ships tf2openapi, a CLI that turns TF SavedModel
+SignatureDefs into OpenAPI request schemas for validation, docs, and
+payload generation (reference tools/tf2openapi/generator/generate.go,
+README.md:1-22).  The JAX analogue is simpler and exact: shapes and
+dtypes come from `jax.eval_shape` — abstract evaluation, no weights
+initialized, no FLOPs — so the generated schema reflects precisely what
+the served module computes.
+
+Usage:
+    python -m kfserving_tpu.tools.jax2openapi --model_dir DIR [--name N]
+    python -m kfserving_tpu.tools.jax2openapi --architecture resnet50
+
+Emits an OpenAPI 3.0 document with the V1 predict path (instances as
+nested fixed-length arrays mirroring the instance shape) and the V2
+infer path (tensor objects with shape/datatype pinned to the model's).
+"""
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+_JSON_TYPES = {
+    "f": "number", "i": "integer", "u": "integer", "b": "boolean",
+}
+
+
+def _leaf_type(dtype) -> str:
+    kind = np.dtype(dtype).kind if np.dtype(dtype).kind in "fiub" else "f"
+    return _JSON_TYPES[kind]
+
+
+def array_schema(shape: List[Any], dtype) -> Dict[str, Any]:
+    """Nested fixed-size array schema for one instance (no batch dim).
+    Dynamic dims (None / -1) become unconstrained arrays."""
+    if not shape:
+        return {"type": _leaf_type(dtype)}
+    inner = array_schema(list(shape[1:]), dtype)
+    out: Dict[str, Any] = {"type": "array", "items": inner}
+    dim = shape[0]
+    if isinstance(dim, int) and dim > 0:
+        out["minItems"] = dim
+        out["maxItems"] = dim
+    return out
+
+
+def _v2_datatype(dtype) -> str:
+    from kfserving_tpu.protocol.v2 import NUMPY_TO_DTYPES
+
+    dt = np.dtype(dtype)
+    if dt.name == "bfloat16":
+        return "BF16"
+    return NUMPY_TO_DTYPES.get(dt, "FP32")
+
+
+def _shapes_of(tree) -> List[Dict[str, Any]]:
+    """Flatten a pytree of ShapeDtypeStructs/arrays to name/shape/dtype."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    if isinstance(tree, dict):
+        names = list(tree.keys())
+    else:
+        names = [f"output_{i}" for i in range(len(leaves))]
+    return [{"name": n, "shape": [int(s) for s in leaf.shape],
+             "dtype": leaf.dtype} for n, leaf in zip(names, leaves)]
+
+
+def model_signature(architecture: str,
+                    arch_kwargs: Optional[Dict] = None) -> Dict[str, Any]:
+    """Abstractly evaluate the module: input + output shapes/dtypes with
+    zero compute (jax.eval_shape end to end, including init)."""
+    import jax
+
+    from kfserving_tpu.models import apply_fn_for, create_model
+
+    spec = create_model(architecture, **(arch_kwargs or {}))
+    example = spec.example
+    if isinstance(example, dict):
+        example = {k: np.asarray(v) for k, v in example.items()}
+        init_shape = jax.eval_shape(
+            lambda rng: spec.module.init(rng, **example),
+            jax.random.PRNGKey(0))
+    else:
+        example = np.asarray(example)
+        init_shape = jax.eval_shape(
+            lambda rng: spec.module.init(rng, example),
+            jax.random.PRNGKey(0))
+    apply = apply_fn_for(spec)
+    out_shape = jax.eval_shape(apply, init_shape, example)
+    return {
+        "inputs": _shapes_of(example if isinstance(example, dict)
+                             else {"input_0": example}),
+        "outputs": _shapes_of(out_shape),
+    }
+
+
+def generate(name: str, architecture: str,
+             arch_kwargs: Optional[Dict] = None) -> Dict[str, Any]:
+    """Build the OpenAPI 3.0 document for one served model."""
+    sig = model_signature(architecture, arch_kwargs)
+
+    def instance_schema(entry):
+        # drop the example's batch dim: per-instance schema
+        return array_schema(entry["shape"][1:], entry["dtype"])
+
+    if len(sig["inputs"]) == 1:
+        v1_item = instance_schema(sig["inputs"][0])
+    else:
+        v1_item = {
+            "type": "object",
+            "properties": {e["name"]: instance_schema(e)
+                           for e in sig["inputs"]},
+            "required": [e["name"] for e in sig["inputs"]],
+        }
+    v1_request = {
+        "type": "object",
+        "required": ["instances"],
+        "properties": {"instances": {"type": "array", "items": v1_item}},
+    }
+    v2_request = {
+        "type": "object",
+        "required": ["inputs"],
+        "properties": {"inputs": {
+            "type": "array",
+            "items": {"oneOf": [
+                {
+                    "type": "object",
+                    "required": ["name", "shape", "datatype", "data"],
+                    "properties": {
+                        "name": {"type": "string",
+                                 "enum": [e["name"]]},
+                        "shape": {"type": "array",
+                                  "items": {"type": "integer"}},
+                        "datatype": {
+                            "type": "string",
+                            "enum": [_v2_datatype(e["dtype"])]},
+                        "data": {"type": "array"},
+                    },
+                } for e in sig["inputs"]
+            ]},
+        }},
+    }
+    return {
+        "openapi": "3.0.0",
+        "info": {"title": f"Predict API for {name}",
+                 "version": "1"},
+        "paths": {
+            f"/v1/models/{name}:predict": {"post": {
+                "requestBody": {"required": True, "content": {
+                    "application/json": {"schema": v1_request}}},
+                "responses": {"200": {
+                    "description": "predictions",
+                    "content": {"application/json": {"schema": {
+                        "type": "object",
+                        "properties": {"predictions":
+                                       {"type": "array"}}}}},
+                }},
+            }},
+            f"/v2/models/{name}/infer": {"post": {
+                "requestBody": {"required": True, "content": {
+                    "application/json": {"schema": v2_request}}},
+                "responses": {"200": {"description": "infer response"}},
+            }},
+        },
+        "x-model-signature": {
+            "inputs": [{"name": e["name"], "shape": e["shape"],
+                        "datatype": _v2_datatype(e["dtype"])}
+                       for e in sig["inputs"]],
+            "outputs": [{"name": e["name"], "shape": e["shape"],
+                         "datatype": _v2_datatype(e["dtype"])}
+                        for e in sig["outputs"]],
+        },
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Generate OpenAPI schemas from a JAX model "
+                    "(tf2openapi analogue)")
+    p.add_argument("--model_dir",
+                   help="model dir with config.json (architecture + "
+                        "arch_kwargs)")
+    p.add_argument("--architecture", help="registry architecture name")
+    p.add_argument("--arch_kwargs", default="{}",
+                   help="JSON kwargs for --architecture")
+    p.add_argument("--name", default=None, help="served model name")
+    args = p.parse_args(argv)
+    if args.model_dir:
+        with open(f"{args.model_dir.rstrip('/')}/config.json") as f:
+            cfg = json.load(f)
+        arch = cfg["architecture"]
+        kwargs = cfg.get("arch_kwargs", {})
+    elif args.architecture:
+        arch = args.architecture
+        kwargs = json.loads(args.arch_kwargs)
+    else:
+        p.error("one of --model_dir / --architecture is required")
+    doc = generate(args.name or arch, arch, kwargs)
+    json.dump(doc, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+
+
+if __name__ == "__main__":
+    main()

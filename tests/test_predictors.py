@@ -231,3 +231,56 @@ def test_blackbox_explainer_metadata_safe():
     assert meta["explainer"] == "noise_flip_rate"
     ex.unload()
     assert not ex.ready
+
+
+def test_pytorch_model(tmp_path):
+    """pytorchserver parity (reference python/pytorchserver/
+    pytorchserver/test_model.py): class file + model.pt state dict in
+    the model dir, V1 instances predict through torch on CPU."""
+    import torch
+
+    d = tmp_path / "torchmodel"
+    d.mkdir()
+    (d / "net.py").write_text(
+        "import torch\n"
+        "class PyTorchModel(torch.nn.Module):\n"
+        "    def __init__(self):\n"
+        "        super().__init__()\n"
+        "        self.fc = torch.nn.Linear(4, 3)\n"
+        "    def forward(self, x):\n"
+        "        return self.fc(x)\n")
+    import importlib.util as iu
+
+    spec = iu.spec_from_file_location("tmp_torch_net", d / "net.py")
+    mod = iu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    net = mod.PyTorchModel()
+    torch.save(net.state_dict(), d / "model.pt")
+
+    from kfserving_tpu.predictors.torchserver import PyTorchModel
+
+    m = PyTorchModel("torchy", f"file://{d}")
+    assert m.load()
+
+    async def run():
+        return await m.predict({"instances": [[1.0, 2.0, 3.0, 4.0]]})
+
+    resp = asyncio.run(run())
+    preds = np.asarray(resp["predictions"])
+    assert preds.shape == (1, 3)
+    with torch.no_grad():
+        expected = net(torch.tensor([[1.0, 2.0, 3.0, 4.0]])).numpy()
+    np.testing.assert_allclose(preds, expected, rtol=1e-5)
+
+
+def test_pytorch_model_rejects_ambiguous_class_files(tmp_path):
+    d = tmp_path / "torchbad"
+    d.mkdir()
+    (d / "a.py").write_text("x = 1\n")
+    (d / "b.py").write_text("x = 2\n")
+    (d / "model.pt").write_bytes(b"")
+    from kfserving_tpu.predictors.torchserver import PyTorchModel
+
+    m = PyTorchModel("torchy", f"file://{d}")
+    with pytest.raises(Exception, match="More than one Python file"):
+        m.load()

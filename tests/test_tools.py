@@ -1,0 +1,75 @@
+"""jax2openapi tests (reference tools/tf2openapi: SavedModel signature ->
+OpenAPI request schema; here jax.eval_shape is the signature source)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.tools.jax2openapi import (
+    array_schema,
+    generate,
+    model_signature,
+)
+
+
+def test_array_schema_fixed_dims():
+    s = array_schema([3, 2], np.float32)
+    assert s == {"type": "array", "minItems": 3, "maxItems": 3,
+                 "items": {"type": "array", "minItems": 2, "maxItems": 2,
+                           "items": {"type": "number"}}}
+
+
+def test_array_schema_integer_leaf():
+    assert array_schema([], np.int32) == {"type": "integer"}
+
+
+def test_mlp_signature_via_eval_shape():
+    sig = model_signature(
+        "mlp", {"input_dim": 8, "features": [16], "num_classes": 3})
+    assert sig["inputs"][0]["shape"] == [1, 8]
+    assert sig["outputs"][0]["shape"] == [1, 3]
+
+
+def test_generate_v1_and_v2_paths():
+    doc = generate("clf", "mlp",
+                   {"input_dim": 4, "features": [8], "num_classes": 2})
+    assert doc["openapi"] == "3.0.0"
+    v1 = doc["paths"]["/v1/models/clf:predict"]["post"]
+    item = v1["requestBody"]["content"]["application/json"]["schema"][
+        "properties"]["instances"]["items"]
+    # per-instance schema: fixed 4-vector (batch dim dropped)
+    assert item["minItems"] == 4 and item["maxItems"] == 4
+    sig = doc["x-model-signature"]
+    assert sig["inputs"][0]["datatype"] == "FP32"
+    assert sig["outputs"][0]["shape"] == [1, 2]
+    assert "/v2/models/clf/infer" in doc["paths"]
+
+
+def test_bert_dict_inputs():
+    doc = generate("bert", "bert_tiny", {"seq_len": 16})
+    item = doc["paths"]["/v1/models/bert:predict"]["post"][
+        "requestBody"]["content"]["application/json"]["schema"][
+        "properties"]["instances"]["items"]
+    # dict-example model: per-instance object with both tensors
+    assert set(item["required"]) == {"input_ids", "attention_mask"}
+
+
+def test_cli_from_model_dir(tmp_path):
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "config.json").write_text(json.dumps(
+        {"architecture": "mlp",
+         "arch_kwargs": {"input_dim": 4, "features": [8],
+                         "num_classes": 2}}))
+    out = subprocess.run(
+        [sys.executable, "-m", "kfserving_tpu.tools.jax2openapi",
+         "--model_dir", str(d), "--name", "svc"],
+        capture_output=True, text=True, timeout=120,
+        env={"PYTHONPATH": "/root/repo", "PATH": "/usr/bin:/bin",
+             "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert "/v1/models/svc:predict" in doc["paths"]
