@@ -168,24 +168,39 @@ parse_dense(Parser *ps, int d)
                 return -1;
         }
         else {
-            /* leaf number */
-            char *endptr;
-            const char *tok = ps->p;
-            double v = strtod(ps->p, &endptr);
-            if (endptr == ps->p)
-                return -1;          /* not a number (string/null/...) */
-            ps->p = endptr;
-            if (ps->all_int) {
-                /* any float-looking token or out-of-int32 value demotes
-                 * the whole tensor to float32 */
-                for (const char *t = tok; t < endptr; t++) {
-                    if (*t == '.' || *t == 'e' || *t == 'E') {
-                        ps->all_int = 0;
-                        break;
-                    }
-                }
-                if (v < -2147483648.0 || v > 2147483647.0)
+            /* leaf number.  Fast path: plain integers (the dominant
+             * case for uint8 image tensors) parse with a digit loop —
+             * strtod costs ~10x per token and its absence also skips
+             * the float-demotion re-scan.  Anything with '.', an
+             * exponent, or >15 digits falls back to strtod. */
+            double v;
+            const char *q = ps->p;
+            int neg = 0;
+            if (q < ps->end && *q == '-') { neg = 1; q++; }
+            const char *dstart = q;
+            long long iv = 0;
+            while (q < ps->end && *q >= '0' && *q <= '9' &&
+                   q - dstart < 15) {
+                iv = iv * 10 + (*q - '0');
+                q++;
+            }
+            if (q > dstart && (q >= ps->end ||
+                               (*q != '.' && *q != 'e' && *q != 'E' &&
+                                (*q < '0' || *q > '9')))) {
+                v = neg ? -(double)iv : (double)iv;
+                ps->p = q;
+                if (ps->all_int &&
+                    (v < -2147483648.0 || v > 2147483647.0))
                     ps->all_int = 0;
+            }
+            else {
+                char *endptr;
+                v = strtod(ps->p, &endptr);
+                if (endptr == ps->p)
+                    return -1;      /* not a number (string/null/...) */
+                ps->p = endptr;
+                /* slow-path tokens are float-looking or huge: demote */
+                ps->all_int = 0;
             }
             if (ps->ndim == 0)
                 ps->ndim = d + 1;   /* leaves live at this depth */

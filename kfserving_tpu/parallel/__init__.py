@@ -25,6 +25,10 @@ from kfserving_tpu.parallel.mesh import (  # noqa: F401
     build_mesh,
     single_device_mesh,
 )
+from kfserving_tpu.parallel.multihost import (  # noqa: F401
+    hybrid_mesh,
+    initialize as initialize_distributed,
+)
 from kfserving_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
     replicate_params,

@@ -164,3 +164,50 @@ def test_ring_attention_via_bert_attn_fn():
     expect = _xla_attention(q, q, q, jnp.asarray(mask4d))
     np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_hybrid_mesh_axes_and_degenerate_dcn():
+    """hybrid_mesh always exposes ("dcn", dp, sp, tp) so jitted code is
+    identical for one slice or many; dcn=1 degenerates cleanly."""
+    from kfserving_tpu.parallel import hybrid_mesh
+
+    mesh = hybrid_mesh(MeshConfig(dp=2, tp=2, sp=2))
+    assert mesh.axis_names == ("dcn", "dp", "sp", "tp")
+    assert dict(mesh.shape) == {"dcn": 1, "dp": 2, "sp": 2, "tp": 2}
+
+
+def test_hybrid_mesh_dcn_replicas_on_cpu_fleet():
+    """dcn=2 x (dp=2,tp=2) over the 8-device CPU mesh: batch shards over
+    (dcn, dp) and a jitted sum matches the unsharded result."""
+    import jax
+    import jax.numpy as jnp
+
+    from kfserving_tpu.parallel import hybrid_mesh
+    from kfserving_tpu.parallel.multihost import data_sharding
+
+    mesh = hybrid_mesh(MeshConfig(dp=2, tp=2, sp=1), dcn_replicas=2)
+    assert dict(mesh.shape) == {"dcn": 2, "dp": 2, "sp": 1, "tp": 2}
+    x = np.arange(8 * 4, dtype=np.float32).reshape(8, 4)
+    xs = jax.device_put(x, data_sharding(mesh))
+
+    @jax.jit
+    def f(a):
+        return a.sum(axis=-1)
+
+    np.testing.assert_allclose(np.asarray(f(xs)), x.sum(-1))
+
+
+def test_hybrid_mesh_too_many_devices():
+    from kfserving_tpu.parallel import hybrid_mesh
+
+    with pytest.raises(ValueError, match="hybrid mesh needs"):
+        hybrid_mesh(MeshConfig(dp=8, tp=2), dcn_replicas=2)
+
+
+def test_initialize_noop_without_coordinates(monkeypatch):
+    from kfserving_tpu.parallel import multihost
+
+    for var in ("COORDINATOR_ADDRESS", "NUM_PROCESSES", "PROCESS_ID",
+                "TPU_WORKER_HOSTNAMES"):
+        monkeypatch.delenv(var, raising=False)
+    assert multihost.initialize() is False
