@@ -179,10 +179,17 @@ async def bench_bert(smoke: bool) -> Dict[str, Any]:
 
     arch = "bert_tiny" if smoke else "bert"
     seq_buckets = [32, 64, 128]
+    # Explicit batch buckets bound warmup to (2 batch x 3 seq) compiles;
+    # without the full grid, serve-time compiles (~25s each through the
+    # tunnel) turned first requests into timeouts.
+    # topk output: fill-mask serving returns top-5 ids/scores per
+    # position, not the raw [seq, vocab] logits (a ~40MB JSON body per
+    # 128-token instance for bert-base's 30k vocab).
     model_dir = _write_jax_model_dir(
         arch, {}, max_batch_size=8 if smoke else 16,
+        batch_buckets=[8] if smoke else [4, 16],
         max_latency_ms=5.0, warmup=True, seq_buckets=seq_buckets,
-        output="logits")
+        output="topk", topk=5)
     model = JaxModel("bert", model_dir)
     model.load()
     server = await _serve([model])

@@ -43,7 +43,9 @@ class ImageTransformer(Model):
                 # are taken as already scaled to [0, 1].
                 arr = arr / 255.0
             arr = (arr - MEAN) / STD
-            out.append(arr.tolist())
+            out.append(arr)
+        # Arrays stay dense: the proxy hop rides the V2 binary wire
+        # instead of re-encoding megabytes of float text (model.py).
         return {"instances": out}
 
 

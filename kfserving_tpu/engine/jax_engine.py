@@ -74,6 +74,17 @@ def _params_on_single_device(jax, params) -> bool:
         return False
 
 
+def _resize_seq(arr: np.ndarray, seq: int) -> np.ndarray:
+    """Clip or tile a single instance's leading (sequence) axis to `seq`
+    for warmup shape synthesis."""
+    if arr.ndim == 0 or arr.shape[0] == seq:
+        return arr
+    if arr.shape[0] > seq:
+        return arr[:seq]
+    reps = (seq + arr.shape[0] - 1) // arr.shape[0]
+    return np.concatenate([arr] * reps, axis=0)[:seq]
+
+
 class JaxEngine:
     """Bucketed, padded, jit-compiled batch execution of `apply_fn(params, x)`.
 
@@ -207,8 +218,12 @@ class JaxEngine:
             t2 = time.perf_counter()
             result = self._jax.tree.map(lambda a: np.asarray(a)[:n], out)
             t3 = time.perf_counter()
-            bucket = (padded[next(iter(padded))]
-                      if isinstance(padded, dict) else padded).shape[0]
+            first = (padded[next(iter(padded))]
+                     if isinstance(padded, dict) else padded)
+            bucket = first.shape[0]
+            flops_key = (int(bucket),
+                         int(first.shape[1]) if self.seq_buckets is not None
+                         and first.ndim >= 2 else None)
             span.update(batch=n, bucket=int(bucket),
                         prepare_ms=round((t1 - t0) * 1e3, 3),
                         device_ms=round((t2 - t1) * 1e3, 3),
@@ -222,7 +237,7 @@ class JaxEngine:
                 self.device_ms_total += (t2 - t1) * 1e3
                 self.fetch_ms_total += (t3 - t2) * 1e3
                 self.flops_total += self._flops_by_bucket.get(
-                    int(bucket), 0.0)
+                    flops_key, 0.0)
         return result
 
     async def predict(self, inputs: Any) -> Any:
@@ -241,23 +256,51 @@ class JaxEngine:
 
     # -- lifecycle -----------------------------------------------------------
     def warmup(self, example: Any, buckets: Optional[List[int]] = None) -> float:
-        """Pre-compile executables for all batch buckets (and the example's
-        seq bucket).  Returns total compile seconds.  `example` is a single
-        instance (no batch dim) as array or dict of arrays."""
+        """Pre-compile every executable a request can hit: all batch
+        buckets x all seq buckets (sequence models without the full grid
+        warm compile at serve time instead — measured ~25s per shape on
+        a tunneled chip, which turns first requests into timeouts).
+        Returns total compile seconds.  `example` is a single instance
+        (no batch dim) as array or dict of arrays."""
         start = time.perf_counter()
-        for b in (buckets or self.batch_buckets.buckets):
+        batch_buckets = buckets or self.batch_buckets.buckets
+        seq_buckets = (self.seq_buckets.buckets
+                       if self.seq_buckets is not None else [None])
+
+        def instance_at(seq):
+            if seq is None:
+                return example
             if isinstance(example, dict):
-                batch = {k: np.stack([np.asarray(v)] * b) for k, v in
-                         example.items()}
-            else:
-                batch = np.stack([np.asarray(example)] * b)
-            self._execute_sync(batch)
-            self.compile_count += 1
-            self._record_flops(b, batch)
+                return {k: _resize_seq(np.asarray(v), seq)
+                        for k, v in example.items()}
+            return _resize_seq(np.asarray(example), seq)
+
+        for s in seq_buckets:
+            inst = instance_at(s)
+            for b in batch_buckets:
+                if isinstance(inst, dict):
+                    batch = {k: np.stack([np.asarray(v)] * b)
+                             for k, v in inst.items()}
+                else:
+                    batch = np.stack([np.asarray(inst)] * b)
+                self._execute_sync(batch)
+                self.compile_count += 1
+                self._record_flops(b, batch)
         dt = time.perf_counter() - start
-        logger.info("warmup compiled %d buckets in %.1fs",
-                    len(buckets or self.batch_buckets.buckets), dt)
+        logger.info("warmup compiled %d batch x %d seq buckets in %.1fs",
+                    len(batch_buckets), len(seq_buckets), dt)
         return dt
+
+    def _flops_key(self, batch: Any):
+        """Stats key: (batch bucket, seq bucket) — per-seq-bucket
+        programs have different FLOPs and must not share an entry.
+        Shape access only (never np.asarray: the batch may already live
+        on device and a copy here would be a hidden D2H transfer)."""
+        first = (batch[next(iter(batch))]
+                 if isinstance(batch, dict) else batch)
+        return (int(first.shape[0]),
+                int(first.shape[1]) if self.seq_buckets is not None
+                and getattr(first, "ndim", 0) >= 2 else None)
 
     def _record_flops(self, bucket: int, batch: Any) -> None:
         """XLA's cost model for this bucket's program (feeds the
@@ -275,7 +318,7 @@ class JaxEngine:
                 analysis = analysis[0] if analysis else {}
             flops = float((analysis or {}).get("flops", 0.0))
             if flops > 0:
-                self._flops_by_bucket[int(bucket)] = flops
+                self._flops_by_bucket[self._flops_key(batch)] = flops
         except Exception as exc:  # cost model optional, never fatal
             logger.debug("cost_analysis unavailable: %s", exc)
 

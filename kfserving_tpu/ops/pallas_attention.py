@@ -9,9 +9,10 @@ sequence lengths BASELINE.json config #3 targets the score tensor is what
 turns attention HBM-bandwidth-bound.
 
 Layout contract matches kfserving_tpu.ops.attention: [B, L, H, D] in, same
-out.  D must be a multiple of 128 (lane width); L a multiple of the block
-size (the engine's seq-bucket policy guarantees this — buckets are chosen
-from multiples of 128, engine/buckets.py).
+out.  D must be a multiple of 64 (64 pads the 128-lane width but measured
+34 TF/s on v5e; attention.py gates eligibility); L must be a multiple of
+128 — block sizes adapt downward (512/256/128) to divide any such L, so
+every legal seq bucket keeps the flash path.
 """
 
 import functools
@@ -21,9 +22,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
+
+
+def _fit_block(block: int, length: int) -> int:
+    """Largest candidate block (<= requested) dividing `length`."""
+    for b in (block, 512, 256, 128, 64, 32, 16, 8):
+        if b <= block and length % b == 0:
+            return b
+    return min(block, length)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
@@ -40,11 +49,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
         acc_scratch[:] = jnp.zeros_like(acc_scratch)
 
     def _run_block():
-        q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
-        k = k_ref[0].astype(jnp.float32)                  # [bk, d]
-        s = jax.lax.dot_general(                          # [bq, bk]
+        # Dots take the inputs' native (bf16) dtype — the MXU multiplies
+        # bf16 at full rate with fp32 accumulation; upcasting first
+        # halves throughput.  Stats/accumulator stay fp32.
+        q = q_ref[0]                                      # [bq, d]
+        k = k_ref[0]                                      # [bk, d]
+        s = jax.lax.dot_general(                          # [bq, bk] fp32
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32) * scale
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -60,9 +72,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
         alpha = jnp.exp(m_prev - m_new)                   # [bq, 1]
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
-        v = v_ref[0].astype(jnp.float32)                  # [bk, d]
-        pv = jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+        v = v_ref[0]                                      # [bk, d]
+        pv = jax.lax.dot_general(                         # p rides bf16
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         acc_scratch[:] = acc_scratch[:] * alpha + pv
         m_scratch[:] = m_new
@@ -90,12 +102,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """Fused attention over [B, L, H, D]; returns [B, L, H, D]."""
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    block_q = min(block_q, Lq)
-    block_k = min(block_k, Lk)
+    # Blocks shrink to the largest power-of-two divisor <= the requested
+    # size, so L=640 runs with 128-blocks instead of losing the kernel.
+    block_q = _fit_block(block_q, Lq)
+    block_k = _fit_block(block_k, Lk)
     if Lq % block_q or Lk % block_k:
         raise ValueError(
-            f"seq lens ({Lq}, {Lk}) must be multiples of blocks "
-            f"({block_q}, {block_k})")
+            f"seq lens ({Lq}, {Lk}) must be multiples of 128 "
+            f"(got blocks {block_q}, {block_k})")
     scale = 1.0 / D ** 0.5
 
     # Fold heads into the grid's first axis: BHLD views with one (b,h) slab

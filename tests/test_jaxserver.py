@@ -333,3 +333,35 @@ def test_v2_binary_wire_through_server(tmp_path):
             assert out["datatype"] == "INT32"
 
     asyncio.run(run())
+
+
+def test_transformer_chain_binary_hop(tmp_path):
+    """Transformer -> predictor proxy: dense ndarray instances ride the
+    V2 binary wire and the response translates back to V1 shape, so the
+    chain result matches a direct V1 predict."""
+    from examples.image_transformer import ImageTransformer
+    from tests.utils import running_server
+
+    model_dir = _write_model_dir(
+        tmp_path, arch="vit_tiny", arch_kwargs={"image_size": 16},
+        config_extra={"max_latency_ms": 2, "output": "argmax"})
+    predictor = JaxModel("chainy", model_dir)
+    predictor.load()
+
+    async def run():
+        async with running_server([predictor]) as server:
+            t = ImageTransformer(
+                "chainy", predictor_host=f"127.0.0.1:{server.http_port}")
+            raw = (np.random.default_rng(0)
+                   .integers(0, 256, size=(2, 16, 16, 3)).tolist())
+            body = await t.preprocess({"instances": raw})
+            assert isinstance(body["instances"][0], np.ndarray)
+            via_chain = await t.predict(body)
+            # direct path for comparison
+            direct = await predictor.predict(
+                {"instances": [a.tolist() for a in body["instances"]]})
+            await t.close()
+            return via_chain, direct
+
+    via_chain, direct = asyncio.run(run())
+    assert via_chain["predictions"] == direct["predictions"]

@@ -308,3 +308,36 @@ async def test_container_concurrency_queue_drains():
         statuses = await asyncio.gather(*[one(i) for i in range(10)])
         assert statuses == [200] * 10
         assert model.peak_inflight <= 2
+
+
+def test_binary_hop_falls_back_to_v1_only_downstream():
+    """A transformer chained to a V1-only predictor: the binary V2 hop
+    fails, the proxy falls back to the configured V1 route (np-aware
+    JSON), and stops attempting binary."""
+    import numpy as np
+
+    from kfserving_tpu import Model as BaseModel
+
+    class V1Only(DummyModel):
+        async def predict(self, request):
+            # a reference-style V1 server: dict in, dict out
+            assert isinstance(request, dict), type(request)
+            return {"predictions": [int(np.sum(i))
+                                    for i in request["instances"]]}
+
+    async def run():
+        backend = V1Only()
+        backend.load()
+        async with running_server([backend]) as server:
+            front = BaseModel("TestModel")
+            front.predictor_host = f"127.0.0.1:{server.http_port}"
+            dense = {"instances": [np.ones((2, 2), np.float32)]}
+            out = await front.predict(dense)
+            assert out["predictions"] == [4]
+            assert front._binary_hop is False  # won't retry binary
+            out2 = await front.predict(
+                {"instances": [np.full((2, 2), 2.0, np.float32)]})
+            assert out2["predictions"] == [8]
+            await front.close()
+
+    asyncio.run(run())
