@@ -365,3 +365,27 @@ def test_transformer_chain_binary_hop(tmp_path):
 
     via_chain, direct = asyncio.run(run())
     assert via_chain["predictions"] == direct["predictions"]
+
+
+def test_bare_rows_canonicalize_to_masked_dict(tmp_path):
+    """Bare token rows synthesize a padding attention_mask and share the
+    dict signature: predictions match an explicit dict request with the
+    same mask, and padding is not attended to."""
+    model_dir = _write_model_dir(
+        tmp_path, arch="bert_tiny", arch_kwargs={"seq_len": 16},
+        config_extra={"seq_buckets": [8], "max_latency_ms": 2})
+    m = JaxModel("m", model_dir)
+    m.load()
+
+    async def run():
+        ids = [1, 2, 3, 4, 5]
+        bare = await m.predict({"instances": [ids]})
+        mask = [1] * 5 + [0] * 3
+        explicit = await m.predict({"instances": [
+            {"input_ids": ids + [0] * 3, "attention_mask": mask}]})
+        return bare, explicit
+
+    bare, explicit = asyncio.run(run())
+    np.testing.assert_allclose(
+        np.asarray(bare["predictions"]),
+        np.asarray(explicit["predictions"]), rtol=1e-4, atol=1e-5)
