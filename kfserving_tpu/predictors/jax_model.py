@@ -400,7 +400,50 @@ class JaxModel(Model):
             meta["batch_buckets"] = list(self.engine.batch_buckets.buckets)
             if self.engine.seq_buckets:
                 meta["seq_buckets"] = list(self.engine.seq_buckets.buckets)
+            meta.update(self._signature_metadata())
         return meta
+
+    def _signature_metadata(self) -> Dict[str, Any]:
+        """V2 model-metadata inputs/outputs (required_api.md Model
+        Metadata): shapes/dtypes from jax.eval_shape of the serving
+        function — abstract evaluation, no device work.  Batch dim
+        reports -1 (dynamic; buckets are an engine detail)."""
+        try:
+            import jax
+
+            from kfserving_tpu.protocol.v2 import datatype_of
+
+            spec = self._spec
+            example = spec.example
+            if isinstance(example, dict):
+                example = {k: np.asarray(v) for k, v in example.items()}
+                inputs = [{"name": k,
+                           "datatype": datatype_of(np.asarray(v)),
+                           "shape": [-1] + list(np.asarray(v).shape[1:])}
+                          for k, v in example.items()]
+            else:
+                example = np.asarray(example)
+                if self.config.input_dtype == "uint8":
+                    example = example.astype(np.uint8)
+                inputs = [{"name": "input_0",
+                           "datatype": datatype_of(example),
+                           "shape": [-1] + list(example.shape[1:])}]
+            out = jax.eval_shape(
+                lambda v, x: self.engine._jitted.__wrapped__(v, x)
+                if hasattr(self.engine._jitted, "__wrapped__")
+                else self.engine._jitted(v, x),
+                self.engine.params, example)
+            leaves = (out.items() if isinstance(out, dict)
+                      else [("output_0", out)])
+            outputs = [{"name": k,
+                        "datatype": datatype_of(
+                            np.empty(0, dtype=leaf.dtype)),
+                        "shape": [-1] + list(leaf.shape[1:])}
+                       for k, leaf in leaves]
+            return {"inputs": inputs, "outputs": outputs}
+        except Exception:  # metadata is best-effort, never fatal
+            logger.debug("signature metadata unavailable", exc_info=True)
+            return {}
 
     def engine_stats(self) -> Dict[str, Any]:
         stats = dict(self.engine.stats()) if self.engine else {}

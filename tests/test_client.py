@@ -284,3 +284,37 @@ async def test_manager_with_subprocess_backend(tmp_path):
                 assert result == {"predictions": [1, 1]}
     finally:
         await manager.stop_async()
+
+
+async def test_client_binary_predict(tmp_path):
+    """SDK binary-wire predict through the ingress router to a jax
+    predictor (dense tensors as raw bytes)."""
+    import json as _json
+
+    model_dir = str(tmp_path / "jaxm")
+    os.makedirs(model_dir)
+    _json.dump({"architecture": "mlp",
+                "arch_kwargs": {"input_dim": 8, "features": [16],
+                                "num_classes": 4},
+                "max_latency_ms": 2, "output": "argmax",
+                "warmup": False},
+               open(os.path.join(model_dir, "config.json"), "w"))
+
+    manager = ServingManager(orchestrator="inprocess",
+                             control_port=0, ingress_port=0)
+    await manager.start_async()
+    try:
+        async with KFServingClient(
+                f"http://127.0.0.1:{manager.api.http_port}",
+                f"http://127.0.0.1:{manager.router.http_port}") as client:
+            await client.create(isvc_spec(
+                "jaxm", "jax", f"file://{model_dir}"))
+            await client.wait_isvc_ready("jaxm")
+            x = np.random.default_rng(0).normal(size=(3, 8)) \
+                .astype(np.float32)
+            resp = await client.predict_binary("jaxm", {"input_0": x})
+            out = resp["outputs"][0]
+            assert out["shape"] == [3]
+            assert out["datatype"] == "INT32"
+    finally:
+        await manager.stop_async()

@@ -389,3 +389,17 @@ def test_bare_rows_canonicalize_to_masked_dict(tmp_path):
     np.testing.assert_allclose(
         np.asarray(bare["predictions"]),
         np.asarray(explicit["predictions"]), rtol=1e-4, atol=1e-5)
+
+
+def test_metadata_reports_signature(tmp_path):
+    """V2 model metadata carries real inputs/outputs (required_api.md):
+    shapes from jax.eval_shape with dynamic batch dim."""
+    model_dir = _write_model_dir(
+        tmp_path, arch="mlp",
+        arch_kwargs={"input_dim": 8, "features": [16], "num_classes": 3})
+    m = JaxModel("m", model_dir)
+    m.load()
+    meta = m.metadata()
+    assert meta["inputs"] == [
+        {"name": "input_0", "datatype": "FP32", "shape": [-1, 8]}]
+    assert meta["outputs"][0]["shape"] == [-1, 3]
