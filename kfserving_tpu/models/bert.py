@@ -28,6 +28,7 @@ class BertConfig:
                  num_layers=12, num_heads=12, intermediate_size=3072,
                  max_position=512, type_vocab_size=2,
                  layer_norm_eps=1e-12, dtype=jnp.bfloat16,
+                 gelu_approximate=True,
                  attn_fn=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -38,6 +39,9 @@ class BertConfig:
         self.type_vocab_size = type_vocab_size
         self.layer_norm_eps = layer_norm_eps
         self.dtype = dtype
+        # tanh-approx gelu is the TPU default; checkpoints converted
+        # from HF torch BERT ("gelu" = erf) set False for exact parity.
+        self.gelu_approximate = gelu_approximate
         # Pluggable attention impl (q, k, v, mask) -> out, mask being the
         # broadcastable [B, 1, 1, L] key-padding mask (or None).  Defaults
         # to ops.dot_product_attention; the sequence-parallel serving
@@ -84,7 +88,7 @@ class BertLayer(nn.Module):
                               name="attention_norm")(hidden + attn)
         mlp = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
                        name="intermediate")(hidden)
-        mlp = nn.gelu(mlp, approximate=True)
+        mlp = nn.gelu(mlp, approximate=cfg.gelu_approximate)
         mlp = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(mlp)
         return nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                             name="output_norm")(hidden + mlp)
@@ -119,7 +123,7 @@ class BertForMaskedLM(nn.Module):
         # MLM head: transform + tied-embedding decoder.
         hidden = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
                           name="mlm_transform")(hidden)
-        hidden = nn.gelu(hidden, approximate=True)
+        hidden = nn.gelu(hidden, approximate=cfg.gelu_approximate)
         hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                               name="mlm_norm")(hidden)
         logits = embed.attend(hidden.astype(embed.embedding.dtype))

@@ -31,6 +31,7 @@ class BottleneckBlock(nn.Module):
     conv: ModuleDef
     norm: ModuleDef
     act: Callable
+    pad3: Any = "SAME"  # 3x3 conv padding (torch ckpts need explicit 1)
 
     @nn.compact
     def __call__(self, x):
@@ -38,7 +39,8 @@ class BottleneckBlock(nn.Module):
         y = self.conv(self.filters, (1, 1))(x)
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.conv(self.filters, (3, 3), self.strides,
+                      padding=self.pad3)(y)
         y = self.norm()(y)
         y = self.act(y)
         y = self.conv(self.filters * 4, (1, 1))(y)
@@ -60,6 +62,11 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # torch checkpoints were trained with explicit (3,3)/(1,1) conv pads
+    # and a padded max_pool; "SAME" puts the asymmetric pad on the other
+    # side at even sizes, shifting every stride-2 conv by one pixel.
+    # Serving converted weights needs the torch geometry.
+    torch_padding: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -68,18 +75,22 @@ class ResNet(nn.Module):
         norm = partial(nn.BatchNorm, use_running_average=True,
                        momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         act = nn.relu
+        pad7 = ((3, 3), (3, 3)) if self.torch_padding else "SAME"
+        pad3 = ((1, 1), (1, 1)) if self.torch_padding else "SAME"
+        pool_pad = ((1, 1), (1, 1)) if self.torch_padding else "SAME"
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=pad7,
+                 name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = act(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=pool_pad)
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 x = BottleneckBlock(
                     self.num_filters * 2 ** i, strides=strides,
-                    conv=conv, norm=norm, act=act)(x)
+                    conv=conv, norm=norm, act=act, pad3=pad3)(x)
         x = jnp.mean(x, axis=(1, 2))
         # Head in float32: logits feed softmax/argmax on host.
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
@@ -93,8 +104,10 @@ ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])
 
 
 def create_resnet50(num_classes: int = 1000, image_size: int = 224,
-                    dtype: Any = jnp.bfloat16):
+                    dtype: Any = jnp.bfloat16,
+                    torch_padding: bool = False):
     """Returns (module, example_input[1, H, W, 3])."""
-    module = ResNet50(num_classes=num_classes, dtype=dtype)
+    module = ResNet50(num_classes=num_classes, dtype=dtype,
+                      torch_padding=torch_padding)
     example = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     return module, example
