@@ -133,9 +133,20 @@ async def bench_resnet(smoke: bool) -> Dict[str, Any]:
             num_requests=128 if smoke else 2048,
             concurrency=16 if smoke else 256,
             headers={"Inference-Header-Content-Length": str(hlen)})
+        # Raw-socket pipelined mode: the aiohttp client above shares the
+        # single host core with the server (the reference ran vegeta on
+        # a separate machine); this shows true server capacity.
+        from benchmarks.harness import pipelined_closed_loop
+
+        piped = await pipelined_closed_loop(
+            server.http_port, "/v2/models/resnet/infer", bin_body,
+            num_requests=256 if smoke else 4096,
+            connections=4 if smoke else 8,
+            headers={"Inference-Header-Content-Length": str(hlen)})
         stats = model.engine_stats()
         return {"closed_loop": peak, "fixed_rate": fixed,
                 "binary_wire_closed_loop": binary,
+                "binary_wire_pipelined": piped,
                 "compile_s": round(compile_s, 1),
                 "engine": {k: (round(v, 4) if isinstance(v, float) else v)
                            for k, v in stats.items()}}
