@@ -84,6 +84,12 @@ def main():
         "vs_baseline": round(vs, 2) if vs else None,
         "p50_ms": peak.get("p50_ms"),
         "p99_ms": peak.get("p99_ms"),
+        # The native tensor wire (V2 binary extension) and the raw-
+        # socket pipelined server-capacity number for the same model.
+        "binary_wire_req_per_s": (resnet.get(
+            "binary_wire_closed_loop", {}) or {}).get("req_per_s"),
+        "pipelined_req_per_s": (resnet.get(
+            "binary_wire_pipelined", {}) or {}).get("req_per_s"),
         "mfu": resnet.get("engine", {}).get("mfu"),
         "compile_s": resnet.get("compile_s"),
         "cpu_baseline": cpu,
