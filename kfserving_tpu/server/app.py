@@ -184,6 +184,15 @@ class ModelServer:
         r.add("GET", "/v2/models/{name}", self._model_metadata)
         r.add("POST", "/v1/models/{name}:predict", self._predict_v1)
         r.add("POST", "/v2/models/{name}/infer", self._infer_v2)
+        # Versioned forms (required_api.md:35-56 — the version segment
+        # is optional for servers with one live version per name; these
+        # accept any version and serve the registered model).
+        r.add("GET", "/v2/models/{name}/versions/{version}/ready",
+              self._model_ready)
+        r.add("GET", "/v2/models/{name}/versions/{version}",
+              self._model_metadata)
+        r.add("POST", "/v2/models/{name}/versions/{version}/infer",
+              self._infer_v2)
         r.add("POST", "/v1/models/{name}:explain", self._explain)
         r.add("POST", "/v2/models/{name}/explain", self._explain)
         r.add("POST", "/v2/repository/models/{name}/load", self._load)

@@ -341,3 +341,21 @@ def test_binary_hop_falls_back_to_v1_only_downstream():
             await front.close()
 
     asyncio.run(run())
+
+
+async def test_v2_versioned_routes():
+    """required_api.md versioned forms: one live version per name, any
+    version segment serves the registered model."""
+    async with serve() as server:
+        status, body = await http_json(
+            server.http_port, "GET", "/v2/models/TestModel/versions/1")
+        assert status == 200 and body["name"] == "TestModel"
+        status, _ = await http_json(
+            server.http_port, "GET",
+            "/v2/models/TestModel/versions/1/ready")
+        assert status == 200
+        status, body = await http_json(
+            server.http_port, "POST",
+            "/v2/models/TestModel/versions/1/infer",
+            {"instances": [[1, 2]]})
+        assert status == 200 and body == {"predictions": [[1, 2]]}
