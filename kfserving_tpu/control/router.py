@@ -91,18 +91,36 @@ class IngressRouter:
                 return t.revision
         return targets[-1].revision
 
-    def _pick_replica(self, cid: str, revision: str) -> Optional[str]:
+    def _pick_replica(self, cid: str, revision: str,
+                      exclude=()) -> Optional[str]:
         replicas = [r for r in
                     self.controller.reconciler.orchestrator.replicas(cid)
-                    if r.revision == revision]
+                    if r.revision == revision and r.host not in exclude]
         if not replicas:
             return None
         idx = self._rr.get(cid, 0)
         self._rr[cid] = idx + 1
         return replicas[idx % len(replicas)].host
 
+    async def _evict_replica(self, cid: str, host: str) -> None:
+        """Drop a replica whose transport failed (crashed process) so
+        rotation skips it; the reconciler/autoscaler recreates capacity
+        on its next pass (the reference leans on kubelet restart +
+        readiness for this, SURVEY.md §5.3)."""
+        orch = self.controller.reconciler.orchestrator
+        for r in orch.replicas(cid):
+            if r.host == host:
+                try:
+                    await orch.delete_replica(r)
+                except Exception:
+                    logger.exception("evicting dead replica %s failed",
+                                     host)
+                logger.warning("evicted dead replica %s of %s", host, cid)
+                return
+
     async def _resolve(self, name: str, verb: str,
-                       component: Optional[str] = None
+                       component: Optional[str] = None,
+                       exclude=()
                        ) -> Tuple[Optional[str], Optional[str],
                                   Optional[str]]:
         """Returns (host, component_name, error)."""
@@ -119,7 +137,7 @@ class IngressRouter:
         if revision is None:
             return None, cname, f"no traffic targets for {name}/{cname}"
         cid = self.controller.reconciler.component_id(isvc, cname)
-        host = self._pick_replica(cid, revision)
+        host = self._pick_replica(cid, revision, exclude=exclude)
         if host is None:
             host = await self._activate(isvc, cname, cid, revision)
             if host is None:
@@ -153,50 +171,79 @@ class IngressRouter:
     async def _health(self, req: Request) -> Response:
         return await self._proxy(req, "health")
 
+    # Transport-level failover attempts per request: a crashed replica is
+    # evicted and the request retries the next one (the reference leans
+    # on kubelet restart + readiness gates; a single-host fabric must
+    # handle the dead-process window itself).
+    MAX_UPSTREAM_ATTEMPTS = 3
+
     async def _proxy(self, req: Request, verb: str,
                      component: Optional[str] = None,
                      strip_prefix: str = "") -> Response:
+        from kfserving_tpu.tracing import REQUEST_ID_HEADER
+
         name = req.path_params["name"]
-        host, cname, err = await self._resolve(name, verb, component)
-        if err is not None:
-            # json.dumps, not f-string interpolation: err embeds the
-            # client-supplied model name, which may contain quotes.
-            return Response(
-                body=json.dumps({"error": err}).encode(), status=404)
         path = req.path
         if strip_prefix and path.startswith(strip_prefix):
             path = path[len(strip_prefix):]
-        url = f"http://{host}{path}"
-        # Per-component gauge: the autoscaler must see transformer and
-        # predictor traffic separately (they scale independently).
-        cid = f"router/{name}/{cname}"
-        self.inflight[cid] = self.inflight.get(cid, 0) + 1
-        self.request_count[cid] = self.request_count.get(cid, 0) + 1
+        headers = {k: v for k, v in req.headers.items()
+                   if k.lower() not in ("host", "content-length",
+                                        "connection")}
+        # Mint the request id at ingress so router, replica, and
+        # engine spans all share one trace id.
+        if REQUEST_ID_HEADER not in headers:
+            import uuid
+
+            headers[REQUEST_ID_HEADER] = uuid.uuid4().hex[:16]
+
+        failed: set = set()
+        gauge_cid = None
         try:
-            from kfserving_tpu.tracing import REQUEST_ID_HEADER
-
-            headers = {k: v for k, v in req.headers.items()
-                       if k.lower() not in ("host", "content-length",
-                                            "connection")}
-            # Mint the request id at ingress so router, replica, and
-            # engine spans all share one trace id.
-            if REQUEST_ID_HEADER not in headers:
-                import uuid
-
-                headers[REQUEST_ID_HEADER] = uuid.uuid4().hex[:16]
-            async with self._session.request(
-                    req.method, url, data=req.body or None,
-                    headers=headers) as upstream:
-                body = await upstream.read()
-                resp_headers = {
-                    k: v for k, v in upstream.headers.items()
-                    if k.lower() in ("content-type", REQUEST_ID_HEADER)
-                    or k.lower().startswith("ce-")}
-                return Response(body=body, status=upstream.status,
-                                headers=resp_headers)
-        except Exception as e:
-            logger.warning("proxy to %s failed: %s", url, e)
+            for attempt in range(self.MAX_UPSTREAM_ATTEMPTS):
+                host, cname, err = await self._resolve(
+                    name, verb, component, exclude=failed)
+                if err is not None:
+                    # json.dumps, not f-string interpolation: err embeds
+                    # the client-supplied model name (may contain quotes).
+                    return Response(
+                        body=json.dumps({"error": err}).encode(),
+                        status=404)
+                if gauge_cid is None:
+                    # Per-component gauge: the autoscaler must see
+                    # transformer and predictor traffic separately.
+                    gauge_cid = f"router/{name}/{cname}"
+                    self.inflight[gauge_cid] = \
+                        self.inflight.get(gauge_cid, 0) + 1
+                    self.request_count[gauge_cid] = \
+                        self.request_count.get(gauge_cid, 0) + 1
+                url = f"http://{host}{path}"
+                try:
+                    async with self._session.request(
+                            req.method, url, data=req.body or None,
+                            headers=headers) as upstream:
+                        body = await upstream.read()
+                        resp_headers = {
+                            k: v for k, v in upstream.headers.items()
+                            if k.lower() in ("content-type",
+                                             REQUEST_ID_HEADER)
+                            or k.lower().startswith("ce-")}
+                        return Response(body=body,
+                                        status=upstream.status,
+                                        headers=resp_headers)
+                except Exception as e:
+                    # Transport failure (refused/reset/timeout): the
+                    # replica is gone — evict and fail over.  HTTP-level
+                    # errors returned above are never retried.
+                    logger.warning("proxy to %s failed (attempt %d): %s",
+                                   url, attempt + 1, e)
+                    failed.add(host)
+                    isvc = self.controller.get(name)
+                    if isvc is not None:
+                        cid = self.controller.reconciler.component_id(
+                            isvc, cname)
+                        await self._evict_replica(cid, host)
             return Response(
                 body=b'{"error": "upstream unavailable"}', status=503)
         finally:
-            self.inflight[cid] -= 1
+            if gauge_cid is not None:
+                self.inflight[gauge_cid] -= 1

@@ -443,3 +443,51 @@ async def test_autoscaler_scales_components_independently(tmp_path):
     for _ in range(8):
         await scaler.tick()
     assert len(orch.replicas("default/duo/transformer")) == 6  # 24/4
+
+
+@pytest.mark.asyncio
+async def test_router_fails_over_dead_replica(tmp_path):
+    """Transport failure -> evict the dead replica and retry the next
+    one; the client sees 200, not 503 (the single-host analogue of
+    kubelet restart + readiness gates)."""
+    import aiohttp
+    import joblib
+    from sklearn import datasets, svm
+
+    artifact = str(tmp_path / "iris")
+    os.makedirs(artifact)
+    X, y = datasets.load_iris(return_X_y=True)
+    joblib.dump(svm.SVC(gamma="scale").fit(X, y),
+                os.path.join(artifact, "model.joblib"))
+
+    orch = InProcessOrchestrator()
+    controller = Controller(orch)
+    router = IngressRouter(controller)
+    await router.start_async()
+    try:
+        isvc = InferenceService(
+            name="ha", predictor=PredictorSpec(
+                framework="sklearn", storage_uri=f"file://{artifact}",
+                min_replicas=2, max_replicas=2))
+        await controller.apply(isvc)
+        cid = "default/ha/predictor"
+        replicas = orch.replicas(cid)
+        assert len(replicas) == 2
+        # Kill one replica's server out from under the router.
+        dead = replicas[0]
+        await dead.handle.stop_async()
+
+        rows = [[6.8, 2.8, 4.8, 1.4]]
+        async with aiohttp.ClientSession() as session:
+            for _ in range(4):  # RR hits the dead host at least once
+                async with session.post(
+                        f"http://127.0.0.1:{router.http_port}"
+                        f"/v1/models/ha:predict",
+                        json={"instances": rows}) as resp:
+                    assert resp.status == 200, await resp.text()
+                    assert (await resp.json())["predictions"] == [1]
+        # The dead replica was evicted from the rotation.
+        assert dead.host not in [r.host for r in orch.replicas(cid)]
+    finally:
+        await router.stop_async()
+        await orch.shutdown()
