@@ -28,9 +28,11 @@ ACTIVATOR_TIMEOUT_S = 60.0
 
 
 class IngressRouter:
-    def __init__(self, controller, http_port: int = 0, seed: int = 0):
+    def __init__(self, controller, http_port: int = 0, seed: int = 0,
+                 upstream_timeout_s: Optional[float] = None):
         self.controller = controller  # Controller (store + reconciler)
         self.http_port = http_port
+        self.upstream_timeout_s = upstream_timeout_s or ACTIVATOR_TIMEOUT_S
         self._rng = random.Random(seed)
         self._rr = {}  # component_id -> round-robin counter
         self.router = Router()
@@ -59,7 +61,7 @@ class IngressRouter:
         import aiohttp
 
         self._session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=ACTIVATOR_TIMEOUT_S))
+            timeout=aiohttp.ClientTimeout(total=self.upstream_timeout_s))
         await self.http_server.start(host, self.http_port)
         self.http_port = self.http_server.port
 
@@ -203,11 +205,18 @@ class IngressRouter:
                 host, cname, err = await self._resolve(
                     name, verb, component, exclude=failed)
                 if err is not None:
+                    # Unknown service/component is a true 404; replica
+                    # exhaustion (e.g. after evicting a crashed one) is
+                    # transient unavailability and must stay 503 so
+                    # clients keep retrying.
+                    status = (503 if err.startswith(("no replicas",
+                                                     "no traffic"))
+                              else 404)
                     # json.dumps, not f-string interpolation: err embeds
                     # the client-supplied model name (may contain quotes).
                     return Response(
                         body=json.dumps({"error": err}).encode(),
-                        status=404)
+                        status=status)
                 if gauge_cid is None:
                     # Per-component gauge: the autoscaler must see
                     # transformer and predictor traffic separately.
@@ -230,10 +239,20 @@ class IngressRouter:
                         return Response(body=body,
                                         status=upstream.status,
                                         headers=resp_headers)
+                except asyncio.TimeoutError:
+                    # A slow-but-alive replica (heavy batch, warmup
+                    # compile): do NOT evict (it would kill in-flight
+                    # work) and do NOT retry (the request may still
+                    # execute — a retry would duplicate inference).
+                    logger.warning("proxy to %s timed out", url)
+                    return Response(
+                        body=b'{"error": "upstream timeout"}',
+                        status=504)
                 except Exception as e:
-                    # Transport failure (refused/reset/timeout): the
-                    # replica is gone — evict and fail over.  HTTP-level
-                    # errors returned above are never retried.
+                    # Connection-level failure (refused/reset/closed):
+                    # the replica process is gone — evict and fail
+                    # over.  HTTP-level errors returned above are never
+                    # retried.
                     logger.warning("proxy to %s failed (attempt %d): %s",
                                    url, attempt + 1, e)
                     failed.add(host)

@@ -27,6 +27,10 @@ PREDICTOR_V2_URL_FORMAT = "http://{0}/v2/models/{1}/infer"
 EXPLAINER_V2_URL_FORMAT = "http://{0}/v2/models/{1}/explain"
 
 
+class _BinaryHopUnsupported(Exception):
+    """The downstream has no V2 infer route (V1-only server)."""
+
+
 def _np_json_default(obj):
     import numpy as np
 
@@ -147,11 +151,14 @@ class Model:
             if arr is not None:
                 try:
                     return await self._predict_binary(arr)
-                except InferenceError:
-                    # Downstream may be a V1-only predictor (the
-                    # reference contract allows any V1 server across the
-                    # pod boundary, kfmodel.py:88-104): fall back to the
-                    # configured V1 route and stop trying binary.
+                except _BinaryHopUnsupported:
+                    # Downstream is a V1-only predictor (404/405 on the
+                    # /v2 route — the reference contract allows any V1
+                    # server across the pod boundary, kfmodel.py:88-104):
+                    # fall back to the configured V1 route and stop
+                    # trying binary.  Any OTHER error (4xx/5xx from a
+                    # V2-capable server) propagates — replaying it over
+                    # V1 would duplicate inference and hide the error.
                     self._binary_hop = False
         if self.protocol == "v2":
             url = PREDICTOR_V2_URL_FORMAT.format(self.predictor_host, self.name)
@@ -201,6 +208,9 @@ class Model:
         async with self.http_session.post(url, data=body,
                                           headers=headers) as resp:
             payload = await resp.read()
+            if resp.status in (404, 405, 501):
+                raise _BinaryHopUnsupported(
+                    payload.decode("utf-8", "replace"))
             if resp.status != 200:
                 raise InferenceError(payload.decode("utf-8", "replace"))
         return _v2_response_to_v1(json.loads(payload))

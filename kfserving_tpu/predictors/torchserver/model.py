@@ -12,7 +12,8 @@ the reference: exactly one .py file in the model dir, class name from
 config (default "PyTorchModel"), strict state-dict load, eval() mode.
 """
 
-import importlib
+import hashlib
+import importlib.util
 import logging
 import os
 import sys
@@ -52,13 +53,17 @@ class PyTorchModel(Model):
             # Reference contract: exactly one Python file per model dir.
             raise InvalidInput(
                 f"More than one Python file is detected: {sorted(py_files)}")
-        module_name = py_files[0][:-3].replace("-", "_")
-        if local_dir not in sys.path:
-            sys.path.append(local_dir)
-        module = importlib.import_module(module_name)
-        # The module may be cached from a previous load of a different
-        # revision in the same dir; reload to pick up edits.
-        module = importlib.reload(module)
+        # Unique module identity per model dir: two models whose class
+        # files share a filename (net.py) must not alias each other's
+        # cached module (multi-model serving in one process).
+        class_file = os.path.join(local_dir, py_files[0])
+        module_name = ("kfserving_tpu._torch_user_"
+                       + hashlib.sha1(class_file.encode()).hexdigest()[:12])
+        spec = importlib.util.spec_from_file_location(
+            module_name, class_file)
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[module_name] = module
+        spec.loader.exec_module(module)
         model_class = getattr(module, self.model_class_name)
         self._model = model_class()
         self._model.load_state_dict(

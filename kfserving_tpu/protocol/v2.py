@@ -255,15 +255,27 @@ def make_binary_request(tensors: Dict[str, np.ndarray],
     Inference-Header-Content-Length header set."""
     import json as _json
 
+    import struct
+
     inputs = []
     raws = []
     for name, arr in tensors.items():
         arr = np.ascontiguousarray(arr)
-        raw = arr.tobytes()
+        datatype = datatype_of(arr)
+        if datatype == "BYTES":
+            # Element framing required by decode_raw_bytes: 4-byte LE
+            # length before each element (raw .tobytes() of S/object
+            # arrays would misparse server-side).
+            elems = [e if isinstance(e, bytes)
+                     else str(e).encode() for e in arr.ravel()]
+            raw = b"".join(struct.pack("<I", len(e)) + e
+                           for e in elems)
+        else:
+            raw = arr.tobytes()
         raws.append(raw)
         inputs.append({
             "name": name, "shape": list(arr.shape),
-            "datatype": datatype_of(arr),
+            "datatype": datatype,
             "parameters": {"binary_data_size": len(raw)},
         })
     header: Dict[str, Any] = {"inputs": inputs}

@@ -58,16 +58,20 @@ def _v2_datatype(dtype) -> str:
 
 
 def _shapes_of(tree) -> List[Dict[str, Any]]:
-    """Flatten a pytree of ShapeDtypeStructs/arrays to name/shape/dtype."""
-    import jax
+    """Flatten a pytree of ShapeDtypeStructs/arrays to name/shape/dtype.
 
-    leaves, treedef = jax.tree.flatten(tree)
+    Dicts iterate their own items (NOT zip(keys, jax.tree.flatten) —
+    flatten sorts keys, which silently swapped shapes between tensors
+    whose insertion order differed from sorted order)."""
     if isinstance(tree, dict):
-        names = list(tree.keys())
+        items = list(tree.items())
     else:
-        names = [f"output_{i}" for i in range(len(leaves))]
+        import jax
+
+        leaves, _ = jax.tree.flatten(tree)
+        items = [(f"output_{i}", leaf) for i, leaf in enumerate(leaves)]
     return [{"name": n, "shape": [int(s) for s in leaf.shape],
-             "dtype": leaf.dtype} for n, leaf in zip(names, leaves)]
+             "dtype": leaf.dtype} for n, leaf in items]
 
 
 def model_signature(architecture: str,

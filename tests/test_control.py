@@ -491,3 +491,46 @@ async def test_router_fails_over_dead_replica(tmp_path):
     finally:
         await router.stop_async()
         await orch.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_router_timeout_does_not_evict(tmp_path):
+    """A slow-but-alive replica must NOT be evicted or retried on
+    client timeout (eviction would kill in-flight work; a retry would
+    duplicate inference): the client gets 504 and the replica stays."""
+    from kfserving_tpu import Model
+
+    class SlowModel(Model):
+        def load(self):
+            self.ready = True
+            return True
+
+        async def predict(self, request):
+            await asyncio.sleep(3.0)
+            return {"predictions": [1]}
+
+    def factory(component_id, spec):
+        return SlowModel(component_id.split("/")[1])
+
+    orch = InProcessOrchestrator(model_factory=factory)
+    controller = Controller(orch)
+    router = IngressRouter(controller, upstream_timeout_s=0.5)
+    await router.start_async()
+    try:
+        isvc = _isvc(name="slow", framework="custom")
+        isvc.predictor.command = ["unused"]
+        await controller.apply(isvc)
+        cid = "default/slow/predictor"
+        assert len(orch.replicas(cid)) == 1
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f"http://127.0.0.1:{router.http_port}"
+                    f"/v1/models/slow:predict",
+                    json={"instances": [[1]]}) as resp:
+                assert resp.status == 504, await resp.text()
+        assert len(orch.replicas(cid)) == 1  # still in rotation
+    finally:
+        await router.stop_async()
+        await orch.shutdown()

@@ -284,3 +284,36 @@ def test_pytorch_model_rejects_ambiguous_class_files(tmp_path):
     m = PyTorchModel("torchy", f"file://{d}")
     with pytest.raises(Exception, match="More than one Python file"):
         m.load()
+
+
+def test_two_pytorch_models_with_same_class_filename(tmp_path):
+    """Two model dirs both using net.py must not alias each other's
+    cached module (multi-model serving in one process)."""
+    import torch
+
+    def make(dirname, scale):
+        d = tmp_path / dirname
+        d.mkdir()
+        (d / "net.py").write_text(
+            "import torch\n"
+            "class PyTorchModel(torch.nn.Module):\n"
+            "    def forward(self, x):\n"
+            f"        return x * {scale}\n")
+        torch.save({}, d / "model.pt")
+        return d
+
+    from kfserving_tpu.predictors.torchserver import PyTorchModel
+
+    a = PyTorchModel("a", f"file://{make('ma', 2)}")
+    b = PyTorchModel("b", f"file://{make('mb', 10)}")
+    a.load()
+    b.load()
+
+    async def run():
+        ra = await a.predict({"instances": [[1.0]]})
+        rb = await b.predict({"instances": [[1.0]]})
+        return ra, rb
+
+    ra, rb = asyncio.run(run())
+    assert ra["predictions"] == [[2.0]]
+    assert rb["predictions"] == [[10.0]]

@@ -193,3 +193,19 @@ class TestV2BinaryErrorPaths:
                                           len(hbytes))
         with pytest.raises(InvalidInput, match="does not fit datatype"):
             req.inputs[0].as_numpy()
+
+
+class TestBinaryBytesFraming:
+    def test_bytes_tensor_round_trips_through_encoder(self):
+        """make_binary_request must frame BYTES elements (4-byte LE
+        lengths) the way decode_raw_bytes expects."""
+        arr = np.array([b"ab", b"cdef"], dtype=np.object_)
+        body, hlen = v2.make_binary_request({"s": arr})
+        req = v2.InferRequest.from_binary(body, hlen)
+        assert list(req.inputs[0].as_numpy()) == [b"ab", b"cdef"]
+
+    def test_fixed_width_string_array(self):
+        arr = np.array(["hi", "there"])  # dtype <U5
+        body, hlen = v2.make_binary_request({"s": arr})
+        req = v2.InferRequest.from_binary(body, hlen)
+        assert list(req.inputs[0].as_numpy()) == [b"hi", b"there"]

@@ -5,6 +5,8 @@ the full route table, error paths, and CloudEvents binary/structured modes.
 
 import asyncio
 import json
+
+import pytest
 from contextlib import asynccontextmanager
 
 from kfserving_tpu import Model
@@ -311,33 +313,95 @@ async def test_container_concurrency_queue_drains():
 
 
 def test_binary_hop_falls_back_to_v1_only_downstream():
-    """A transformer chained to a V1-only predictor: the binary V2 hop
-    fails, the proxy falls back to the configured V1 route (np-aware
-    JSON), and stops attempting binary."""
+    """A transformer chained to a truly V1-only predictor (no /v2
+    routes, like a reference server): the binary hop gets 404, the
+    proxy falls back to the configured V1 route (np-aware JSON), and
+    stops attempting binary."""
     import numpy as np
 
     from kfserving_tpu import Model as BaseModel
 
-    class V1Only(DummyModel):
-        async def predict(self, request):
-            # a reference-style V1 server: dict in, dict out
-            assert isinstance(request, dict), type(request)
-            return {"predictions": [int(np.sum(i))
-                                    for i in request["instances"]]}
+    async def v1_only_server():
+        """Minimal reference-style server: /v1 predict only."""
+        async def handle(reader, writer):
+            while True:
+                try:
+                    line = await reader.readline()
+                except (ConnectionError, asyncio.IncompleteReadError):
+                    return
+                if not line:
+                    return
+                path = line.split()[1].decode()
+                length = 0
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b""):
+                        break
+                    if h.lower().startswith(b"content-length:"):
+                        length = int(h.split(b":")[1])
+                body = await reader.readexactly(length)
+                if path.startswith("/v2/"):
+                    payload = b'{"error": "not found"}'
+                    writer.write(
+                        b"HTTP/1.1 404 Not Found\r\nContent-Length: "
+                        + str(len(payload)).encode()
+                        + b"\r\n\r\n" + payload)
+                else:
+                    req = json.loads(body)
+                    preds = [int(np.sum(i)) for i in req["instances"]]
+                    payload = json.dumps(
+                        {"predictions": preds}).encode()
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\nContent-Length: "
+                        + str(len(payload)).encode()
+                        + b"\r\n\r\n" + payload)
+                await writer.drain()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        return server, server.sockets[0].getsockname()[1]
 
     async def run():
-        backend = V1Only()
-        backend.load()
-        async with running_server([backend]) as server:
+        server, port = await v1_only_server()
+        try:
             front = BaseModel("TestModel")
-            front.predictor_host = f"127.0.0.1:{server.http_port}"
+            front.predictor_host = f"127.0.0.1:{port}"
             dense = {"instances": [np.ones((2, 2), np.float32)]}
-            out = await front.predict(dense)
+            out = await asyncio.wait_for(front.predict(dense), 20)
             assert out["predictions"] == [4]
             assert front._binary_hop is False  # won't retry binary
             out2 = await front.predict(
                 {"instances": [np.full((2, 2), 2.0, np.float32)]})
             assert out2["predictions"] == [8]
+            await front.close()
+        finally:
+            # No wait_closed(): the keep-alive handler coroutine may
+            # still sit in readline() and 3.12's wait_closed waits for
+            # every handler; close() is enough for a test socket.
+            server.close()
+
+    asyncio.run(run())
+
+
+def test_binary_hop_error_from_v2_server_propagates():
+    """A V2-capable downstream returning 400 must NOT trigger the V1
+    fallback (that would duplicate inference and hide the error)."""
+    import numpy as np
+
+    from kfserving_tpu import Model as BaseModel
+    from kfserving_tpu.protocol.errors import InferenceError
+
+    async def run():
+        backend = DummyModel()
+        backend.load()
+        async with running_server([backend]) as server:
+            front = BaseModel("TestModel")
+            front.predictor_host = f"127.0.0.1:{server.http_port}"
+            # DummyModel.predict crashes on InferRequest input -> 500
+            # from a server that DOES have the /v2 route.
+            with pytest.raises(InferenceError):
+                await front.predict(
+                    {"instances": [np.ones((2, 2), np.float32)]})
+            assert front._binary_hop is True  # not disabled
             await front.close()
 
     asyncio.run(run())

@@ -73,3 +73,18 @@ def test_cli_from_model_dir(tmp_path):
     assert out.returncode == 0, out.stderr
     doc = json.loads(out.stdout)
     assert "/v1/models/svc:predict" in doc["paths"]
+
+
+def test_shapes_preserve_dict_names():
+    """Dict tensors must keep their own shapes — zip(keys, flatten)
+    once swapped shapes when insertion order differed from sorted."""
+    import numpy as np
+
+    from kfserving_tpu.tools.jax2openapi import _shapes_of
+
+    out = _shapes_of({"zz_ids": np.zeros((1, 16), np.int32),
+                      "aa_mask": np.zeros((1, 4), np.float32)})
+    by_name = {e["name"]: e for e in out}
+    assert by_name["zz_ids"]["shape"] == [1, 16]
+    assert str(by_name["zz_ids"]["dtype"]) == "int32"
+    assert by_name["aa_mask"]["shape"] == [1, 4]
