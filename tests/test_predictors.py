@@ -317,3 +317,67 @@ def test_two_pytorch_models_with_same_class_filename(tmp_path):
     ra, rb = asyncio.run(run())
     assert ra["predictions"] == [[2.0]]
     assert rb["predictions"] == [[10.0]]
+
+
+def test_fairness_explainer_metrics():
+    """aiffairness parity (reference aifserver/model.py:55-90):
+    hand-computed base rates, parity difference, disparate impact."""
+    from kfserving_tpu.explainers import FairnessExplainer
+
+    ex = FairnessExplainer(
+        "fair", feature_names=["age", "income"],
+        privileged_groups=[{"age": 1}],
+        unprivileged_groups=[{"age": 0}])
+    # age=1 rows: preds [1, 1, 0] -> rate 2/3; age=0: [1, 0, 0] -> 1/3
+    X = [[1, 10], [1, 20], [1, 30], [0, 10], [0, 20], [0, 30]]
+    preds = [1, 1, 0, 1, 0, 0]
+
+    async def run():
+        return await ex.explain({"instances": X, "outputs": preds})
+
+    out = asyncio.run(run())
+    m = out["metrics"]
+    assert m["num_instances"] == 6
+    assert m["num_positives"] == 3 and m["num_negatives"] == 3
+    assert m["base_rate"] == pytest.approx(0.5)
+    assert m["statistical_parity_difference"] == pytest.approx(
+        1 / 3 - 2 / 3)
+    assert m["disparate_impact"] == pytest.approx(0.5)
+    assert 0.0 <= m["consistency"][0] <= 1.0
+    assert out["predictions"] == [1, 1, 0, 1, 0, 0]
+
+
+def test_fairness_explainer_scores_via_predictor(tmp_path):
+    """Without precomputed outputs the explainer proxies to the
+    predictor (reference _predict path)."""
+    import joblib
+    from sklearn import datasets, svm
+
+    from kfserving_tpu.explainers import FairnessExplainer
+    from tests.utils import running_server
+
+    d = tmp_path / "iris"
+    d.mkdir()
+    X, y = datasets.load_iris(return_X_y=True)
+    joblib.dump(svm.SVC(gamma="scale").fit(X, (y == 1).astype(int)),
+                os.path.join(d, "model.joblib"))
+    model = SKLearnModel("fair", str(d))
+    model.load()
+
+    async def run():
+        async with running_server([model]) as server:
+            ex = FairnessExplainer(
+                "fair",
+                feature_names=["sl", "sw", "pl", "pw"],
+                privileged_groups=[{"sl": 6.8}],
+                unprivileged_groups=[{"sl": 6.0}],
+                predictor_host=f"127.0.0.1:{server.http_port}")
+            out = await ex.explain(
+                {"instances": [[6.8, 2.8, 4.8, 1.4],
+                               [6.0, 3.4, 4.5, 1.6]]})
+            await ex.close()
+            return out
+
+    out = asyncio.run(run())
+    assert out["predictions"] == [1, 1]
+    assert out["metrics"]["num_instances"] == 2
