@@ -204,24 +204,33 @@ class KFServingClient:
         return await self._request("POST", url, payload)
 
     async def predict_binary(self, name: str, tensors: Dict[str, Any],
-                             model_name: Optional[str] = None
+                             model_name: Optional[str] = None,
+                             binary_output: bool = False
                              ) -> Dict[str, Any]:
         """V2 binary-wire predict: tensors {name: ndarray} ship as raw
         bytes (Inference-Header-Content-Length extension) — the fast
-        wire for dense inputs (images, token ids)."""
+        wire for dense inputs (images, token ids).  binary_output=True
+        returns outputs as raw bytes too; their "data" decode to numpy
+        arrays client-side."""
         import numpy as np
 
         from kfserving_tpu.protocol import v2 as v2proto
 
         model = model_name or name
         body, hlen = v2proto.make_binary_request(
-            {k: np.asarray(v) for k, v in tensors.items()})
+            {k: np.asarray(v) for k, v in tensors.items()},
+            binary_output=binary_output)
         url = f"{self._ingress()}/v2/models/{model}/infer"
         session = await self._ensure_session()
         headers = {"Inference-Header-Content-Length": str(hlen),
                    "Content-Type": "application/octet-stream"}
         async with session.post(url, data=body, headers=headers) as resp:
             payload = await resp.read()
+            resp_hlen = resp.headers.get(
+                "Inference-Header-Content-Length")
+            if resp.status < 400 and resp_hlen:
+                return v2proto.decode_binary_response(
+                    payload, int(resp_hlen))
             try:
                 decoded = json.loads(payload) if payload else {}
             except ValueError:

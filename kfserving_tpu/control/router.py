@@ -233,8 +233,10 @@ class IngressRouter:
                         body = await upstream.read()
                         resp_headers = {
                             k: v for k, v in upstream.headers.items()
-                            if k.lower() in ("content-type",
-                                             REQUEST_ID_HEADER)
+                            if k.lower() in (
+                                "content-type",
+                                "inference-header-content-length",
+                                REQUEST_ID_HEADER)
                             or k.lower().startswith("ce-")}
                         return Response(body=body,
                                         status=upstream.status,

@@ -249,10 +249,13 @@ class InferRequest:
 
 
 def make_binary_request(tensors: Dict[str, np.ndarray],
-                        id: Optional[str] = None) -> "tuple[bytes, int]":
+                        id: Optional[str] = None,
+                        binary_output: bool = False
+                        ) -> "tuple[bytes, int]":
     """Client-side encoder for the binary extension: returns
     (body, header_length) ready to POST with the
-    Inference-Header-Content-Length header set."""
+    Inference-Header-Content-Length header set.  binary_output=True
+    asks the server to return outputs as raw bytes too."""
     import json as _json
 
     import struct
@@ -279,6 +282,8 @@ def make_binary_request(tensors: Dict[str, np.ndarray],
             "parameters": {"binary_data_size": len(raw)},
         })
     header: Dict[str, Any] = {"inputs": inputs}
+    if binary_output:
+        header["parameters"] = {"binary_data_output": True}
     if id is not None:
         header["id"] = id
     hbytes = _json.dumps(header).encode()
@@ -286,6 +291,66 @@ def make_binary_request(tensors: Dict[str, np.ndarray],
 
 
 INFERENCE_HEADER_CONTENT_LENGTH = "inference-header-content-length"
+
+
+def encode_binary_response(response: Dict[str, Any]
+                           ) -> "tuple[bytes, int]":
+    """Binary-extension response encoding: outputs' data ships as raw
+    bytes after the JSON header (the response-side twin of
+    raw_output_contents, grpc_predict_v2.proto:773).  Returns
+    (body, header_length)."""
+    import json as _json
+    import struct
+
+    header = dict(response)
+    outputs = []
+    raws = []
+    for out in response.get("outputs", []):
+        data = out.get("data")
+        dtype = _numpy_dtype(out["datatype"])
+        if out["datatype"] == "BYTES":
+            elems = [e if isinstance(e, bytes) else str(e).encode()
+                     for e in np.asarray(data, np.object_).ravel()]
+            raw = b"".join(struct.pack("<I", len(e)) + e for e in elems)
+        else:
+            raw = np.ascontiguousarray(
+                np.asarray(data, dtype=dtype)).tobytes()
+        entry = {k: v for k, v in out.items() if k != "data"}
+        params = dict(entry.get("parameters") or {})
+        params["binary_data_size"] = len(raw)
+        entry["parameters"] = params
+        outputs.append(entry)
+        raws.append(raw)
+    header["outputs"] = outputs
+    hbytes = _json.dumps(header).encode()
+    return hbytes + b"".join(raws), len(hbytes)
+
+
+def decode_binary_response(body: bytes,
+                           header_length: int) -> Dict[str, Any]:
+    """Client-side decode of a binary-extension response: outputs' data
+    come back as numpy arrays."""
+    import json as _json
+
+    if header_length <= 0 or header_length > len(body):
+        raise InvalidInput(
+            f"response header length {header_length} out of range")
+    resp = _json.loads(body[:header_length])
+    offset = header_length
+    for out in resp.get("outputs", []):
+        size = int((out.get("parameters") or {})
+                   .get("binary_data_size") or 0)
+        if not size:
+            continue
+        raw = body[offset:offset + size]
+        offset += size
+        if out["datatype"] == "BYTES":
+            out["data"] = decode_raw_bytes(raw)
+        else:
+            out["data"] = np.frombuffer(
+                raw, dtype=_numpy_dtype(out["datatype"])
+            ).reshape(out["shape"])
+    return resp
 
 
 def tensor_to_output(name: str, arr: np.ndarray) -> Dict[str, Any]:

@@ -313,7 +313,8 @@ class ModelServer:
     def _encode_response(self, req: Request, body: Any, response: Any
                          ) -> Response:
         """Echo CloudEvents framing when the request was a CloudEvent
-        (reference handlers/http.py:96-109)."""
+        (reference handlers/http.py:96-109); binary-extension responses
+        when the V2 request asked for binary_data_output."""
         if isinstance(body, cloudevents.CloudEvent):
             event = cloudevents.CloudEvent(body.attributes, response)
             if cloudevents.is_structured(req.headers):
@@ -321,6 +322,21 @@ class ModelServer:
             else:
                 headers, payload = cloudevents.to_binary(event)
             return Response(payload, headers=headers)
+        from kfserving_tpu.protocol.v2 import (
+            InferRequest,
+            encode_binary_response,
+        )
+
+        if (isinstance(body, InferRequest)
+                and body.parameters.get("binary_data_output")
+                and isinstance(response, dict)
+                and response.get("outputs")):
+            payload, hlen = encode_binary_response(response)
+            return Response(
+                payload,
+                headers={
+                    "content-type": "application/octet-stream",
+                    "inference-header-content-length": str(hlen)})
         return _json(response)
 
     async def _load(self, req: Request) -> Response:

@@ -403,3 +403,36 @@ def test_metadata_reports_signature(tmp_path):
     assert meta["inputs"] == [
         {"name": "input_0", "datatype": "FP32", "shape": [-1, 8]}]
     assert meta["outputs"][0]["shape"] == [-1, 3]
+
+
+def test_v2_binary_response_through_server(tmp_path):
+    """binary_data_output: the server returns outputs as raw bytes with
+    its own Inference-Header-Content-Length."""
+    from kfserving_tpu.protocol import v2
+    from tests.utils import http_request, running_server
+
+    model_dir = _write_model_dir(
+        tmp_path, arch="mlp",
+        arch_kwargs={"input_dim": 8, "features": [16], "num_classes": 4},
+        config_extra={"max_latency_ms": 2, "output": "topk", "topk": 2})
+    m = JaxModel("m", model_dir)
+    m.load()
+
+    async def run():
+        async with running_server([m]) as server:
+            x = np.random.default_rng(0).normal(
+                size=(3, 8)).astype(np.float32)
+            body, hlen = v2.make_binary_request(
+                {"input_0": x}, binary_output=True)
+            status, headers, raw = await http_request(
+                server.http_port, "POST", "/v2/models/m/infer", body,
+                headers={"Inference-Header-Content-Length": str(hlen)})
+            assert status == 200, raw
+            resp_hlen = headers.get("inference-header-content-length")
+            assert resp_hlen, headers
+            resp = v2.decode_binary_response(raw, int(resp_hlen))
+            by_name = {o["name"]: o for o in resp["outputs"]}
+            assert by_name["values"]["data"].shape == (3, 2)
+            assert by_name["indices"]["data"].dtype == np.int32
+
+    asyncio.run(run())

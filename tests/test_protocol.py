@@ -209,3 +209,35 @@ class TestBinaryBytesFraming:
         body, hlen = v2.make_binary_request({"s": arr})
         req = v2.InferRequest.from_binary(body, hlen)
         assert list(req.inputs[0].as_numpy()) == [b"hi", b"there"]
+
+
+class TestBinaryResponse:
+    def test_encode_decode_round_trip(self):
+        resp = {"model_name": "m", "outputs": [
+            {"name": "out", "shape": [2, 3], "datatype": "FP32",
+             "data": [1, 2, 3, 4, 5, 6]},
+            {"name": "idx", "shape": [2], "datatype": "INT32",
+             "data": [7, 8]},
+        ]}
+        body, hlen = v2.encode_binary_response(resp)
+        back = v2.decode_binary_response(body, hlen)
+        assert back["model_name"] == "m"
+        np.testing.assert_array_equal(
+            back["outputs"][0]["data"],
+            np.arange(1, 7, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_array_equal(
+            back["outputs"][1]["data"], np.array([7, 8], np.int32))
+
+    def test_bytes_output(self):
+        resp = {"outputs": [{"name": "s", "shape": [2],
+                             "datatype": "BYTES",
+                             "data": [b"ab", b"cdef"]}]}
+        body, hlen = v2.encode_binary_response(resp)
+        back = v2.decode_binary_response(body, hlen)
+        assert back["outputs"][0]["data"] == [b"ab", b"cdef"]
+
+    def test_request_flag(self):
+        body, hlen = v2.make_binary_request(
+            {"x": np.zeros(2, np.float32)}, binary_output=True)
+        req = v2.InferRequest.from_binary(body, hlen)
+        assert req.parameters.get("binary_data_output") is True
