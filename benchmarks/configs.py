@@ -228,8 +228,22 @@ async def bench_bert(smoke: bool) -> Dict[str, Any]:
             server.http_port, path,
             lambda i: bodies[lengths[i % 3]],
             10 if smoke else 30, 2.0 if smoke else 6.0)
+        # Native wire both ways: token ids in as raw int32, topk
+        # values/indices back as raw bytes (binary_data_output) — the
+        # heavy part of a fill-mask response is the output tensors.
+        from kfserving_tpu.protocol import v2 as v2proto
+
+        ids48 = rng.integers(1, vocab, size=(1, 48)).astype(np.int32)
+        bin_body, hlen = v2proto.make_binary_request(
+            {"input_0": ids48}, binary_output=True)
+        binary = await closed_loop(
+            server.http_port, "/v2/models/bert/infer", bin_body,
+            num_requests=64 if smoke else 384,
+            concurrency=8 if smoke else 32,
+            headers={"Inference-Header-Content-Length": str(hlen)})
         stats = model.engine_stats()
         return {"closed_loop": peak, "mixed_lengths_fixed_rate": mixed,
+                "binary_wire_closed_loop": binary,
                 "seq_buckets": seq_buckets,
                 "engine": {k: (round(v, 4) if isinstance(v, float) else v)
                            for k, v in stats.items()}}
