@@ -180,15 +180,11 @@ async def pipelined_closed_loop(port: int, path: str, body: bytes,
         reader, writer = await asyncio.open_connection(host, port)
         ok = 0
         try:
-            batch = request * 8
-            sent = 0
-            write_task = None
-
             async def pump():
                 n = 0
                 while n < per_conn:
                     k = min(8, per_conn - n)
-                    writer.write(request * k if k != 8 else batch)
+                    writer.write(request * k)
                     await writer.drain()
                     n += k
 
@@ -206,8 +202,7 @@ async def pipelined_closed_loop(port: int, path: str, body: bytes,
                         length = int(line.split(b":")[1])
                 await reader.readexactly(length)
             await write_task
-            sent = per_conn
-            return ok, sent
+            return ok, per_conn
         finally:
             writer.close()
 

@@ -221,9 +221,7 @@ class JaxEngine:
             first = (padded[next(iter(padded))]
                      if isinstance(padded, dict) else padded)
             bucket = first.shape[0]
-            flops_key = (int(bucket),
-                         int(first.shape[1]) if self.seq_buckets is not None
-                         and first.ndim >= 2 else None)
+            flops_key = self._flops_key(padded)
             span.update(batch=n, bucket=int(bucket),
                         prepare_ms=round((t1 - t0) * 1e3, 3),
                         device_ms=round((t2 - t1) * 1e3, 3),

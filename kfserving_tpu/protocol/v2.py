@@ -86,6 +86,19 @@ def datatype_of(arr: np.ndarray) -> str:
         raise InvalidInput(f"Unsupported numpy dtype {dt}")
 
 
+def frame_raw_bytes(elems) -> bytes:
+    """V2 raw BYTES framing: 4-byte little-endian length before each
+    element (shared by HTTP binary extension and gRPC raw contents)."""
+    import struct
+
+    out = []
+    for e in elems:
+        b = (e if isinstance(e, bytes)
+             else e.encode() if isinstance(e, str) else bytes(e))
+        out.append(struct.pack("<I", len(b)) + b)
+    return b"".join(out)
+
+
 def decode_raw_bytes(raw: bytes) -> List[bytes]:
     """V2 raw BYTES framing: 4-byte little-endian length before each
     element (required_api.md binary data / raw_input_contents)."""
@@ -258,21 +271,17 @@ def make_binary_request(tensors: Dict[str, np.ndarray],
     asks the server to return outputs as raw bytes too."""
     import json as _json
 
-    import struct
-
     inputs = []
     raws = []
     for name, arr in tensors.items():
         arr = np.ascontiguousarray(arr)
         datatype = datatype_of(arr)
         if datatype == "BYTES":
-            # Element framing required by decode_raw_bytes: 4-byte LE
-            # length before each element (raw .tobytes() of S/object
-            # arrays would misparse server-side).
-            elems = [e if isinstance(e, bytes)
-                     else str(e).encode() for e in arr.ravel()]
-            raw = b"".join(struct.pack("<I", len(e)) + e
-                           for e in elems)
+            # Element framing required by decode_raw_bytes (raw
+            # .tobytes() of S/object arrays would misparse server-side).
+            raw = frame_raw_bytes(
+                e if isinstance(e, bytes) else str(e).encode()
+                for e in arr.ravel())
         else:
             raw = arr.tobytes()
         raws.append(raw)
@@ -300,7 +309,6 @@ def encode_binary_response(response: Dict[str, Any]
     raw_output_contents, grpc_predict_v2.proto:773).  Returns
     (body, header_length)."""
     import json as _json
-    import struct
 
     header = dict(response)
     outputs = []
@@ -309,9 +317,9 @@ def encode_binary_response(response: Dict[str, Any]
         data = out.get("data")
         dtype = _numpy_dtype(out["datatype"])
         if out["datatype"] == "BYTES":
-            elems = [e if isinstance(e, bytes) else str(e).encode()
-                     for e in np.asarray(data, np.object_).ravel()]
-            raw = b"".join(struct.pack("<I", len(e)) + e for e in elems)
+            raw = frame_raw_bytes(
+                e if isinstance(e, bytes) else str(e).encode()
+                for e in np.asarray(data, np.object_).ravel())
         else:
             raw = np.ascontiguousarray(
                 np.asarray(data, dtype=dtype)).tobytes()

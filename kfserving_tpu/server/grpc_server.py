@@ -51,34 +51,12 @@ _RAW_DTYPE = {
 }
 
 
-def _decode_raw_bytes(raw: bytes) -> List[bytes]:
-    """V2 raw BYTES framing: each element is a 4-byte little-endian
-    length followed by that many bytes."""
-    import struct
-
-    out: List[bytes] = []
-    offset = 0
-    n = len(raw)
-    while offset < n:
-        if offset + 4 > n:
-            raise ValueError("truncated raw BYTES tensor")
-        (length,) = struct.unpack_from("<I", raw, offset)
-        offset += 4
-        if offset + length > n:
-            raise ValueError("truncated raw BYTES element")
-        out.append(raw[offset:offset + length])
-        offset += length
-    return out
-
-
-def _encode_raw_bytes(values) -> bytes:
-    import struct
-
-    parts = []
-    for v in values:
-        b = v.encode() if isinstance(v, str) else bytes(v)
-        parts.append(struct.pack("<I", len(b)) + b)
-    return b"".join(parts)
+# Shared V2 BYTES framing (protocol/v2.py) — one implementation for
+# HTTP binary extension and gRPC raw contents.
+from kfserving_tpu.protocol.v2 import (  # noqa: E402
+    decode_raw_bytes as _decode_raw_bytes,
+    frame_raw_bytes as _encode_raw_bytes,
+)
 
 
 def _tensor_to_numpy(tensor, raw: Optional[bytes]) -> np.ndarray:
