@@ -28,7 +28,7 @@ class BertConfig:
                  num_layers=12, num_heads=12, intermediate_size=3072,
                  max_position=512, type_vocab_size=2,
                  layer_norm_eps=1e-12, dtype=jnp.bfloat16,
-                 gelu_approximate=True,
+                 gelu_approximate=True, prefix_padding=True,
                  attn_fn=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -42,6 +42,11 @@ class BertConfig:
         # tanh-approx gelu is the TPU default; checkpoints converted
         # from HF torch BERT ("gelu" = erf) set False for exact parity.
         self.gelu_approximate = gelu_approximate
+        # attention_mask is treated as suffix key padding (1s then 0s —
+        # what the serving batcher produces), which unlocks the
+        # padding-aware flash kernel.  Set False to serve arbitrary
+        # mask patterns through the XLA path.
+        self.prefix_padding = prefix_padding
         # Pluggable attention impl (q, k, v, mask) -> out, mask being the
         # broadcastable [B, 1, 1, L] key-padding mask (or None).  Defaults
         # to ops.dot_product_attention; the sequence-parallel serving
@@ -66,12 +71,20 @@ class BertSelfAttention(nn.Module):
         v = proj("value")(hidden)
         # mask [B, L] -> [B, 1, 1, L] broadcast over heads and query pos.
         attn_mask = None
+        kv_lengths = None
         if mask is not None:
             attn_mask = mask[:, None, None, :].astype(bool)
+            if cfg.prefix_padding:
+                # Serving masks are suffix padding (the batcher pads seq
+                # buckets at the end): declaring lengths keeps long
+                # buckets on the flash kernel instead of the
+                # materialized-mask XLA path.
+                kv_lengths = mask.astype(jnp.int32).sum(-1)
         if cfg.attn_fn is not None:
             out = cfg.attn_fn(q, k, v, attn_mask)
         else:
-            out = dot_product_attention(q, k, v, mask=attn_mask)
+            out = dot_product_attention(q, k, v, mask=attn_mask,
+                                        kv_lengths=kv_lengths)
         out = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out")(out)
         return out

@@ -68,16 +68,25 @@ def _flash_eligible(q: jax.Array, mask: Optional[jax.Array]) -> bool:
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array] = None,
-                          causal: bool = False) -> jax.Array:
+                          causal: bool = False,
+                          kv_lengths: Optional[jax.Array] = None
+                          ) -> jax.Array:
     """Attention over [batch, len, heads, head_dim] tensors.
 
     mask: optional broadcastable boolean [B, H, Lq, Lk] (True = attend).
     causal: apply a causal mask (decoder serving).  Composes with an
         explicit mask (logical AND); the flash kernel path requires the
         causal-only case.
+    kv_lengths: optional int32 [B] declaring the mask to be suffix key
+        padding (real keys then padding) — the flash kernel masks it
+        natively, so padded seq buckets keep the fused path.  When flash
+        is ineligible the provided/derived mask serves via XLA.
     """
+    Lq, Lk = q.shape[1], k.shape[1]
+    if kv_lengths is not None and mask is None:
+        mask = (jnp.arange(Lk)[None, :]
+                < kv_lengths[:, None])[:, None, None, :]
     if causal:
-        Lq, Lk = q.shape[1], k.shape[1]
         # KV-cache decode has Lq < Lk: query i sits at absolute position
         # (Lk - Lq + i), so the allowed region is a shifted triangle.
         causal_mask = jnp.tril(
@@ -85,15 +94,22 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         mask = causal_mask if mask is None else (mask & causal_mask)
         # The Pallas kernel's causal mask assumes query i sits at absolute
         # position i, which only holds when Lq == Lk; KV-cache decode
-        # (Lq < Lk, shifted triangle) must take the XLA path.
-        flash_ok = mask is causal_mask and Lq == Lk
+        # (Lq < Lk, shifted triangle) must take the XLA path.  Causal +
+        # key-padding composition stays on XLA too.
+        flash_ok = (mask is causal_mask and Lq == Lk
+                    and kv_lengths is None)
+        lengths = None
     else:
-        flash_ok = mask is None
+        # Non-causal flash handles rectangular (Lq != Lk) grids and
+        # key-padding lengths natively.
+        flash_ok = mask is None or kv_lengths is not None
+        lengths = kv_lengths
     if flash_ok and _flash_eligible(q, None):
         try:
             from kfserving_tpu.ops.pallas_attention import flash_attention
 
-            return flash_attention(q, k, v, causal=causal)
+            return flash_attention(q, k, v, causal=causal,
+                                   kv_lengths=lengths)
         except Exception as exc:  # pragma: no cover - TPU-only path
             logger.warning("pallas flash attention failed (%s); "
                            "falling back to XLA", exc)

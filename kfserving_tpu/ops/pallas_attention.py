@@ -35,12 +35,20 @@ def _fit_block(block: int, length: int) -> int:
     return min(block, length)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
-                  m_scratch, l_scratch, acc_scratch,
-                  *, causal: bool, scale: float, block_q: int, block_k: int):
+def _flash_kernel(q_ref, k_ref, v_ref, *rest,
+                  causal: bool, scale: float, block_q: int, block_k: int,
+                  has_lengths: bool):
+    if has_lengths:
+        len_ref, o_ref, m_scratch, l_scratch, acc_scratch = rest
+    else:
+        len_ref = None
+        o_ref, m_scratch, l_scratch, acc_scratch = rest
+    bh_idx = pl.program_id(0)
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
     num_k = pl.num_programs(2)
+    # len_ref holds the WHOLE [B*H, 1] vector in SMEM (un-blocked).
+    row_len = len_ref[bh_idx, 0] if has_lengths else None
 
     @pl.when(k_idx == 0)
     def _init():
@@ -57,12 +65,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(                          # [bq, bk] fp32
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        if causal or has_lengths:
+            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        if has_lengths:
+            # Key-padding: keys at positions >= this batch row's real
+            # length never contribute (suffix padding from the serving
+            # batcher's seq buckets).
+            s = jnp.where(k_pos < row_len, s, _NEG_INF)
 
         m_prev = m_scratch[:]                             # [bq, 1]
         l_prev = l_scratch[:]
@@ -80,26 +94,42 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref,
         m_scratch[:] = m_new
         l_scratch[:] = l_new
 
+    pred = None
     if causal:
         # Skip fully-masked k blocks above the diagonal.
-        @pl.when(k_idx * block_k <= q_idx * block_q + (block_q - 1))
-        def _():
-            _run_block()
-    else:
+        pred = k_idx * block_k <= q_idx * block_q + (block_q - 1)
+    if has_lengths:
+        # Skip k blocks entirely beyond this row's length (dynamic
+        # predicate — pl.when accepts traced conditions).
+        beyond = k_idx * block_k < row_len
+        pred = beyond if pred is None else (pred & beyond)
+    if pred is None:
         _run_block()
+    else:
+        pl.when(pred)(_run_block)
 
     @pl.when(k_idx == num_k - 1)
     def _finalize():
-        # l is positive: row 0 of k always contributes for causal (q >= 0).
-        o_ref[0] = (acc_scratch[:] / l_scratch[:]).astype(o_ref.dtype)
+        # max() guards rows with length 0 (batch-dim padding): 0/eps
+        # instead of 0/0 NaN; those rows are sliced away by the caller.
+        o_ref[0] = (acc_scratch[:]
+                    / jnp.maximum(l_scratch[:], 1e-30)).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
-    """Fused attention over [B, L, H, D]; returns [B, L, H, D]."""
+                    block_k: int = DEFAULT_BLOCK_K,
+                    kv_lengths: "jax.Array | None" = None) -> jax.Array:
+    """Fused attention over [B, L, H, D]; returns [B, L, H, D].
+
+    kv_lengths: optional int32 [B] — per-row count of real keys (suffix
+    padding beyond is masked inside the kernel, and fully-padded k
+    blocks are skipped).  This is what lets the serving path's
+    seq-bucket padding ride the flash kernel instead of falling back
+    to XLA with a materialized mask.
+    """
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
     # Blocks shrink to the largest power-of-two divisor <= the requested
@@ -119,18 +149,30 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     vt = v.transpose(0, 2, 1, 3).reshape(B * H, Lk, D)
 
     grid = (B * H, Lq // block_q, Lk // block_k)
+    has_lengths = kv_lengths is not None
     kernel = functools.partial(
         _flash_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, has_lengths=has_lengths)
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+    ]
+    args = [qt, kt, vt]
+    if has_lengths:
+        # The whole lengths vector rides SMEM un-blocked (scalar loads;
+        # VMEM/blocked forms must tile 8x128) and the kernel indexes it
+        # by its grid row.
+        lengths_bh = jnp.repeat(
+            kv_lengths.astype(jnp.int32), H).reshape(B * H, 1)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(lengths_bh)
 
     out = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
         scratch_shapes=[
@@ -140,5 +182,5 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(qt, kt, vt)
+    )(*args)
     return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)

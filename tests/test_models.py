@@ -180,3 +180,53 @@ def test_attention_causal_composes_with_padding_mask():
     expect = _xla_attention(q, q, q, both)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                atol=1e-5)
+
+
+def test_flash_kernel_interpret_mode_kv_lengths():
+    """Padding-aware flash: kv_lengths masks suffix keys identically to
+    an explicit prefix mask through the XLA path (incl. a zero-length
+    row, which must be finite)."""
+    from jax.experimental import pallas as pl  # noqa: F401
+    import functools
+    from kfserving_tpu.ops import pallas_attention as pa
+
+    rng = np.random.default_rng(7)
+    B, L, H, D = 3, 256, 2, 128
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    k = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    v = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    lengths = jnp.array([256, 100, 0], jnp.int32)
+
+    orig = pl.pallas_call
+    try:
+        pl.pallas_call = functools.partial(orig, interpret=True)
+        out = pa.flash_attention.__wrapped__(
+            q, k, v, causal=False, block_q=128, block_k=128,
+            kv_lengths=lengths)
+    finally:
+        pl.pallas_call = orig
+    mask = (np.arange(L)[None, :]
+            < np.asarray(lengths)[:, None])[:, None, None, :]
+    expect = np.asarray(_xla_attention(q, k, v, jnp.asarray(mask)))
+    got = np.asarray(out)
+    # rows with real keys match the masked XLA result
+    np.testing.assert_allclose(got[:2], expect[:2], atol=2e-3, rtol=2e-3)
+    # zero-length row: well-defined (zeros), never NaN
+    assert np.isfinite(got[2]).all()
+    np.testing.assert_allclose(got[2], 0.0, atol=1e-6)
+
+
+def test_dispatch_uses_lengths_for_prefix_masks():
+    """dot_product_attention(kv_lengths=...) matches the masked XLA
+    result on CPU (falls back there) — semantic equivalence of the
+    lengths declaration."""
+    rng = np.random.default_rng(9)
+    B, L, H, D = 2, 8, 1, 4
+    q = jnp.asarray(rng.normal(size=(B, L, H, D)).astype("float32"))
+    lengths = jnp.array([8, 5], jnp.int32)
+    got = dot_product_attention(q, q, q, kv_lengths=lengths)
+    mask = (np.arange(L)[None, :]
+            < np.asarray(lengths)[:, None])[:, None, None, :]
+    expect = _xla_attention(q, q, q, jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               atol=1e-5, rtol=1e-5)
