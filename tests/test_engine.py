@@ -1,5 +1,7 @@
 """JaxEngine + bucket policy + HBM manager tests (CPU backend)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -190,3 +192,38 @@ def test_hbm_failed_admit_restores_books():
         m.admit("a", 80, evict=False)
     assert m.used_bytes == 90
     assert sorted(m.resident_models()) == ["a", "b"]
+
+
+class TestCompileCache:
+    def test_enable_points_jax_at_dir(self, tmp_path, monkeypatch):
+        import jax
+
+        from kfserving_tpu.engine import compile_cache
+
+        monkeypatch.setattr(compile_cache, "_active_dir", None)
+        d = str(tmp_path / "xla-cache")
+        out = compile_cache.enable(d, min_compile_time_secs=0.0)
+        assert out == d and os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
+        # idempotent for the same dir
+        assert compile_cache.enable(d) == d
+
+    def test_enable_repoints_with_warning(self, tmp_path, monkeypatch,
+                                          caplog):
+        from kfserving_tpu.engine import compile_cache
+
+        monkeypatch.setattr(compile_cache, "_active_dir", None)
+        a = str(tmp_path / "a")
+        b = str(tmp_path / "b")
+        compile_cache.enable(a)
+        with caplog.at_level("WARNING"):
+            assert compile_cache.enable(b) == b
+        assert any("re-pointing" in r.message for r in caplog.records)
+
+    def test_env_var_default(self, tmp_path, monkeypatch):
+        from kfserving_tpu.engine import compile_cache
+
+        monkeypatch.setattr(compile_cache, "_active_dir", None)
+        d = str(tmp_path / "envcache")
+        monkeypatch.setenv("KFSERVING_TPU_COMPILE_CACHE", d)
+        assert compile_cache.enable() == d
