@@ -195,6 +195,20 @@ def test_hbm_failed_admit_restores_books():
 
 
 class TestCompileCache:
+    @pytest.fixture(autouse=True)
+    def _restore_jax_cache_config(self):
+        """These tests point the process-global JAX cache config at
+        pytest tmp dirs; restore it so later compilations in this
+        process don't write into deleted directories."""
+        import jax
+
+        saved_dir = jax.config.jax_compilation_cache_dir
+        saved_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        yield
+        jax.config.update("jax_compilation_cache_dir", saved_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          saved_min)
+
     def test_enable_points_jax_at_dir(self, tmp_path, monkeypatch):
         import jax
 
