@@ -55,15 +55,14 @@ def _tpu_backend() -> bool:
         return False
 
 
-def _flash_eligible(q: jax.Array, mask: Optional[jax.Array]) -> bool:
+def _flash_eligible(q: jax.Array) -> bool:
+    """Shape/backend gate for the fused kernel.  Mask handling is the
+    dispatcher's job: suffix key padding rides the kernel as kv_lengths
+    (non-causal only); every other mask pattern serves via XLA."""
     if not _tpu_backend():
         return False
     _, L, _, D = q.shape
-    # Padding masks are handled by the kernel only in the causal/full cases;
-    # arbitrary masks fall back (serving uses full attention + host-side
-    # length slicing, so this covers the hot path).
-    return (mask is None and L >= _FLASH_MIN_SEQ
-            and D % _FLASH_HEAD_DIM_MULTIPLE == 0)
+    return L >= _FLASH_MIN_SEQ and D % _FLASH_HEAD_DIM_MULTIPLE == 0
 
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -104,7 +103,7 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # key-padding lengths natively.
         flash_ok = mask is None or kv_lengths is not None
         lengths = kv_lengths
-    if flash_ok and _flash_eligible(q, None):
+    if flash_ok and _flash_eligible(q):
         try:
             from kfserving_tpu.ops.pallas_attention import flash_attention
 

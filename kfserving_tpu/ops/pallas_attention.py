@@ -35,20 +35,23 @@ def _fit_block(block: int, length: int) -> int:
     return min(block, length)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, *rest,
+def _flash_kernel(*refs,
                   causal: bool, scale: float, block_q: int, block_k: int,
                   has_lengths: bool):
     if has_lengths:
-        len_ref, o_ref, m_scratch, l_scratch, acc_scratch = rest
+        # Scalar-prefetch layout: the lengths vector precedes the
+        # tensor refs (PrefetchScalarGridSpec).
+        len_ref, q_ref, k_ref, v_ref, o_ref, \
+            m_scratch, l_scratch, acc_scratch = refs
     else:
         len_ref = None
-        o_ref, m_scratch, l_scratch, acc_scratch = rest
+        q_ref, k_ref, v_ref, o_ref, \
+            m_scratch, l_scratch, acc_scratch = refs
     bh_idx = pl.program_id(0)
     q_idx = pl.program_id(1)
     k_idx = pl.program_id(2)
     num_k = pl.num_programs(2)
-    # len_ref holds the WHOLE [B*H, 1] vector in SMEM (un-blocked).
-    row_len = len_ref[bh_idx, 0] if has_lengths else None
+    row_len = len_ref[bh_idx] if has_lengths else None
 
     @pl.when(k_idx == 0)
     def _init():
@@ -153,34 +156,60 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kernel = functools.partial(
         _flash_kernel, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, has_lengths=has_lengths)
-
-    in_specs = [
-        pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-        pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
-        pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+    scratch_shapes = [
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, D), jnp.float32),
     ]
-    args = [qt, kt, vt]
-    if has_lengths:
-        # The whole lengths vector rides SMEM un-blocked (scalar loads;
-        # VMEM/blocked forms must tile 8x128) and the kernel indexes it
-        # by its grid row.
-        lengths_bh = jnp.repeat(
-            kv_lengths.astype(jnp.int32), H).reshape(B * H, 1)
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.append(lengths_bh)
+    out_shape = jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype)
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
 
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Lq, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, D), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(*args)
+    if has_lengths:
+        # Lengths ride as a prefetched scalar vector so the k/v index
+        # maps can CLAMP their block index: grid steps beyond a row's
+        # last real block re-request the same block, which Mosaic's
+        # pipeline elides — short rows in long buckets skip the HBM
+        # traffic, not just the FLOPs (the pl.when below only skips
+        # compute).
+        lengths_bh = jnp.repeat(kv_lengths.astype(jnp.int32), H)
+
+        def kv_index(bh, i, j, lens):
+            # index_map signature: (*grid_indices, *scalar_refs)
+            last = jnp.maximum(
+                (lens[bh] + block_k - 1) // block_k - 1, 0)
+            return (bh, jnp.minimum(j, last), 0)
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, D),
+                             lambda bh, i, j, lens: (bh, i, 0)),
+                pl.BlockSpec((1, block_k, D), kv_index),
+                pl.BlockSpec((1, block_k, D), kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, D), lambda bh, i, j, lens: (bh, i, 0)),
+            scratch_shapes=scratch_shapes,
+        )
+        out = pl.pallas_call(
+            kernel, grid_spec=grid_spec, out_shape=out_shape,
+            compiler_params=params,
+        )(lengths_bh, qt, kt, vt)
+    else:
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+                pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+                pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, D),
+                                   lambda bh, i, j: (bh, i, 0)),
+            out_shape=out_shape,
+            scratch_shapes=scratch_shapes,
+            compiler_params=params,
+        )(qt, kt, vt)
     return out.reshape(B, H, Lq, D).transpose(0, 2, 1, 3)

@@ -309,6 +309,8 @@ class JaxModel(Model):
                 if key is not None:  # pad rows to the shared seq bucket
                     rows = [self._pad_seq(r, key) for r in rows]
                 batch[k] = np.stack(rows)
+            if "attention_mask" in batch:
+                self._check_prefix_mask(batch["attention_mask"])
         else:
             rows = [np.asarray(inst) for inst in instances]
             lengths = [r.shape[0] if r.ndim else 1 for r in rows]
@@ -332,6 +334,28 @@ class JaxModel(Model):
                 batch = {primary: batch, "attention_mask": mask}
         out = await self.engine.predict(batch)
         return self._scatter(out, len(instances))
+
+    def _check_prefix_mask(self, mask: np.ndarray) -> None:
+        """Models running with prefix_padding (the default for the BERT
+        family) interpret attention_mask as suffix padding and serve it
+        through the padding-aware flash kernel.  A non-suffix mask
+        (e.g. left padding) would be SILENTLY wrong on that path, so
+        reject it loudly here on the host — callers with arbitrary mask
+        patterns set arch_kwargs.prefix_padding=false (XLA path)."""
+        if not self.config.architecture.startswith("bert"):
+            return  # other archs don't derive kv_lengths from the mask
+        if not self.config.arch_kwargs.get("prefix_padding", True):
+            return
+        m = np.asarray(mask)
+        if m.ndim != 2:
+            return
+        # suffix form == row values never increase (1s then 0s)
+        if np.any(np.diff(m.astype(np.int8), axis=1) > 0):
+            raise InvalidInput(
+                "attention_mask is not suffix padding (1s then 0s); "
+                "this model serves masks as sequence lengths "
+                "(prefix_padding). Set arch_kwargs.prefix_padding=false "
+                "in the model config to serve arbitrary mask patterns.")
 
     @staticmethod
     def _pad_seq(row: np.ndarray, bucket: int) -> np.ndarray:

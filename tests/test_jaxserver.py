@@ -436,3 +436,39 @@ def test_v2_binary_response_through_server(tmp_path):
             assert by_name["indices"]["data"].dtype == np.int32
 
     asyncio.run(run())
+
+
+def test_left_padded_mask_rejected_loudly(tmp_path):
+    """Non-suffix attention masks would be silently wrong on the
+    padding-aware flash path — they must 400, with the escape hatch
+    named (prefix_padding=false)."""
+    model_dir = _write_model_dir(
+        tmp_path, arch="bert_tiny", arch_kwargs={"seq_len": 16},
+        config_extra={"seq_buckets": [8], "max_latency_ms": 2})
+    m = JaxModel("m", model_dir)
+    m.load()
+
+    async def run():
+        with pytest.raises(Exception, match="prefix_padding"):
+            await m.predict({"instances": [
+                {"input_ids": [1, 2, 3, 4],
+                 "attention_mask": [0, 0, 1, 1]}]})  # left padding
+
+    asyncio.run(run())
+
+
+def test_left_padded_mask_allowed_with_flag(tmp_path):
+    model_dir = _write_model_dir(
+        tmp_path, arch="bert_tiny",
+        arch_kwargs={"seq_len": 16, "prefix_padding": False},
+        config_extra={"seq_buckets": [8], "max_latency_ms": 2})
+    m = JaxModel("m", model_dir)
+    m.load()
+
+    async def run():
+        return await m.predict({"instances": [
+            {"input_ids": [1, 2, 3, 4],
+             "attention_mask": [0, 0, 1, 1]}]})
+
+    resp = asyncio.run(run())
+    assert np.asarray(resp["predictions"][0]).shape == (8, 1024)
