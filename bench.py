@@ -56,6 +56,7 @@ def main():
         "bert": C.bench_bert,
         "multimodel": C.bench_multimodel,
         "chain": C.bench_chain,
+        "longctx": C.bench_longctx,
     }
     results = {}
     for name, fn in matrix.items():

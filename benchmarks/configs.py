@@ -371,3 +371,53 @@ async def bench_chain(smoke: bool) -> Dict[str, Any]:
     finally:
         await router.stop_async()
         await orch.shutdown()
+
+
+# -- config 6 (TPU-native addition): long-context serving --------------------
+async def bench_longctx(smoke: bool) -> Dict[str, Any]:
+    """Long-context fill-mask: a 4096-token seq bucket served through
+    the binary wire, suffix padding masked inside the flash kernel
+    (kv_lengths).  No reference counterpart — the reference never
+    touches model internals; this is the TPU-native long-sequence
+    serving capability (SURVEY.md §5.7)."""
+    from kfserving_tpu.predictors.jax_model import JaxModel
+    from kfserving_tpu.protocol import v2 as v2proto
+
+    if smoke:
+        arch_kwargs = {"num_layers": 2, "hidden_size": 64,
+                       "num_heads": 2, "intermediate_size": 128,
+                       "vocab_size": 512, "max_position": 256,
+                       "seq_len": 256}
+        bucket, tokens, vocab = 256, 200, 512
+    else:
+        arch_kwargs = {"num_layers": 4, "hidden_size": 512,
+                       "num_heads": 8, "intermediate_size": 2048,
+                       "vocab_size": 8192, "max_position": 4096,
+                       "seq_len": 4096}
+        bucket, tokens, vocab = 4096, 3000, 8192
+    model_dir = _write_jax_model_dir(
+        "bert", arch_kwargs,
+        seq_buckets=[bucket], batch_buckets=[4], max_batch_size=4,
+        max_latency_ms=25.0, pipeline_depth=2, warmup=True,
+        output="topk", topk=5)
+    model = JaxModel("longctx", model_dir)
+    t0 = time.perf_counter()
+    model.load()
+    compile_s = time.perf_counter() - t0
+    server = await _serve([model])
+    try:
+        rng = np.random.default_rng(0)
+        ids = rng.integers(1, vocab, size=(1, tokens)).astype(np.int32)
+        body, hlen = v2proto.make_binary_request(
+            {"input_0": ids}, binary_output=True)
+        res = await closed_loop(
+            server.http_port, "/v2/models/longctx/infer", body,
+            num_requests=16 if smoke else 48,
+            concurrency=4 if smoke else 8,
+            headers={"Inference-Header-Content-Length": str(hlen)})
+        res["tokens_per_request"] = tokens
+        res["tokens_per_s"] = res["req_per_s"] * tokens
+        return {"closed_loop": res, "seq_bucket": bucket,
+                "compile_s": round(compile_s, 1)}
+    finally:
+        await server.stop_async()
