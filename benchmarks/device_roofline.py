@@ -1,0 +1,146 @@
+"""Device-only model step timing: what the chip does with the tunnel
+taken out of the loop.
+
+The round-2 engine stats measure dispatch->host-visible-result, which on
+this bench host includes an ~87 ms runtime round trip per batch — a
+floor on wall MFU but not a statement about the silicon.  This tool
+measures the flagship models the way the attention kernels were
+measured (ROOFLINE.md "Flash attention" row): K model steps chained
+inside one on-device ``lax.fori_loop`` with an explicit data dependency
+between iterations, timed at K=1 and K=N.  The per-step device time is
+
+    (t_N - t_1) / (N - 1)
+
+which cancels dispatch, transfer, and the single sync.
+
+The chain dependency is a zero-scaled scalar folded back into the input
+(x + 0*mean(logits)): XLA cannot DCE or reorder the steps, and the
+added work is one reduction + broadcast per step (noise at these
+FLOP counts).
+
+Usage:  python -m benchmarks.device_roofline [--model resnet50|bert]
+Prints one JSON line per (model, batch) with ms/step, TF/s, and MFU
+against the chip's bf16 peak.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _flops_of(jitted, params, x) -> float:
+    """XLA cost-model FLOPs for one step (same source the engine stats
+    use, engine/jax_engine.py:303-321)."""
+    lowered = jitted.lower(params, x)
+    analysis = lowered.cost_analysis()
+    if not analysis:
+        analysis = lowered.compile().cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    return float((analysis or {}).get("flops", 0.0))
+
+
+def chained_step_time(apply_fn, params, x, n: int = 12,
+                      reps: int = 3) -> dict:
+    """Median of `reps` (t_n - t_1)/(n-1) measurements, seconds/step."""
+    import jax
+    import jax.numpy as jnp
+
+    def chain(k):
+        def body(_, carry):
+            out = apply_fn(params, carry)
+            leaves = jax.tree.leaves(out)
+            dep = sum(jnp.sum(l).astype(jnp.float32) for l in leaves)
+            zero = (dep * 0.0)
+            if isinstance(carry, dict):
+                return {key: (v + zero.astype(v.dtype)
+                              if jnp.issubdtype(v.dtype, jnp.floating)
+                              else v + zero.astype(jnp.int32).astype(v.dtype))
+                        for key, v in carry.items()}
+            if jnp.issubdtype(carry.dtype, jnp.floating):
+                return carry + zero.astype(carry.dtype)
+            return carry + zero.astype(jnp.int32).astype(carry.dtype)
+
+        return jax.jit(lambda p, v: jax.lax.fori_loop(0, k, body, v),
+                       static_argnums=())
+
+    f1 = chain(1)
+    fn = chain(n)
+    # compile both
+    jax.block_until_ready(f1(params, x))
+    jax.block_until_ready(fn(params, x))
+    per_step = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f1(params, x))
+        t1 = time.perf_counter()
+        jax.block_until_ready(fn(params, x))
+        t2 = time.perf_counter()
+        per_step.append(((t2 - t1) - (t1 - t0)) / (n - 1))
+    per_step.sort()
+    return {"sec_per_step": per_step[len(per_step) // 2],
+            "t1_sec": t1 - t0, "n": n}
+
+
+def measure(model_name: str, batches, seq=None) -> list:
+    import jax
+
+    from kfserving_tpu.engine.jax_engine import device_peak_flops
+    from kfserving_tpu.models import registry
+
+    if model_name == "resnet50":
+        spec = registry.create_model("resnet50")
+        make_x = lambda b: np.random.default_rng(0).normal(
+            size=(b, 224, 224, 3)).astype(np.float32)
+    elif model_name == "bert":
+        spec = registry.create_model("bert")
+        make_x = lambda b: np.random.default_rng(0).integers(
+            1, 1000, size=(b, seq or 128)).astype(np.int32)
+    else:
+        raise SystemExit(f"unknown model {model_name}")
+    params = registry.init_params(spec)
+    apply_fn = registry.apply_fn_for(spec)
+    jitted = jax.jit(apply_fn)
+    peak = device_peak_flops()
+    rows = []
+    for b in batches:
+        x = jax.device_put(make_x(b))
+        flops = _flops_of(jitted, params, x)
+        t = chained_step_time(apply_fn, params, x)
+        sec = t["sec_per_step"]
+        tf_s = flops / sec / 1e12 if sec > 0 else None
+        row = {"model": model_name, "batch": b,
+               "seq": seq if model_name == "bert" else None,
+               "ms_per_step": round(sec * 1e3, 3),
+               "ms_per_item": round(sec * 1e3 / b, 4),
+               "flops_per_step": flops,
+               "tflops_per_s": round(tf_s, 2) if tf_s else None,
+               "mfu": round(flops / sec / peak, 4) if peak and sec > 0
+               else None,
+               "t1_wall_ms": round(t["t1_sec"] * 1e3, 1)}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="all",
+                    choices=["resnet50", "bert", "all"])
+    ap.add_argument("--batches", default="32,64,128,256")
+    ap.add_argument("--seq", type=int, default=128)
+    args = ap.parse_args()
+    batches = [int(b) for b in args.batches.split(",")]
+    out = []
+    if args.model in ("resnet50", "all"):
+        out += measure("resnet50", batches)
+    if args.model in ("bert", "all"):
+        out += measure("bert", batches, seq=args.seq)
+    with open("DEVICE_ROOFLINE.json", "w") as f:
+        json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

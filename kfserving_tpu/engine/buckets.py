@@ -46,6 +46,15 @@ class BucketPolicy:
             return None
         return self.buckets[i]
 
+    def floor_fit(self, n: int) -> Optional[int]:
+        """Largest bucket <= n, or None if n is below the smallest bucket.
+        The batcher's bucket-aligned flush uses this: executing exactly a
+        bucket's worth of pending instances pads zero slots."""
+        i = bisect.bisect_right(self.buckets, n) - 1
+        if i < 0:
+            return None
+        return self.buckets[i]
+
     def waste(self, n: int) -> float:
         """Fraction of padded work wasted for a size-n batch."""
         b = self.fit(n)

@@ -263,7 +263,12 @@ class JaxModel(Model):
             # is always staged when a thread frees (the batcher defers
             # flushes past this — small batches coalesce while the
             # engine is busy instead of queueing tiny executions).
-            max_inflight=cfg.pipeline_depth + 1)
+            max_inflight=cfg.pipeline_depth + 1,
+            # Bucket-aligned flushing: executed batches land exactly on
+            # the engine's compiled shapes, so pad waste comes only from
+            # drain-out tails (VERDICT r2: 62% of ResNet batch slots were
+            # padding with misaligned flushes).
+            buckets=engine.batch_buckets.buckets)
         return engine, batcher
 
     def _example_instance(self, spec):
