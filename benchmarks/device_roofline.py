@@ -42,49 +42,123 @@ def _flops_of(jitted, params, x) -> float:
     return float((analysis or {}).get("flops", 0.0))
 
 
-def chained_step_time(apply_fn, params, x, n: int = 12,
-                      reps: int = 3) -> dict:
-    """Median of `reps` (t_n - t_1)/(n-1) measurements, seconds/step."""
+def _chain_dep(out, v):
+    """Fold a model output into the next step's input without changing
+    its value at runtime and without being eliminable at compile time.
+
+    NOT `0.0 * sum(out)`: for integer inputs the int-cast zero is a
+    valid strength reduction and XLA deletes the whole model (measured:
+    a "4098 TF/s BERT" = 20x chip peak).  And not plain `sum(out)`
+    either: a reduce-sum of a matmul factors through it
+    (sum(A@B) = sum_k(sum_i A)_k (sum_j B)_k), which let XLA skip
+    BERT's 96-GFLOP vocab projection (measured 105% "MFU").  The
+    squared sum consumes every output element irreducibly; scaled by
+    1e-30 it is a non-constant float the simplifier cannot prove zero —
+    its int cast truncates to 0 and its float add is far below one ulp
+    of any activation, both only at runtime."""
     import jax
     import jax.numpy as jnp
 
-    def chain(k):
-        def body(_, carry):
-            out = apply_fn(params, carry)
-            leaves = jax.tree.leaves(out)
-            dep = sum(jnp.sum(l).astype(jnp.float32) for l in leaves)
-            zero = (dep * 0.0)
-            if isinstance(carry, dict):
-                return {key: (v + zero.astype(v.dtype)
-                              if jnp.issubdtype(v.dtype, jnp.floating)
-                              else v + zero.astype(jnp.int32).astype(v.dtype))
-                        for key, v in carry.items()}
-            if jnp.issubdtype(carry.dtype, jnp.floating):
-                return carry + zero.astype(carry.dtype)
-            return carry + zero.astype(jnp.int32).astype(carry.dtype)
+    leaves = jax.tree.leaves(out)
+    dep = sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+              for l in leaves) * 1e-30
 
-        return jax.jit(lambda p, v: jax.lax.fori_loop(0, k, body, v),
-                       static_argnums=())
+    def inject(a):
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return a + dep.astype(a.dtype)
+        return a + dep.astype(jnp.int32).astype(a.dtype)
 
-    f1 = chain(1)
-    fn = chain(n)
-    # compile both
-    jax.block_until_ready(f1(params, x))
-    jax.block_until_ready(fn(params, x))
+    if isinstance(v, dict):
+        return {k: inject(a) for k, a in v.items()}
+    return inject(v)
+
+
+def _fetch_probe(v):
+    """Reduce a chain carry to one f32 scalar whose value depends on
+    every element — fetching it joins the device timeline at ~zero
+    transfer cost regardless of carry size."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(v)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+               for l in leaves)
+
+
+def dispatch_chained_step_time(apply_fn, params, x, n: int = 24,
+                               reps: int = 3) -> dict:
+    """Host-chained variant for models whose fori_loop chain exceeds the
+    tunnel's remote-compile body limit (BERT-base hits HTTP 413): issue K
+    async dispatches where each step's input carries a data dependency
+    on the previous output, sync once at the end.  The device executes
+    the queue back-to-back, so (t_K - t_1)/(K-1) still cancels the
+    single round trip and dispatch tail."""
+    import jax
+
+    def step(p, v):
+        return _chain_dep(apply_fn(p, v), v)
+
+    jstep = jax.jit(step)
+    probe = jax.jit(_fetch_probe)
+
+    def run(k):
+        # Sync via a tiny scalar D2H fetch, NOT block_until_ready: on
+        # the tunneled backend block_until_ready acks the dispatch
+        # without waiting for execution (measured 0.24 ms for a 458
+        # GFLOP program); only a fetch truly joins the device timeline.
+        v = x
+        for _ in range(k):
+            v = jstep(params, v)
+        np.asarray(probe(v))
+
+    run(2)  # compile + queue warm
     per_step = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(f1(params, x))
+        run(1)
         t1 = time.perf_counter()
-        jax.block_until_ready(fn(params, x))
+        run(n)
         t2 = time.perf_counter()
         per_step.append(((t2 - t1) - (t1 - t0)) / (n - 1))
     per_step.sort()
     return {"sec_per_step": per_step[len(per_step) // 2],
-            "t1_sec": t1 - t0, "n": n}
+            "t1_sec": t1 - t0, "n": n, "method": "dispatch-chain"}
 
 
-def measure(model_name: str, batches, seq=None) -> list:
+def chained_step_time(apply_fn, params, x, n: int = 12,
+                      reps: int = 3) -> dict:
+    """Median of `reps` (t_n - t_1)/(n-1) measurements, seconds/step."""
+    import jax
+
+    def chain(k):
+        def body(_, carry):
+            return _chain_dep(apply_fn(params, carry), carry)
+
+        # Scalar-probe output: the fetch that times the run transfers 4
+        # bytes but depends on every chained step (block_until_ready is
+        # a dispatch ack on the tunneled backend, not a join).
+        return jax.jit(
+            lambda p, v: _fetch_probe(jax.lax.fori_loop(0, k, body, v)))
+
+    f1 = chain(1)
+    fn = chain(n)
+    # compile both
+    np.asarray(f1(params, x))
+    np.asarray(fn(params, x))
+    per_step = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(f1(params, x))
+        t1 = time.perf_counter()
+        np.asarray(fn(params, x))
+        t2 = time.perf_counter()
+        per_step.append(((t2 - t1) - (t1 - t0)) / (n - 1))
+    per_step.sort()
+    return {"sec_per_step": per_step[len(per_step) // 2],
+            "t1_sec": t1 - t0, "n": n, "method": "fori-chain"}
+
+
+def measure(model_name: str, batches, seq=None, method="auto") -> list:
     import jax
 
     from kfserving_tpu.engine.jax_engine import device_peak_flops
@@ -108,11 +182,20 @@ def measure(model_name: str, batches, seq=None) -> list:
     for b in batches:
         x = jax.device_put(make_x(b))
         flops = _flops_of(jitted, params, x)
-        t = chained_step_time(apply_fn, params, x)
+        if method == "dispatch":
+            t = dispatch_chained_step_time(apply_fn, params, x)
+        else:
+            try:
+                t = chained_step_time(apply_fn, params, x)
+            except Exception as exc:  # chain too big for remote compile
+                print(f"# fori chain failed ({type(exc).__name__}); "
+                      "falling back to dispatch chain", flush=True)
+                t = dispatch_chained_step_time(apply_fn, params, x)
         sec = t["sec_per_step"]
         tf_s = flops / sec / 1e12 if sec > 0 else None
         row = {"model": model_name, "batch": b,
                "seq": seq if model_name == "bert" else None,
+               "method": t.get("method", "fori-chain"),
                "ms_per_step": round(sec * 1e3, 3),
                "ms_per_item": round(sec * 1e3 / b, 4),
                "flops_per_step": flops,
@@ -131,15 +214,28 @@ def main():
                     choices=["resnet50", "bert", "all"])
     ap.add_argument("--batches", default="32,64,128,256")
     ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--method", default="auto",
+                    choices=["auto", "dispatch"])
     args = ap.parse_args()
     batches = [int(b) for b in args.batches.split(",")]
     out = []
     if args.model in ("resnet50", "all"):
-        out += measure("resnet50", batches)
+        out += measure("resnet50", batches, method=args.method)
     if args.model in ("bert", "all"):
-        out += measure("bert", batches, seq=args.seq)
+        out += measure("bert", batches, seq=args.seq,
+                       method=args.method)
+    # Merge with prior invocations (partial runs build the table up).
+    try:
+        with open("DEVICE_ROOFLINE.json") as f:
+            prior = json.load(f)
+    except Exception:
+        prior = []
+    key = lambda r: (r["model"], r["batch"], r.get("seq"))
+    merged = {key(r): r for r in prior}
+    merged.update({key(r): r for r in out})
     with open("DEVICE_ROOFLINE.json", "w") as f:
-        json.dump(out, f, indent=2)
+        json.dump(sorted(merged.values(),
+                         key=lambda r: (r["model"], r["batch"])), f, indent=2)
 
 
 if __name__ == "__main__":

@@ -170,7 +170,16 @@ def default_model_factory(component_id: str, spec):
         if spec.framework == "jax":
             from kfserving_tpu.predictors.jax_model import JaxModel
 
-            return JaxModel(isvc_name, spec.storage_uri)
+            # The spec's ParallelismSpec decides the within-replica mesh
+            # (placement is a deployment concern; the artifact's
+            # config.json stays mesh-agnostic — SURVEY.md §5.8).
+            par = getattr(spec, "parallelism", None)
+            overrides = {}
+            if par is not None and par.chips_per_replica > 1:
+                overrides["mesh"] = {
+                    "dp": par.dp, "tp": par.tp, "sp": par.sp}
+            return JaxModel(isvc_name, spec.storage_uri,
+                            config_overrides=overrides)
         if spec.framework == "sklearn":
             from kfserving_tpu.predictors.sklearnserver import SKLearnModel
 

@@ -20,6 +20,8 @@ import logging
 import random
 from typing import Dict, Optional, Tuple
 
+import aiohttp
+
 from kfserving_tpu.server.http import HTTPServer, Request, Response, Router
 
 logger = logging.getLogger("kfserving_tpu.control.router")
@@ -58,10 +60,17 @@ class IngressRouter:
               self._predict_direct)
 
     async def start_async(self, host: str = "127.0.0.1"):
-        import aiohttp
-
+        # force_close: no keep-alive pooling to upstreams.  A reused
+        # half-closed socket would raise ServerDisconnectedError before
+        # the replica saw anything — indistinguishable from a true
+        # mid-request drop, which must NOT be retried (may duplicate
+        # inference).  Closing per request makes "ClientError after
+        # connect" reliably mean "the request was dispatched", at the
+        # cost of a TCP handshake per proxy hop (local links; the
+        # reference's activator pays the same per-request dial).
         self._session = aiohttp.ClientSession(
-            timeout=aiohttp.ClientTimeout(total=self.upstream_timeout_s))
+            timeout=aiohttp.ClientTimeout(total=self.upstream_timeout_s),
+            connector=aiohttp.TCPConnector(force_close=True))
         await self.http_server.start(host, self.http_port)
         self.http_port = self.http_server.port
 
@@ -250,11 +259,12 @@ class IngressRouter:
                     return Response(
                         body=b'{"error": "upstream timeout"}',
                         status=504)
-                except Exception as e:
-                    # Connection-level failure (refused/reset/closed):
-                    # the replica process is gone — evict and fail
-                    # over.  HTTP-level errors returned above are never
-                    # retried.
+                except aiohttp.ClientConnectorError as e:
+                    # PRE-dispatch connection failure (refused / no
+                    # route): the request never reached the replica, so
+                    # a retry cannot duplicate inference — evict and
+                    # fail over.  HTTP-level errors returned above are
+                    # never retried.
                     logger.warning("proxy to %s failed (attempt %d): %s",
                                    url, attempt + 1, e)
                     failed.add(host)
@@ -263,6 +273,18 @@ class IngressRouter:
                         cid = self.controller.reconciler.component_id(
                             isvc, cname)
                         await self._evict_replica(cid, host)
+                except aiohttp.ClientError as e:
+                    # Mid-request/-response failure (reset after
+                    # dispatch, truncated read): the upstream may have
+                    # executed the inference, so neither retry (would
+                    # duplicate work) nor evict (possibly transient,
+                    # e.g. one dropped keep-alive socket) — surface a
+                    # 502 like the timeout case surfaces 504.
+                    logger.warning("proxy to %s failed mid-request: %s",
+                                   url, e)
+                    return Response(
+                        body=b'{"error": "upstream connection failed"}',
+                        status=502)
             return Response(
                 body=b'{"error": "upstream unavailable"}', status=503)
         finally:

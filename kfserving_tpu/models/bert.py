@@ -73,13 +73,20 @@ class BertSelfAttention(nn.Module):
         attn_mask = None
         kv_lengths = None
         if mask is not None:
-            attn_mask = mask[:, None, None, :].astype(bool)
-            if cfg.prefix_padding:
+            if cfg.prefix_padding and cfg.attn_fn is None:
                 # Serving masks are suffix padding (the batcher pads seq
                 # buckets at the end): declaring lengths keeps long
                 # buckets on the flash kernel instead of the
-                # materialized-mask XLA path.
+                # materialized-mask XLA path.  kv_lengths and mask are
+                # mutually exclusive downstream (ops/attention.py), so
+                # the mask is dropped here — prefix_padding declares it
+                # redundant with the lengths (enforced host-side for
+                # serving by jax_model._check_prefix_mask; direct
+                # callers with non-suffix masks set prefix_padding
+                # False).
                 kv_lengths = mask.astype(jnp.int32).sum(-1)
+            else:
+                attn_mask = mask[:, None, None, :].astype(bool)
         if cfg.attn_fn is not None:
             out = cfg.attn_fn(q, k, v, attn_mask)
         else:

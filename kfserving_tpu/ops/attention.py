@@ -76,11 +76,22 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     causal: apply a causal mask (decoder serving).  Composes with an
         explicit mask (logical AND); the flash kernel path requires the
         causal-only case.
-    kv_lengths: optional int32 [B] declaring the mask to be suffix key
-        padding (real keys then padding) — the flash kernel masks it
-        natively, so padded seq buckets keep the fused path.  When flash
-        is ineligible the provided/derived mask serves via XLA.
+    kv_lengths: optional int32 [B] declaring suffix key padding (real
+        keys then padding) — the flash kernel masks it natively, so
+        padded seq buckets keep the fused path.  When flash is
+        ineligible, the equivalent suffix mask is derived and served via
+        XLA.  Mutually exclusive with `mask`: lengths fully determine
+        the suffix mask, and an inconsistent explicit mask would be
+        silently ignored on the kernel path (callers with arbitrary mask
+        patterns pass `mask` alone; the serving path enforces
+        suffix-ness host-side in jax_model._check_prefix_mask).
     """
+    if kv_lengths is not None and mask is not None:
+        raise ValueError(
+            "mask and kv_lengths are mutually exclusive: kv_lengths "
+            "asserts suffix padding and the flash path would silently "
+            "ignore a disagreeing mask; pass the mask alone for "
+            "arbitrary patterns")
     Lq, Lk = q.shape[1], k.shape[1]
     if kv_lengths is not None and mask is None:
         mask = (jnp.arange(Lk)[None, :]

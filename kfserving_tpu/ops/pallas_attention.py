@@ -10,9 +10,11 @@ turns attention HBM-bandwidth-bound.
 
 Layout contract matches kfserving_tpu.ops.attention: [B, L, H, D] in, same
 out.  D must be a multiple of 64 (64 pads the 128-lane width but measured
-34 TF/s on v5e; attention.py gates eligibility); L must be a multiple of
-128 — block sizes adapt downward (512/256/128) to divide any such L, so
-every legal seq bucket keeps the flash path.
+34 TF/s on v5e; attention.py gates eligibility); L needs a power-of-two
+block divisor >= 8 — block sizes adapt downward (512/256/.../8) to divide
+any such L, so every legal seq bucket keeps the flash path (128-multiples
+get full-width blocks; smaller divisors trade MXU efficiency for
+coverage).
 """
 
 import functools
@@ -141,8 +143,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     block_k = _fit_block(block_k, Lk)
     if Lq % block_q or Lk % block_k:
         raise ValueError(
-            f"seq lens ({Lq}, {Lk}) must be multiples of 128 "
-            f"(got blocks {block_q}, {block_k})")
+            f"seq lens ({Lq}, {Lk}) need a power-of-two block divisor "
+            f">= 8 (largest candidates {block_q}, {block_k} do not "
+            "divide them); pad sequences to a multiple of 8")
     scale = 1.0 / D ** 0.5
 
     # Fold heads into the grid's first axis: BHLD views with one (b,h) slab

@@ -88,9 +88,16 @@ class JaxModelConfig:
         self.pipeline_depth = pipeline_depth
 
     @classmethod
-    def from_file(cls, path: str) -> "JaxModelConfig":
+    def from_file(cls, path: str,
+                  overrides: Optional[Dict[str, Any]] = None
+                  ) -> "JaxModelConfig":
+        """Load config.json, with deployment-time overrides layered on
+        top (the control plane's ParallelismSpec injects `mesh` here —
+        the artifact stays mesh-agnostic, placement is a spec concern)."""
         with open(path) as f:
             data = json.load(f)
+        if overrides:
+            data.update(overrides)
         if "architecture" not in data:
             raise InvalidInput(f"{path} missing required key 'architecture'")
         return cls(**data)
@@ -101,11 +108,13 @@ class JaxModel(Model):
 
     def __init__(self, name: str, model_dir: str,
                  config: Optional[JaxModelConfig] = None,
-                 hbm: Optional[HBMManager] = None):
+                 hbm: Optional[HBMManager] = None,
+                 config_overrides: Optional[Dict[str, Any]] = None):
         super().__init__(name)
         self.model_dir = model_dir
         self.config = config
         self.hbm = hbm
+        self.config_overrides = dict(config_overrides or {})
         self.engine: Optional[JaxEngine] = None
         self.batcher: Optional[DynamicBatcher] = None
         self._local_dir: Optional[str] = None
@@ -118,7 +127,8 @@ class JaxModel(Model):
         cfg = self.config
         if cfg is None:
             cfg = JaxModelConfig.from_file(
-                os.path.join(self._local_dir, DEFAULT_CONFIG_NAME))
+                os.path.join(self._local_dir, DEFAULT_CONFIG_NAME),
+                overrides=self.config_overrides)
             self.config = cfg
 
         spec = create_model(cfg.architecture, **cfg.arch_kwargs)

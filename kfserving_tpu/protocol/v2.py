@@ -350,6 +350,14 @@ def decode_binary_response(body: bytes,
                    .get("binary_data_size") or 0)
         if not size:
             continue
+        if offset + size > len(body):
+            # A truncated response must fail cleanly, not as a numpy
+            # reshape error / silently short BYTES list (mirrors
+            # InferRequest.from_binary's overrun check).
+            raise InvalidInput(
+                f"binary output {out.get('name')!r} overruns the "
+                f"response body: need {offset + size} bytes, "
+                f"have {len(body)}")
         raw = body[offset:offset + size]
         offset += size
         if out["datatype"] == "BYTES":

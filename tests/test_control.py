@@ -534,3 +534,63 @@ async def test_router_timeout_does_not_evict(tmp_path):
     finally:
         await router.stop_async()
         await orch.shutdown()
+
+
+async def test_router_mid_response_failure_no_retry_no_evict(tmp_path):
+    """A connection that drops AFTER dispatch (mid-response) must not be
+    retried (the upstream may have executed the inference — a retry
+    would duplicate work) and must not evict the replica (possibly one
+    transient socket): the client gets 502 (ADVICE r2 router.py:260)."""
+    from kfserving_tpu import Model
+
+    hits = {"n": 0}
+
+    class OkModel(Model):
+        def load(self):
+            self.ready = True
+            return True
+
+        async def predict(self, request):
+            return {"predictions": [1]}
+
+    def factory(component_id, spec):
+        return OkModel(component_id.split("/")[1])
+
+    orch = InProcessOrchestrator(model_factory=factory)
+    controller = Controller(orch)
+    router = IngressRouter(controller)
+    await router.start_async()
+
+    # A raw socket listener that reads the request then slams the
+    # connection shut: aiohttp surfaces ServerDisconnectedError (a
+    # ClientError that is NOT ClientConnectorError).
+    async def slam(reader, writer):
+        hits["n"] += 1
+        await reader.read(1024)
+        writer.close()
+
+    slam_server = await asyncio.start_server(slam, "127.0.0.1", 0)
+    slam_port = slam_server.sockets[0].getsockname()[1]
+    try:
+        isvc = _isvc(name="drop", framework="custom")
+        isvc.predictor.command = ["unused"]
+        await controller.apply(isvc)
+        cid = "default/drop/predictor"
+        replicas = orch.replicas(cid)
+        assert len(replicas) == 1
+        # Point the single replica's advertised host at the slammer.
+        replicas[0].host = f"127.0.0.1:{slam_port}"
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f"http://127.0.0.1:{router.http_port}"
+                    f"/v1/models/drop:predict",
+                    json={"instances": [[1]]}) as resp:
+                assert resp.status == 502, await resp.text()
+        assert hits["n"] == 1  # dispatched exactly once: no retry
+        assert len(orch.replicas(cid)) == 1  # not evicted
+    finally:
+        slam_server.close()
+        await router.stop_async()
+        await orch.shutdown()

@@ -230,3 +230,41 @@ def test_dispatch_uses_lengths_for_prefix_masks():
     expect = _xla_attention(q, q, q, jnp.asarray(mask))
     np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
                                atol=1e-5, rtol=1e-5)
+
+
+def test_mask_and_kv_lengths_mutually_exclusive():
+    """Passing both is rejected: kv_lengths asserts suffix padding and
+    the flash path would silently ignore a disagreeing mask (ADVICE r2
+    attention.py:104)."""
+    import pytest
+
+    q = jnp.zeros((2, 16, 2, 64), jnp.float32)
+    lengths = jnp.array([8, 16], jnp.int32)
+    mask = (jnp.arange(16)[None, :] < lengths[:, None])[:, None, None, :]
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        dot_product_attention(q, q, q, mask=mask, kv_lengths=lengths)
+
+
+def test_bert_prefix_padding_false_serves_arbitrary_mask():
+    """With prefix_padding disabled the mask (any pattern) rides the XLA
+    path; with it enabled the same suffix mask serves as kv_lengths.
+    Both must agree on suffix-padded input."""
+    from kfserving_tpu.models.bert import BertConfig, BertForMaskedLM
+
+    cfg_len = BertConfig(vocab_size=64, hidden_size=32, num_heads=2,
+                         num_layers=1, intermediate_size=64,
+                         max_position=16, prefix_padding=True)
+    cfg_mask = BertConfig(vocab_size=64, hidden_size=32, num_heads=2,
+                          num_layers=1, intermediate_size=64,
+                          max_position=16, prefix_padding=False)
+    ids = np.random.default_rng(0).integers(1, 64, size=(2, 16))
+    ids = jnp.asarray(ids, jnp.int32)
+    mask = jnp.asarray([[1] * 10 + [0] * 6, [1] * 16], jnp.int32)
+    m_len = BertForMaskedLM(cfg_len)
+    m_mask = BertForMaskedLM(cfg_mask)
+    params = m_len.init(jax.random.PRNGKey(0), ids, mask)
+    out_len = m_len.apply(params, ids, mask)
+    out_mask = m_mask.apply(params, ids, mask)
+    np.testing.assert_allclose(np.asarray(out_len)[:, :10],
+                               np.asarray(out_mask)[:, :10],
+                               rtol=2e-2, atol=2e-2)

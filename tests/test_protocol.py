@@ -241,3 +241,20 @@ class TestBinaryResponse:
             {"x": np.zeros(2, np.float32)}, binary_output=True)
         req = v2.InferRequest.from_binary(body, hlen)
         assert req.parameters.get("binary_data_output") is True
+
+
+def test_decode_binary_response_truncated_body_clean_error():
+    """A truncated binary response raises InvalidInput, not a numpy
+    reshape error (ADVICE r2 v2.py:353)."""
+    import pytest
+
+    from kfserving_tpu.protocol import v2 as v2proto
+    from kfserving_tpu.protocol.errors import InvalidInput
+
+    arr = np.arange(12, dtype=np.float32).reshape(1, 12)
+    body, hlen = v2proto.encode_binary_response(
+        v2proto.make_response("m", {"out": arr}))
+    ok = v2proto.decode_binary_response(body, hlen)
+    assert np.allclose(ok["outputs"][0]["data"], arr)
+    with pytest.raises(InvalidInput, match="overruns"):
+        v2proto.decode_binary_response(body[:-8], hlen)
