@@ -204,6 +204,10 @@ def default_model_factory(component_id: str, spec):
             f"in-process orchestrator cannot run framework "
             f"{spec.framework!r}")
     if isinstance(spec, ExplainerSpec):
+        if spec.explainer_type == "anchor_tabular":
+            from kfserving_tpu.explainers import AnchorTabular
+
+            return AnchorTabular(isvc_name, spec.storage_uri)
         from kfserving_tpu.explainers import SaliencyExplainer
 
         return SaliencyExplainer(isvc_name, spec.storage_uri)

@@ -182,9 +182,13 @@ class InferenceServiceReconciler:
             want = desired.get(rev, 0)
             for replica in replicas[want:]:
                 await self.orchestrator.delete_replica(replica)
-        # scale up
+        # scale up — counting creates already in flight (an orchestrator
+        # swapping/recycling a replica registers it only when ready; a
+        # second spawn in that window would double-own a TPU chip).
+        pending = getattr(self.orchestrator, "pending_creates",
+                          lambda cid_, rev_: 0)
         for rev, want in desired.items():
-            have = len(by_rev.get(rev, []))
+            have = len(by_rev.get(rev, [])) + pending(cid, rev)
             for _ in range(max(0, want - have)):
                 await self.orchestrator.create_replica(
                     cid, rev, comp, placement=placements.get(rev))

@@ -160,7 +160,20 @@ class IngressRouter:
         """Scale-from-zero: bring up one replica and wait (activator
         buffering)."""
         logger.info("activating %s (scale from zero)", cid)
-        await self.controller.reconciler.scale(isvc, cname, 1)
+        try:
+            await self.controller.reconciler.scale(isvc, cname, 1)
+        except Exception:
+            # A racing create (e.g. a recycle swap) may win the chip and
+            # fail this one — the poll below still succeeds off the
+            # winner's replica.  But if nothing else is creating one,
+            # the failure is deterministic (bad spec, storage error):
+            # fail fast instead of hanging the client for the full poll.
+            logger.exception("activation scale for %s failed", cid)
+            pending = getattr(self.controller.reconciler.orchestrator,
+                              "pending_creates", lambda c, r: 0)
+            if pending(cid, revision) == 0 and \
+                    self._pick_replica(cid, revision) is None:
+                return None
         for _ in range(600):
             host = self._pick_replica(cid, revision)
             if host is not None:

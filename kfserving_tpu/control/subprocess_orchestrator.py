@@ -43,9 +43,52 @@ def _free_port(host: str = "127.0.0.1") -> int:
 
 
 @dataclass
+class RecyclePolicy:
+    """Replica process recycling (ROOFLINE.md soak: the tunneled device
+    transport leaks ~3.2 GB/min under load; the pod-level analogue is
+    kubelet restarting a container that crosses its memory limit —
+    SURVEY.md §5.3 delegation, built natively here).
+
+    A replica crossing either threshold is drain-replaced: a successor
+    is spawned (before the drain when `overlap`, after otherwise) and
+    the old process gets SIGTERM (the server's handler drains in-flight
+    work).  The router's readiness gating + scale-from-zero buffering
+    carry traffic across the swap.
+
+    overlap=False is for chip-owning replicas: only one process can
+    hold the TPU, so the successor can't initialize until the old owner
+    exits.  CPU replicas keep overlap=True for a zero-gap swap.
+    """
+
+    max_requests: Optional[int] = None
+    max_rss_mb: Optional[float] = None
+    check_interval_s: float = 5.0
+    overlap: bool = True
+    # Successor grace: a replica younger than this is never recycled.
+    # Without it, a threshold at/below a fresh process's baseline RSS
+    # (easy with JAX loaded) would kill/spawn in an unbounded loop with
+    # a zero-replica gap per cycle on chip owners.
+    min_age_s: float = 30.0
+
+
+def _proc_rss_mb(pid: int) -> Optional[float]:
+    """Resident set size of a pid in MB (Linux /proc, no psutil)."""
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        return None
+    return None
+
+
+@dataclass
 class _Proc:
     process: asyncio.subprocess.Process
     port: int
+    spec: object = None
+    spawned_at: float = 0.0
 
 
 class SubprocessOrchestrator:
@@ -54,14 +97,28 @@ class SubprocessOrchestrator:
     def __init__(self, cluster_config: Optional[ClusterConfig] = None,
                  env_overrides: Optional[Dict[str, str]] = None,
                  host: str = "127.0.0.1",
-                 credentials=None):
+                 credentials=None,
+                 recycle: Optional[RecyclePolicy] = None):
         self.cluster_config = cluster_config or ClusterConfig()
         self.env_overrides = env_overrides or {}
         self.host = host
         # CredentialStore: per-service-account env injected into replica
         # processes (reference credential builder injects into containers).
         self.credentials = credentials
+        self.recycle = recycle
+        self.recycle_count = 0
+        self._watchdog: Optional[asyncio.Task] = None
+        self._recycling: set = set()  # replica ids being swapped
+        # (cid, revision) -> count of creates past spawn but not yet
+        # ready.  replicas() lists only ready processes, so without this
+        # the reconciler's scale-up and the recycler would both spawn
+        # during a swap window — fatal for chip-owning replicas (one
+        # process per TPU).
+        self._creating: Dict[tuple, int] = {}
         self.state: Dict[str, _ComponentState] = {}
+
+    def pending_creates(self, component_id: str, revision: str) -> int:
+        return self._creating.get((component_id, revision), 0)
 
     def replicas(self, component_id: str) -> List[Replica]:
         return list(self.state.get(component_id,
@@ -128,20 +185,34 @@ class SubprocessOrchestrator:
         env.update(self.env_overrides)
         logger.info("spawning replica %s rev=%s: %s",
                     component_id, revision[:8], " ".join(argv))
-        process = await asyncio.create_subprocess_exec(
-            *argv, env=env,
-            stdout=asyncio.subprocess.DEVNULL,
-            stderr=asyncio.subprocess.DEVNULL)
-        host = f"{self.host}:{port}"
+        key = (component_id, revision)
+        self._creating[key] = self._creating.get(key, 0) + 1
         try:
-            await self._wait_ready(process, host)
-        except Exception:
-            await self._terminate(process)
-            raise
+            process = await asyncio.create_subprocess_exec(
+                *argv, env=env,
+                stdout=asyncio.subprocess.DEVNULL,
+                stderr=asyncio.subprocess.DEVNULL)
+            host = f"{self.host}:{port}"
+            try:
+                await self._wait_ready(process, host)
+            except Exception:
+                await self._terminate(process)
+                raise
+        finally:
+            n = self._creating.get(key, 1) - 1
+            if n <= 0:
+                self._creating.pop(key, None)
+            else:
+                self._creating[key] = n
         replica = Replica(component_id, revision, host,
-                          handle=_Proc(process, port), placement=placement)
+                          handle=_Proc(
+                              process, port, spec=spec,
+                              spawned_at=asyncio.get_running_loop().time()),
+                          placement=placement)
         self.state.setdefault(component_id,
                               _ComponentState()).replicas.append(replica)
+        if self.recycle is not None and self._watchdog is None:
+            self._watchdog = asyncio.ensure_future(self._watchdog_loop())
         return replica
 
     async def _wait_ready(self, process, host: str) -> None:
@@ -169,6 +240,92 @@ class SubprocessOrchestrator:
                         f"{READY_TIMEOUT_S}s")
                 await asyncio.sleep(0.1)
 
+    # -- recycling ----------------------------------------------------------
+    async def _request_count(self, host: str) -> Optional[float]:
+        """Best-effort scrape of the replica's request counter (the
+        server's Prometheus text endpoint)."""
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=2.0)) as session:
+                async with session.get(f"http://{host}/metrics") as resp:
+                    if resp.status != 200:
+                        return None
+                    text = await resp.text()
+        except Exception:
+            return None
+        total = 0.0
+        for line in text.splitlines():
+            if line.startswith("kfserving_tpu_request_total{"):
+                try:
+                    total += float(line.rsplit(" ", 1)[1])
+                except (IndexError, ValueError):
+                    pass
+        return total
+
+    def _over_threshold(self, handle: _Proc) -> Optional[str]:
+        pol = self.recycle
+        if pol.max_rss_mb is not None and handle.process.pid:
+            rss = _proc_rss_mb(handle.process.pid)
+            if rss is not None and rss > pol.max_rss_mb:
+                return f"rss {rss:.0f}MB > {pol.max_rss_mb:.0f}MB"
+        return None
+
+    async def _watchdog_loop(self):
+        while True:
+            await asyncio.sleep(self.recycle.check_interval_s)
+            for cid, comp in list(self.state.items()):
+                for replica in list(comp.replicas):
+                    if id(replica) in self._recycling:
+                        continue
+                    handle: _Proc = replica.handle
+                    if handle is None or \
+                            handle.process.returncode is not None:
+                        continue
+                    age = asyncio.get_running_loop().time() \
+                        - handle.spawned_at
+                    if age < self.recycle.min_age_s:
+                        continue  # successor grace: no thrash loop
+                    reason = self._over_threshold(handle)
+                    if reason is None and \
+                            self.recycle.max_requests is not None:
+                        n = await self._request_count(replica.host)
+                        if n is not None and \
+                                n >= self.recycle.max_requests:
+                            reason = (f"served {n:.0f} >= "
+                                      f"{self.recycle.max_requests} "
+                                      "requests")
+                    if reason is not None:
+                        self._recycling.add(id(replica))
+                        try:
+                            await self._recycle_replica(replica, reason)
+                        except Exception:
+                            logger.exception(
+                                "recycle of %s failed", replica.host)
+                        finally:
+                            self._recycling.discard(id(replica))
+
+    async def _recycle_replica(self, replica: Replica, reason: str):
+        """Drain-then-replace.  overlap: successor first (zero-gap; CPU
+        replicas).  Chip owners (overlap=False): the old process must
+        release the TPU before the successor can initialize — the
+        router's buffering/failover carries requests across the gap."""
+        logger.warning("recycling replica %s at %s: %s",
+                       replica.component_id, replica.host, reason)
+        handle: _Proc = replica.handle
+        if self.recycle.overlap:
+            await self.create_replica(
+                replica.component_id, replica.revision, handle.spec,
+                placement=replica.placement)
+            await self.delete_replica(replica)
+        else:
+            await self.delete_replica(replica)
+            await self.create_replica(
+                replica.component_id, replica.revision, handle.spec,
+                placement=replica.placement)
+        self.recycle_count += 1
+
     async def delete_replica(self, replica: Replica) -> None:
         comp = self.state.get(replica.component_id)
         if comp and replica in comp.replicas:
@@ -191,6 +348,13 @@ class SubprocessOrchestrator:
             await process.wait()
 
     async def shutdown(self):
+        if self._watchdog is not None:
+            self._watchdog.cancel()
+            try:
+                await self._watchdog
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._watchdog = None
         for comp in list(self.state.values()):
             for replica in list(comp.replicas):
                 await self.delete_replica(replica)

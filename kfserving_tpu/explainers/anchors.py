@@ -1,0 +1,298 @@
+"""Native anchors explainer: precision-guided IF-THEN rules (tabular).
+
+The reference's flagship explainer is alibi AnchorTabular served by
+alibiexplainer (reference
+python/alibiexplainer/alibiexplainer/explainer.py:39-100, anchor
+dispatch :55-66; anchor_tabular.py wraps alibi.explainers.AnchorTabular
+and proxies model calls through the predictor, explainer.py:66-76).
+This is a first-party implementation of the same artifact — an anchor
+rule
+
+    IF petal_len <= 1.57 AND petal_w <= 0.4 THEN predict setosa
+    (precision 0.99, coverage 0.31)
+
+found by beam search over discretized feature predicates, with
+precision estimated by Monte-Carlo perturbation through the live
+predictor (Ribeiro et al. 2018, "Anchors: High-Precision
+Model-Agnostic Explanations").
+
+Differences from alibi, by design:
+- the sampler and beam search are ~200 lines of numpy with *batched*
+  predictor calls — every precision estimate is one `predict(batch)`
+  round trip, which on this stack rides the dynamic batcher and the
+  TPU engine's padded buckets (alibi's sampler loops row-by-row);
+- precision confirmation is a fixed-budget re-estimate, not KL-LUCB
+  (serving-grade simplicity; the confirm batch is 5x the search batch).
+"""
+
+import asyncio
+import inspect
+import json
+import logging
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.protocol import v1
+from kfserving_tpu.protocol.errors import InvalidInput
+
+logger = logging.getLogger("kfserving_tpu.explainers.anchors")
+
+
+class AnchorSearch:
+    """Beam search for the smallest high-precision anchor.
+
+    predict_fn: (sync or async) batch [n, d] -> class labels [n] (or
+        probabilities [n, k], argmax'd here — the reference wraps the
+        same two cases, anchor_tabular.py:47-56).
+    train_data: [m, d] background sample defining the perturbation
+        distribution and coverage.
+    """
+
+    def __init__(self, predict_fn: Callable,
+                 train_data: np.ndarray,
+                 feature_names: Optional[Sequence[str]] = None,
+                 categorical_features: Optional[Sequence[int]] = None,
+                 n_bins: int = 4,
+                 seed: int = 0):
+        self.predict_fn = predict_fn
+        self.train = np.asarray(train_data, np.float64)
+        if self.train.ndim != 2:
+            raise InvalidInput("train_data must be [rows, features]")
+        m, d = self.train.shape
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"f{j}" for j in range(d)])
+        self.categorical = set(categorical_features or ())
+        self.rng = np.random.default_rng(seed)
+        # Quantile discretization for numeric features (alibi uses the
+        # same quartile default).
+        self.bin_edges: Dict[int, np.ndarray] = {}
+        for j in range(d):
+            if j in self.categorical:
+                continue
+            qs = np.quantile(self.train[:, j],
+                             np.linspace(0, 1, n_bins + 1)[1:-1])
+            self.bin_edges[j] = np.unique(qs)
+
+    # -- predicates --------------------------------------------------------
+    def _bin_of(self, j: int, value: float) -> int:
+        if j in self.categorical:
+            return int(value)
+        return int(np.digitize(value, self.bin_edges[j]))
+
+    def _predicate_mask(self, j: int, b: int,
+                        data: np.ndarray) -> np.ndarray:
+        """Rows of `data` whose feature j falls in bin b."""
+        col = data[:, j]
+        if j in self.categorical:
+            return col == b
+        edges = self.bin_edges[j]
+        lo = -np.inf if b == 0 else edges[b - 1]
+        hi = np.inf if b == len(edges) else edges[b]
+        return (col > lo) & (col <= hi)
+
+    def _describe(self, j: int, b: int) -> str:
+        name = self.feature_names[j]
+        if j in self.categorical:
+            return f"{name} = {b}"
+        edges = self.bin_edges[j]
+        if b == 0:
+            return f"{name} <= {edges[0]:.2f}"
+        if b == len(edges):
+            return f"{name} > {edges[-1]:.2f}"
+        return f"{edges[b - 1]:.2f} < {name} <= {edges[b]:.2f}"
+
+    # -- sampling ----------------------------------------------------------
+    def _sample(self, x: np.ndarray, anchor: Tuple[int, ...],
+                n: int) -> np.ndarray:
+        """Perturbations conditioned on the anchor: anchored features
+        take values from the same bin as x (from the background pool,
+        falling back to x's value), free features take whole background
+        rows — the paper's D(z|A)."""
+        idx = self.rng.integers(0, len(self.train), size=n)
+        z = self.train[idx].copy()
+        for j in anchor:
+            b = self._bin_of(j, x[j])
+            pool = self.train[self._predicate_mask(j, b, self.train), j]
+            if len(pool):
+                z[:, j] = self.rng.choice(pool, size=n)
+            else:
+                z[:, j] = x[j]
+        return z
+
+    async def _labels(self, batch: np.ndarray) -> np.ndarray:
+        out = self.predict_fn(batch)
+        if inspect.isawaitable(out):
+            out = await out
+        out = np.asarray(out)
+        if out.ndim > 1:  # probabilities/logits -> class
+            out = np.argmax(out, axis=-1)
+        return out.reshape(-1)
+
+    async def _precision(self, x: np.ndarray, label,
+                         anchor: Tuple[int, ...], n: int) -> float:
+        z = self._sample(x, anchor, n)
+        labels = await self._labels(z)
+        return float(np.mean(labels == label))
+
+    def _coverage(self, x: np.ndarray, anchor: Tuple[int, ...]) -> float:
+        mask = np.ones(len(self.train), bool)
+        for j in anchor:
+            mask &= self._predicate_mask(j, self._bin_of(j, x[j]),
+                                         self.train)
+        return float(np.mean(mask))
+
+    # -- search ------------------------------------------------------------
+    async def explain(self, x: Any, threshold: float = 0.95,
+                      batch_size: int = 128, beam_size: int = 2,
+                      max_anchor_size: Optional[int] = None
+                      ) -> Dict[str, Any]:
+        x = np.asarray(x, np.float64).reshape(-1)
+        d = x.shape[0]
+        if d != self.train.shape[1]:
+            raise InvalidInput(
+                f"instance has {d} features, train_data has "
+                f"{self.train.shape[1]}")
+        label = (await self._labels(x[None]))[0]
+        max_size = max_anchor_size or d
+
+        # Empty anchor short-circuit: the model may predict this class
+        # for most of the distribution already.
+        base_prec = await self._precision(x, label, (), batch_size)
+        if base_prec >= threshold:
+            return self._result(x, label, (), base_prec)
+
+        beam: List[Tuple[Tuple[int, ...], float]] = [((), base_prec)]
+        best: Optional[Tuple[Tuple[int, ...], float]] = None
+        for _ in range(max_size):
+            candidates: Dict[Tuple[int, ...], float] = {}
+            for anchor, _ in beam:
+                for j in range(d):
+                    if j in anchor:
+                        continue
+                    cand = tuple(sorted(anchor + (j,)))
+                    if cand in candidates:
+                        continue
+                    candidates[cand] = await self._precision(
+                        x, label, cand, batch_size)
+            if not candidates:
+                break
+            ranked = sorted(candidates.items(),
+                            key=lambda kv: (-kv[1], len(kv[0])))
+            passing = [c for c in ranked if c[1] >= threshold]
+            if passing:
+                # Confirm with a 5x budget; prefer the widest-coverage
+                # confirmed anchor of this (smallest passing) size.
+                confirmed = []
+                for anchor, _ in passing[:beam_size + 1]:
+                    prec = await self._precision(
+                        x, label, anchor, batch_size * 5)
+                    if prec >= threshold:
+                        confirmed.append(
+                            (anchor, prec, self._coverage(x, anchor)))
+                if confirmed:
+                    confirmed.sort(key=lambda t: -t[2])
+                    anchor, prec, _ = confirmed[0]
+                    return self._result(x, label, anchor, prec)
+            beam = ranked[:beam_size]
+            if best is None or beam[0][1] > best[1]:
+                best = beam[0]
+        # No anchor met the threshold (noisy boundary instance): return
+        # the best found, flagged — the reference surfaces alibi's
+        # best-effort result the same way.
+        anchor, prec = best if best else ((), base_prec)
+        return self._result(x, label, anchor, prec, met_threshold=False)
+
+    def _result(self, x, label, anchor, precision,
+                met_threshold: bool = True) -> Dict[str, Any]:
+        return {
+            "anchor": [self._describe(j, self._bin_of(j, x[j]))
+                       for j in anchor],
+            "feature_indices": list(anchor),
+            "precision": round(precision, 4),
+            "coverage": round(self._coverage(x, anchor), 4),
+            "prediction": int(label) if np.ndim(label) == 0 else label,
+            "met_threshold": met_threshold,
+        }
+
+
+class AnchorTabular(Model):
+    """Served anchors explainer: sits on `:explain` and proxies model
+    calls to the predictor (the alibiexplainer deployment shape:
+    explainer.py:66-76 builds predict_fn from predictor_host).
+
+    Artifact layout (`storage_uri`):
+        anchors.json — {"feature_names": [...], "precision_threshold":
+                        0.95, "batch_size": 128, "n_bins": 4,
+                        "categorical_features": [...]}  (all optional)
+        train.npy    — [m, d] background data (required)
+    """
+
+    def __init__(self, name: str, model_dir: str,
+                 predictor_host: Optional[str] = None,
+                 predict_fn: Optional[Callable] = None):
+        super().__init__(name)
+        self.model_dir = model_dir
+        self.predictor_host = predictor_host
+        self._predict_fn = predict_fn
+        self.search: Optional[AnchorSearch] = None
+        self.config: Dict[str, Any] = {}
+
+    def load(self) -> bool:
+        from kfserving_tpu.storage import Storage
+
+        local = Storage.download(self.model_dir)
+        cfg_path = os.path.join(local, "anchors.json")
+        self.config = {}
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                self.config = json.load(f)
+        train_path = os.path.join(local, "train.npy")
+        if not os.path.exists(train_path):
+            raise InvalidInput(
+                f"anchors explainer needs train.npy in {self.model_dir}")
+        train = np.load(train_path)
+        self.search = AnchorSearch(
+            self._proxied_predict,
+            train,
+            feature_names=self.config.get("feature_names"),
+            categorical_features=self.config.get("categorical_features"),
+            n_bins=int(self.config.get("n_bins", 4)),
+            seed=int(self.config.get("seed", 0)))
+        self.ready = True
+        return True
+
+    async def _proxied_predict(self, batch: np.ndarray) -> np.ndarray:
+        if self._predict_fn is not None:
+            out = self._predict_fn(batch)
+            if inspect.isawaitable(out):
+                out = await out
+            return np.asarray(out)
+        if not self.predictor_host:
+            raise InvalidInput(
+                f"explainer {self.name} has no predictor_host")
+        resp = await super().predict(
+            {"instances": np.asarray(batch).tolist()})
+        if "predictions" not in resp:
+            raise InvalidInput(
+                "predictor response has no 'predictions' key")
+        return np.asarray(resp["predictions"])
+
+    async def explain(self, request: Any) -> Any:
+        if self.search is None:
+            raise InvalidInput(f"explainer {self.name} not loaded")
+        instances = v1.get_instances(request)
+        explanation = await self.search.explain(
+            np.asarray(instances[0], np.float64),
+            threshold=float(self.config.get("precision_threshold", 0.95)),
+            batch_size=int(self.config.get("batch_size", 128)),
+            beam_size=int(self.config.get("beam_size", 2)),
+            max_anchor_size=self.config.get("max_anchor_size"))
+        # alibi Explanation JSON shape: meta + data (explainer.py:84-87
+        # returns it verbatim); the anchor payload lives under data.
+        return {
+            "meta": {"name": "AnchorTabular"},
+            "data": explanation,
+        }

@@ -71,27 +71,21 @@ class BertSelfAttention(nn.Module):
         v = proj("value")(hidden)
         # mask [B, L] -> [B, 1, 1, L] broadcast over heads and query pos.
         attn_mask = None
-        kv_lengths = None
         if mask is not None:
-            if cfg.prefix_padding and cfg.attn_fn is None:
-                # Serving masks are suffix padding (the batcher pads seq
-                # buckets at the end): declaring lengths keeps long
-                # buckets on the flash kernel instead of the
-                # materialized-mask XLA path.  kv_lengths and mask are
-                # mutually exclusive downstream (ops/attention.py), so
-                # the mask is dropped here — prefix_padding declares it
-                # redundant with the lengths (enforced host-side for
-                # serving by jax_model._check_prefix_mask; direct
-                # callers with non-suffix masks set prefix_padding
-                # False).
-                kv_lengths = mask.astype(jnp.int32).sum(-1)
-            else:
-                attn_mask = mask[:, None, None, :].astype(bool)
+            attn_mask = mask[:, None, None, :].astype(bool)
         if cfg.attn_fn is not None:
             out = cfg.attn_fn(q, k, v, attn_mask)
         else:
-            out = dot_product_attention(q, k, v, mask=attn_mask,
-                                        kv_lengths=kv_lengths)
+            # prefix_padding declares serving masks to be suffix padding
+            # (the batcher pads seq buckets at the end): the flash
+            # kernel consumes the mask as per-row lengths, while the
+            # XLA fallback applies the true mask — a direct caller with
+            # an interior mask stays correct on XLA (suffix-ness is
+            # enforced host-side for serving by
+            # jax_model._check_prefix_mask).
+            out = dot_product_attention(
+                q, k, v, mask=attn_mask,
+                prefix_padding=cfg.prefix_padding)
         out = nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype, name="out")(out)
         return out

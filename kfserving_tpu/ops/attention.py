@@ -68,7 +68,8 @@ def _flash_eligible(q: jax.Array) -> bool:
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           mask: Optional[jax.Array] = None,
                           causal: bool = False,
-                          kv_lengths: Optional[jax.Array] = None
+                          kv_lengths: Optional[jax.Array] = None,
+                          prefix_padding: bool = False
                           ) -> jax.Array:
     """Attention over [batch, len, heads, head_dim] tensors.
 
@@ -85,14 +86,27 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         silently ignored on the kernel path (callers with arbitrary mask
         patterns pass `mask` alone; the serving path enforces
         suffix-ness host-side in jax_model._check_prefix_mask).
+    prefix_padding: declares `mask` to be suffix key padding.  The
+        flash path then consumes it as per-row lengths (sum over the
+        key axis) while the XLA fallback still applies the mask
+        itself — so a contract-violating (non-suffix) mask stays
+        correct on XLA and is wrong only where the declaration was
+        load-bearing (the kernel), unlike kv_lengths which bakes the
+        suffix form into both paths.
     """
     if kv_lengths is not None and mask is not None:
         raise ValueError(
             "mask and kv_lengths are mutually exclusive: kv_lengths "
             "asserts suffix padding and the flash path would silently "
             "ignore a disagreeing mask; pass the mask alone for "
-            "arbitrary patterns")
+            "arbitrary patterns (optionally with prefix_padding=True)")
     Lq, Lk = q.shape[1], k.shape[1]
+    derived_lengths = None
+    if prefix_padding and mask is not None and not causal:
+        # mask broadcasts over [B, H, Lq, Lk]; any one query row's key
+        # mask gives the row's real-key count for a suffix mask.
+        flat = jnp.reshape(mask, (mask.shape[0], -1, mask.shape[-1]))
+        derived_lengths = flat[:, 0, :].astype(jnp.int32).sum(-1)
     if kv_lengths is not None and mask is None:
         mask = (jnp.arange(Lk)[None, :]
                 < kv_lengths[:, None])[:, None, None, :]
@@ -112,8 +126,9 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     else:
         # Non-causal flash handles rectangular (Lq != Lk) grids and
         # key-padding lengths natively.
-        flash_ok = mask is None or kv_lengths is not None
-        lengths = kv_lengths
+        flash_ok = (mask is None or kv_lengths is not None
+                    or derived_lengths is not None)
+        lengths = kv_lengths if kv_lengths is not None else derived_lengths
     if flash_ok and _flash_eligible(q):
         try:
             from kfserving_tpu.ops.pallas_attention import flash_attention

@@ -18,6 +18,7 @@ coverage).
 """
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -29,12 +30,15 @@ DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
 
 
-def _fit_block(block: int, length: int) -> int:
-    """Largest candidate block (<= requested) dividing `length`."""
+def _fit_block(block: int, length: int) -> Optional[int]:
+    """Largest candidate block (<= requested) dividing `length`, or
+    None when no power-of-two >= 8 divides it — the caller raises the
+    documented error rather than launching the kernel with an unaligned
+    block (Mosaic mis-lowers those)."""
     for b in (block, 512, 256, 128, 64, 32, 16, 8):
         if b <= block and length % b == 0:
             return b
-    return min(block, length)
+    return None
 
 
 def _flash_kernel(*refs,
@@ -141,11 +145,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # size, so L=640 runs with 128-blocks instead of losing the kernel.
     block_q = _fit_block(block_q, Lq)
     block_k = _fit_block(block_k, Lk)
-    if Lq % block_q or Lk % block_k:
+    if block_q is None or block_k is None:
         raise ValueError(
             f"seq lens ({Lq}, {Lk}) need a power-of-two block divisor "
-            f">= 8 (largest candidates {block_q}, {block_k} do not "
-            "divide them); pad sequences to a multiple of 8")
+            ">= 8; pad sequences to a multiple of 8")
     scale = 1.0 / D ** 0.5
 
     # Fold heads into the grid's first axis: BHLD views with one (b,h) slab

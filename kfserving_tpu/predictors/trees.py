@@ -219,6 +219,17 @@ class LightGBMEnsemble:
                     "model uses categorical splits; the native "
                     "evaluator handles numerical splits only — install "
                     "lightgbm for categorical models")
+            if any((d >> 2) & 3 == 1 for d in dt):
+                # Bits 2-3 = missing_type (0 None, 1 Zero, 2 NaN).  The
+                # walk routes only NaN through the default branch; a
+                # zero_as_missing model needs zeros routed there too —
+                # reject at load rather than silently diverge from
+                # lightgbm's output.
+                raise ValueError(
+                    "model uses zero-as-missing splits "
+                    "(missing_type=Zero); the native evaluator routes "
+                    "only NaN as missing — install lightgbm for this "
+                    "model")
             n_internal = len(feat)
             # Flatten internal nodes then leaves into one array; child id
             # c >= 0 is internal node c, c < 0 is leaf ~c (= -(c)-1).

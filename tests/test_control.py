@@ -594,3 +594,38 @@ async def test_router_mid_response_failure_no_retry_no_evict(tmp_path):
         slam_server.close()
         await router.stop_async()
         await orch.shutdown()
+
+
+async def test_activation_fails_fast_on_deterministic_scale_error():
+    """Scale-from-zero for a spec whose replica creation fails
+    deterministically must 503 fast, not hang the client for the full
+    60s activation poll (review r3 router.py:164)."""
+    class BoomOrchestrator(InProcessOrchestrator):
+        async def create_replica(self, component_id, revision, spec,
+                                 placement=None):
+            raise RuntimeError("no such artifact")
+
+    orch = BoomOrchestrator()
+    controller = Controller(orch)
+    router = IngressRouter(controller)
+    await router.start_async()
+    try:
+        isvc = _isvc(name="doomed", framework="custom")
+        isvc.predictor.command = ["unused"]
+        isvc.predictor.min_replicas = 0  # apply succeeds with 0 replicas
+        await controller.apply(isvc)
+        import time
+
+        import aiohttp
+
+        t0 = time.perf_counter()
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f"http://127.0.0.1:{router.http_port}"
+                    f"/v1/models/doomed:predict",
+                    json={"instances": [[1]]}) as resp:
+                assert resp.status == 503
+        assert time.perf_counter() - t0 < 10.0  # not the 60s poll
+    finally:
+        await router.stop_async()
+        await orch.shutdown()

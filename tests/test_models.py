@@ -268,3 +268,35 @@ def test_bert_prefix_padding_false_serves_arbitrary_mask():
     np.testing.assert_allclose(np.asarray(out_len)[:, :10],
                                np.asarray(out_mask)[:, :10],
                                rtol=2e-2, atol=2e-2)
+
+
+def test_bert_interior_mask_correct_on_xla_path():
+    """prefix_padding declares masks suffix-form for the flash kernel,
+    but the XLA fallback must honor the TRUE mask — an interior-padding
+    mask gives identical logits with the flag on or off when flash is
+    ineligible (CPU) (review r3 bert.py:87)."""
+    from kfserving_tpu.models.bert import BertConfig, BertForMaskedLM
+
+    kw = dict(vocab_size=64, hidden_size=32, num_heads=2, num_layers=1,
+              intermediate_size=64, max_position=16)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        1, 64, size=(2, 16)), jnp.int32)
+    interior = jnp.asarray([[1, 1, 0, 0, 1, 1, 1, 1] + [1] * 8,
+                            [1] * 16], jnp.int32)
+    m_on = BertForMaskedLM(BertConfig(prefix_padding=True, **kw))
+    m_off = BertForMaskedLM(BertConfig(prefix_padding=False, **kw))
+    params = m_on.init(jax.random.PRNGKey(0), ids, interior)
+    np.testing.assert_allclose(
+        np.asarray(m_on.apply(params, ids, interior)),
+        np.asarray(m_off.apply(params, ids, interior)),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_flash_attention_rejects_indivisible_seq_len():
+    """L with no power-of-two divisor >= 8 raises the documented error
+    instead of launching an unaligned Pallas block (review r3)."""
+    from kfserving_tpu.ops.pallas_attention import flash_attention
+
+    q = jnp.zeros((1, 12, 2, 64), jnp.float32)
+    with pytest.raises(ValueError, match="power-of-two block divisor"):
+        flash_attention(q, q, q)
