@@ -56,8 +56,26 @@ p_canary = sub.add_parser("canary", help="set canary traffic percent")
 p_canary.add_argument("name")
 p_canary.add_argument("--percent", type=int, required=True)
 
-p_promote = sub.add_parser("promote", help="promote canary to 100%")
+p_promote = sub.add_parser("promote", help="promote canary to 100%%")
 p_promote.add_argument("name")
+
+p_creds = sub.add_parser(
+    "credentials",
+    help="register storage credentials (reference set_credentials)")
+creds_sub = p_creds.add_subparsers(dest="creds_command", required=True)
+for _provider in ("gcs", "s3", "azure"):
+    cp = creds_sub.add_parser(f"set-{_provider}")
+    cp.add_argument("-f", "--credentials-file", required=True)
+    cp.add_argument("--service-account", default="default")
+    if _provider == "s3":
+        cp.add_argument("--profile", default="default")
+        cp.add_argument("--endpoint", default=None)
+        cp.add_argument("--region", default=None)
+        cp.add_argument("--use-https", default=None)
+        cp.add_argument("--verify-ssl", default=None)
+creds_sub.add_parser("list")
+creds_del = creds_sub.add_parser("delete")
+creds_del.add_argument("name")
 
 p_tm = sub.add_parser("trainedmodel", help="TrainedModel ops")
 tm_sub = p_tm.add_subparsers(dest="tm_command", required=True)
@@ -104,6 +122,29 @@ async def _run(args) -> dict:
             return await c.rollout_canary(args.name, args.percent, ns)
         if args.command == "promote":
             return await c.promote(args.name, ns)
+        if args.command == "credentials":
+            if args.creds_command == "set-gcs":
+                name = await c.set_gcs_credentials(
+                    args.credentials_file, args.service_account)
+                return {"secret": name,
+                        "serviceAccount": args.service_account}
+            if args.creds_command == "set-s3":
+                name = await c.set_s3_credentials(
+                    args.credentials_file, args.service_account,
+                    s3_profile=args.profile, s3_endpoint=args.endpoint,
+                    s3_region=args.region, s3_use_https=args.use_https,
+                    s3_verify_ssl=args.verify_ssl)
+                return {"secret": name,
+                        "serviceAccount": args.service_account}
+            if args.creds_command == "set-azure":
+                name = await c.set_azure_credentials(
+                    args.credentials_file, args.service_account)
+                return {"secret": name,
+                        "serviceAccount": args.service_account}
+            if args.creds_command == "list":
+                return await c.list_secrets()
+            if args.creds_command == "delete":
+                return await c.delete_secret(args.name)
         if args.command == "trainedmodel":
             if args.tm_command == "apply":
                 with open(args.filename) as f:

@@ -247,6 +247,77 @@ class KFServingClient:
         url = f"{self._ingress()}/v1/models/{model}:explain"
         return await self._request("POST", url, payload)
 
+    # -- credential registration (reference api/creds_utils.py:26-142) ------
+    async def create_secret(self, payload: Dict[str, Any],
+                            service_account: Optional[str] = None,
+                            name: Optional[str] = None) -> str:
+        """Register a secret; returns the (possibly generated) name."""
+        body = dict(payload)
+        if name:
+            body["name"] = name
+        if service_account:
+            body["serviceAccount"] = service_account
+        result = await self._request(
+            "POST", f"{self.control_url}/v1/secrets", body)
+        return result["name"]
+
+    async def attach_secret(self, service_account: str,
+                            secret_name: str) -> Dict[str, Any]:
+        return await self._request(
+            "POST",
+            f"{self.control_url}/v1/serviceaccounts/{service_account}"
+            f"/secrets",
+            {"secret": secret_name})
+
+    async def list_secrets(self) -> Dict[str, Any]:
+        return await self._request(
+            "GET", f"{self.control_url}/v1/secrets")
+
+    async def delete_secret(self, name: str) -> Dict[str, Any]:
+        return await self._request(
+            "DELETE", f"{self.control_url}/v1/secrets/{name}")
+
+    async def set_gcs_credentials(self, credentials_file: str,
+                                  service_account: str = "default") -> str:
+        """Register a GCS key file (reference set_gcs_credentials)."""
+        from kfserving_tpu.client.creds import gcs_secret_payload
+
+        return await self.create_secret(
+            gcs_secret_payload(credentials_file),
+            service_account=service_account)
+
+    async def set_s3_credentials(self, credentials_file: str,
+                                 service_account: str = "default",
+                                 s3_profile: str = "default",
+                                 s3_endpoint: Optional[str] = None,
+                                 s3_region: Optional[str] = None,
+                                 s3_use_https: Optional[str] = None,
+                                 s3_verify_ssl: Optional[str] = None
+                                 ) -> str:
+        """Register AWS-CLI-format credentials (reference
+        set_s3_credentials; endpoint/region/SSL knobs become the same
+        secret annotations the builder consumes)."""
+        from kfserving_tpu.client.creds import s3_secret_payload
+
+        return await self.create_secret(
+            s3_secret_payload(credentials_file, s3_profile=s3_profile,
+                              s3_endpoint=s3_endpoint,
+                              s3_region=s3_region,
+                              s3_use_https=s3_use_https,
+                              s3_verify_ssl=s3_verify_ssl),
+            service_account=service_account)
+
+    async def set_azure_credentials(self, credentials_file: str,
+                                    service_account: str = "default"
+                                    ) -> str:
+        """Register an Azure service-principal JSON (reference
+        set_azure_credentials)."""
+        from kfserving_tpu.client.creds import azure_secret_payload
+
+        return await self.create_secret(
+            azure_secret_payload(credentials_file),
+            service_account=service_account)
+
 
 def isvc_spec(name: str, framework: str, storage_uri: str,
               namespace: str = "default", **predictor_kwargs

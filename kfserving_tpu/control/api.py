@@ -19,6 +19,16 @@ Routes:
     POST   /v1/trainedmodels
     GET    /v1/trainedmodels/{ns}/{name}
     DELETE /v1/trainedmodels/{ns}/{name}
+    GET    /v1/secrets                                metadata only, no data
+    POST   /v1/secrets                                create (+optional attach)
+    DELETE /v1/secrets/{name}
+    GET    /v1/serviceaccounts
+    POST   /v1/serviceaccounts/{name}/secrets         attach existing secret
+
+The secrets surface is the server side of the SDK's credential
+registration (reference python/kfserving/kfserving/api/creds_utils.py:
+create_secret + set_service_account against the K8s API); secret data is
+write-only — list/read endpoints never return it.
 """
 
 import json
@@ -57,9 +67,15 @@ def merge_patch(base: Dict[str, Any], patch: Dict[str, Any]
 
 
 class ControlAPI:
-    def __init__(self, controller: Controller, http_port: int = 0):
+    def __init__(self, controller: Controller, http_port: int = 0,
+                 credentials=None, credentials_path: Optional[str] = None):
         self.controller = controller
         self.http_port = http_port
+        # CredentialStore shared with the orchestrators; mutations via the
+        # secrets routes take effect on the next replica build and persist
+        # to credentials_path when configured.
+        self.credentials = credentials
+        self.credentials_path = credentials_path
         self.router = Router()
         self._register_routes()
         self.http_server = HTTPServer(self.router)
@@ -78,6 +94,12 @@ class ControlAPI:
         r.add("POST", "/v1/trainedmodels", self._apply_tm)
         r.add("GET", "/v1/trainedmodels/{ns}/{name}", self._get_tm)
         r.add("DELETE", "/v1/trainedmodels/{ns}/{name}", self._delete_tm)
+        r.add("GET", "/v1/secrets", self._list_secrets)
+        r.add("POST", "/v1/secrets", self._create_secret)
+        r.add("DELETE", "/v1/secrets/{name}", self._delete_secret)
+        r.add("GET", "/v1/serviceaccounts", self._list_service_accounts)
+        r.add("POST", "/v1/serviceaccounts/{name}/secrets",
+              self._attach_secret)
 
     async def start_async(self, host: str = "127.0.0.1"):
         await self.http_server.start(host, self.http_port)
@@ -195,3 +217,80 @@ class ControlAPI:
             return _err(f"trained model {ns}/{name} not found", 404)
         await self.controller.remove_trained_model(name, ns)
         return _json({"deleted": f"{ns}/{name}"})
+
+    # -- handlers: credentials ----------------------------------------------
+    def _persist_credentials(self) -> None:
+        if self.credentials_path:
+            self.credentials.save(self.credentials_path)
+
+    async def _list_secrets(self, req: Request) -> Response:
+        if self.credentials is None:
+            return _err("credential store not configured", 404)
+        items = [{"name": s.name, "type": s.type,
+                  "annotations": s.annotations}
+                 for s in self.credentials.secrets.values()]
+        return _json({"items": items})
+
+    async def _create_secret(self, req: Request) -> Response:
+        if self.credentials is None:
+            return _err("credential store not configured", 404)
+        try:
+            data = self._decode(req)
+            secret_type = data["type"]
+            if secret_type not in ("s3", "gcs", "azure", "https"):
+                raise ValidationError(
+                    f"unknown secret type {secret_type!r} "
+                    f"(s3 | gcs | azure | https)")
+            payload = data.get("data")
+            if not isinstance(payload, dict) or not payload:
+                raise ValidationError("secret 'data' must be a non-empty "
+                                      "JSON object")
+            name = self.credentials.add_secret(
+                secret_type, payload,
+                annotations=data.get("annotations"),
+                name=data.get("name"))
+            account = data.get("serviceAccount")
+            if account:
+                self.credentials.attach(account, name)
+            self._persist_credentials()
+        except (ValidationError, KeyError, TypeError) as e:
+            return _err(str(e), 422)
+        return _json({"name": name,
+                      "serviceAccount": account or None}, status=201)
+
+    async def _delete_secret(self, req: Request) -> Response:
+        if self.credentials is None:
+            return _err("credential store not configured", 404)
+        name = req.path_params["name"]
+        try:
+            self.credentials.remove_secret(name)
+        except KeyError:
+            return _err(f"secret {name} not found", 404)
+        self._persist_credentials()
+        return _json({"deleted": name})
+
+    async def _list_service_accounts(self, req: Request) -> Response:
+        if self.credentials is None:
+            return _err("credential store not configured", 404)
+        return _json({"serviceAccounts": {
+            k: list(v)
+            for k, v in self.credentials.service_accounts.items()}})
+
+    async def _attach_secret(self, req: Request) -> Response:
+        if self.credentials is None:
+            return _err("credential store not configured", 404)
+        account = req.path_params["name"]
+        try:
+            data = self._decode(req)
+            secret = data.get("secret")
+            if not isinstance(secret, str) or not secret:
+                raise ValidationError("body must carry a 'secret' name")
+            self.credentials.attach(account, secret)
+        except ValidationError as e:
+            return _err(str(e), 422)
+        except KeyError as e:
+            return _err(str(e), 404)
+        self._persist_credentials()
+        return _json({"serviceAccount": account,
+                      "secrets": list(
+                          self.credentials.service_accounts[account])})

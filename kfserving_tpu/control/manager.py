@@ -74,7 +74,10 @@ class ServingManager:
             target_concurrency=(
                 self.cluster_config.autoscaler.target_concurrency),
             tick_seconds=self.cluster_config.autoscaler.tick_seconds)
-        self.api = ControlAPI(self.controller, http_port=control_port)
+        self.api = ControlAPI(
+            self.controller, http_port=control_port,
+            credentials=credentials,
+            credentials_path=self.cluster_config.credentials.store_file)
         self.host = host
 
     # -- lifecycle ----------------------------------------------------------

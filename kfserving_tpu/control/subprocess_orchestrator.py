@@ -314,16 +314,31 @@ class SubprocessOrchestrator:
         logger.warning("recycling replica %s at %s: %s",
                        replica.component_id, replica.host, reason)
         handle: _Proc = replica.handle
-        if self.recycle.overlap:
-            await self.create_replica(
-                replica.component_id, replica.revision, handle.spec,
-                placement=replica.placement)
-            await self.delete_replica(replica)
-        else:
-            await self.delete_replica(replica)
-            await self.create_replica(
-                replica.component_id, replica.revision, handle.spec,
-                placement=replica.placement)
+        # Hold a create reservation across the WHOLE swap: in the
+        # overlap=False drain window (SIGTERM grace, up to TERM_GRACE_S)
+        # the replica is already out of state and the successor's create
+        # hasn't started, so without this the reconciler/autoscaler sees
+        # have < want and spawns its own replacement while the old
+        # process still owns the chip.
+        key = (replica.component_id, replica.revision)
+        self._creating[key] = self._creating.get(key, 0) + 1
+        try:
+            if self.recycle.overlap:
+                await self.create_replica(
+                    replica.component_id, replica.revision, handle.spec,
+                    placement=replica.placement)
+                await self.delete_replica(replica)
+            else:
+                await self.delete_replica(replica)
+                await self.create_replica(
+                    replica.component_id, replica.revision, handle.spec,
+                    placement=replica.placement)
+        finally:
+            n = self._creating.get(key, 1) - 1
+            if n <= 0:
+                self._creating.pop(key, None)
+            else:
+                self._creating[key] = n
         self.recycle_count += 1
 
     async def delete_replica(self, replica: Replica) -> None:

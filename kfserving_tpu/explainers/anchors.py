@@ -80,7 +80,10 @@ class AnchorSearch:
     def _bin_of(self, j: int, value: float) -> int:
         if j in self.categorical:
             return int(value)
-        return int(np.digitize(value, self.bin_edges[j]))
+        # right=True makes bins (lo, hi], agreeing with _predicate_mask
+        # and the "<=" rule text — a value sitting exactly on a quantile
+        # edge must land in the bin its own anchor covers.
+        return int(np.digitize(value, self.bin_edges[j], right=True))
 
     def _predicate_mask(self, j: int, b: int,
                         data: np.ndarray) -> np.ndarray:

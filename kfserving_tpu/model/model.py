@@ -18,7 +18,7 @@ import json
 from typing import Any, Dict, Optional
 
 from kfserving_tpu.protocol import cloudevents
-from kfserving_tpu.protocol.errors import InferenceError
+from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
 
 # URL formats, same as reference kfmodel.py:24-27.
 PREDICTOR_URL_FORMAT = "http://{0}/v1/models/{1}:predict"
@@ -115,15 +115,29 @@ class Model:
         """Unwrap CloudEvents payloads, else pass through.
 
         Same semantics as reference kfmodel.py:56-88: a binary CloudEvent's
-        data is JSON-decoded when possible; a structured CloudEvent dict is
-        unwrapped to its "data" member.
+        data is JSON-decoded when possible; if the event declares a JSON
+        content type but the body doesn't parse, that's a client error
+        (400, reference kfmodel.py:63-71); otherwise the raw bytes pass
+        through for the model to decode (e.g. avro payloads,
+        protocol/avro.py).  A structured CloudEvent dict is unwrapped to
+        its "data" member.
         """
         if isinstance(request, cloudevents.CloudEvent):
             data = request.data
             if isinstance(data, (bytes, bytearray)):
                 try:
                     return json.loads(data.decode("utf-8"))
-                except (ValueError, UnicodeDecodeError):
+                except (ValueError, UnicodeDecodeError) as e:
+                    ctype = request.attributes.get(
+                        "content-type",
+                        request.attributes.get("datacontenttype", ""))
+                    # Media type only — "application/json; charset=utf-8"
+                    # must still hit the 400 path.
+                    if ctype.split(";")[0].strip() in (
+                            "application/json",
+                            "application/cloudevents+json"):
+                        raise InvalidInput(
+                            f"Unrecognized request format: {e}")
                     return data
             return data
         if isinstance(request, dict):

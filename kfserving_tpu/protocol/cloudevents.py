@@ -63,11 +63,17 @@ def from_http(headers: Dict[str, str], body: bytes) -> CloudEvent:
         attrs = {k: v for k, v in envelope.items()
                  if k not in ("data", "data_base64")}
         return CloudEvent(attrs, data)
-    # binary mode
+    # binary mode: the content-type HTTP header carries the datacontenttype
+    # attribute (CE spec HTTP binding §3.1); keep a "content-type" alias for
+    # reference-SDK attribute parity (test_server.py:146-149 asserts both).
     attrs = {k[3:]: v for k, v in headers.items() if k.startswith("ce-")}
     missing = [a for a in REQUIRED_ATTRS if a not in attrs]
     if missing:
         raise ValueError(f"CloudEvent missing required fields: {missing}")
+    ctype = headers.get("content-type")
+    if ctype:
+        attrs.setdefault("datacontenttype", ctype)
+        attrs.setdefault("content-type", ctype)
     return CloudEvent(attrs, body)
 
 
@@ -85,8 +91,14 @@ def ce_time_now() -> str:
 
 
 def to_binary(event: CloudEvent) -> Tuple[Dict[str, str], bytes]:
-    headers = {f"ce-{k}": str(v) for k, v in event.attributes.items()}
+    headers = {f"ce-{k}": str(v) for k, v in event.attributes.items()
+               if k != "content-type"}
     headers["ce-time"] = ce_time_now()
+    # datacontenttype rides the plain content-type header too (CE HTTP
+    # binding; reference response asserts both, test_server.py:257-263).
+    dct = event.attributes.get("datacontenttype")
+    if dct:
+        headers["content-type"] = dct
     data = event.data
     if isinstance(data, bytes):
         body = data

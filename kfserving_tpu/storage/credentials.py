@@ -84,6 +84,10 @@ class CredentialStore:
              ) -> "CredentialStore":
         if not path:
             return cls(gcs_file_name=gcs_file_name)
+        if not os.path.exists(path):
+            # A configured-but-absent store starts empty; the first
+            # client-side registration creates it (control/api.py).
+            return cls(gcs_file_name=gcs_file_name)
         with open(path) as f:
             data = json.load(f)
         return cls.from_dict(data, gcs_file_name=gcs_file_name)
@@ -102,6 +106,60 @@ class CredentialStore:
         return cls(service_accounts=dict(
                        data.get("serviceAccounts") or {}),
                    secrets=secrets, gcs_file_name=gcs_file_name)
+
+    # -- registration (SDK creds_utils server side) -------------------------
+    def add_secret(self, type: str, data: Dict,
+                   annotations: Optional[Dict[str, str]] = None,
+                   name: Optional[str] = None) -> str:
+        """Create-or-replace a secret; generates a name when none given
+        (reference creds_utils.create_secret uses generateName
+        'kfserving-secret-', api/creds_utils.py:144-167)."""
+        if not name:
+            n = len(self.secrets)
+            while f"kfserving-secret-{n}" in self.secrets:
+                n += 1
+            name = f"kfserving-secret-{n}"
+        self.secrets[name] = Secret(name=name, type=type, data=dict(data),
+                                    annotations=dict(annotations or {}))
+        return name
+
+    def attach(self, service_account: str, secret_name: str) -> None:
+        """Attach a secret to a service account, creating the account if
+        absent (reference set_service_account create-or-patch,
+        api/creds_utils.py:170-180)."""
+        if secret_name not in self.secrets:
+            raise KeyError(f"secret {secret_name!r} not found")
+        attached = self.service_accounts.setdefault(service_account, [])
+        if secret_name not in attached:
+            attached.append(secret_name)
+
+    def remove_secret(self, name: str) -> None:
+        if name not in self.secrets:
+            raise KeyError(f"secret {name!r} not found")
+        del self.secrets[name]
+        for attached in self.service_accounts.values():
+            if name in attached:
+                attached.remove(name)
+
+    def to_dict(self) -> Dict:
+        return {
+            "serviceAccounts": {k: list(v)
+                                for k, v in self.service_accounts.items()},
+            "secrets": {
+                name: {"type": s.type, "data": s.data,
+                       "annotations": s.annotations}
+                for name, s in self.secrets.items()
+            },
+        }
+
+    def save(self, path: str) -> None:
+        """Persist the store (atomic replace; the file holds live
+        credentials, so 0600 like the GCS key file)."""
+        tmp = f"{path}.tmp"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(self.to_dict(), f, indent=2)
+        os.replace(tmp, path)
 
     # -- builder (CreateSecretVolumeAndEnv equivalent) ----------------------
     def build_env(self, service_account: str = "default"

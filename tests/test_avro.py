@@ -1,0 +1,212 @@
+"""Avro binary codec + avro-CloudEvents serving parity.
+
+Mirrors the reference's avro CE coverage (reference
+python/kfserving/test/test_server.py:143-314: TestTFHttpServerAvroCloudEvent
+with the example.avro User schema, and the bad-format 400 paths at :283-305)
+using the in-tree codec (protocol/avro.py) instead of the avro library.
+"""
+
+import json
+
+import pytest
+
+from kfserving_tpu import Model
+from kfserving_tpu.protocol import avro
+from tests.utils import http_request, running_server
+
+USER_SCHEMA = """
+{
+  "namespace": "example.avro",
+  "type": "record",
+  "name": "User",
+  "fields": [
+    {"name": "name", "type": "string"},
+    {"name": "favorite_number", "type": ["int", "null"]},
+    {"name": "favorite_color", "type": ["string", "null"]}
+  ]
+}
+"""
+
+
+# -- codec unit tests -------------------------------------------------------
+
+def test_roundtrip_record_with_unions():
+    msg = {"name": "foo", "favorite_number": 1, "favorite_color": "pink"}
+    payload = avro.encode(msg, USER_SCHEMA)
+    assert avro.decode(payload, USER_SCHEMA) == msg
+
+
+def test_roundtrip_null_union_branches():
+    msg = {"name": "bar", "favorite_number": None, "favorite_color": None}
+    payload = avro.encode(msg, USER_SCHEMA)
+    assert avro.decode(payload, USER_SCHEMA) == msg
+
+
+def test_known_wire_bytes():
+    """Pin the wire format: zigzag varints + length-prefixed strings.
+
+    "foo" -> len 3 (zigzag 0x06) + bytes; union branch 0 (0x00) then
+    int 1 (zigzag 0x02); branch 0 then "pink" (len 4 -> 0x08).
+    """
+    msg = {"name": "foo", "favorite_number": 1, "favorite_color": "pink"}
+    assert avro.encode(msg, USER_SCHEMA) == \
+        b"\x06foo\x00\x02\x00\x08pink"
+
+
+@pytest.mark.parametrize("value,schema", [
+    (True, "boolean"),
+    (False, "boolean"),
+    (-1234567890123, "long"),
+    (0, "int"),
+    (1.5, "double"),
+    (b"\x00\xff", "bytes"),
+    ("ünicode", "string"),
+    (None, "null"),
+])
+def test_roundtrip_primitives(value, schema):
+    assert avro.decode(avro.encode(value, schema), schema) == value
+
+
+def test_roundtrip_float32():
+    out = avro.decode(avro.encode(0.25, "float"), "float")
+    assert out == 0.25
+
+
+def test_roundtrip_array_map_enum_fixed():
+    schema = {
+        "type": "record", "name": "Blob", "fields": [
+            {"name": "xs", "type": {"type": "array", "items": "long"}},
+            {"name": "kv", "type": {"type": "map", "values": "string"}},
+            {"name": "mood", "type": {"type": "enum", "name": "Mood",
+                                      "symbols": ["HAPPY", "SAD"]}},
+            {"name": "mac", "type": {"type": "fixed", "name": "Mac",
+                                     "size": 4}},
+        ],
+    }
+    msg = {"xs": [1, -2, 300], "kv": {"a": "x", "b": "y"},
+           "mood": "SAD", "mac": b"\x01\x02\x03\x04"}
+    assert avro.decode(avro.encode(msg, schema), schema) == msg
+
+
+def test_nested_record_and_named_reference():
+    schema = {
+        "type": "record", "name": "Outer", "fields": [
+            {"name": "child", "type": {
+                "type": "record", "name": "Inner", "fields": [
+                    {"name": "v", "type": "long"}]}},
+            {"name": "other", "type": "Inner"},
+        ],
+    }
+    msg = {"child": {"v": 7}, "other": {"v": -9}}
+    assert avro.decode(avro.encode(msg, schema), schema) == msg
+
+
+def test_truncated_payload_rejected():
+    payload = avro.encode({"name": "foo", "favorite_number": 1,
+                           "favorite_color": "pink"}, USER_SCHEMA)
+    with pytest.raises(ValueError):
+        avro.decode(payload[:-2], USER_SCHEMA)
+
+
+def test_empty_array_and_map():
+    schema = {"type": "record", "name": "E", "fields": [
+        {"name": "xs", "type": {"type": "array", "items": "int"}},
+        {"name": "kv", "type": {"type": "map", "values": "int"}}]}
+    msg = {"xs": [], "kv": {}}
+    assert avro.decode(avro.encode(msg, schema), schema) == msg
+
+
+# -- serving parity ---------------------------------------------------------
+
+class AvroCEModel(Model):
+    """Reference DummyAvroCEModel analogue: decodes avro bytes in predict
+    (test_server.py:83-113)."""
+
+    def load(self):
+        self.ready = True
+        return self.ready
+
+    async def predict(self, request):
+        record = avro.decode(request, USER_SCHEMA)
+        return {"predictions": [[record["name"], record["favorite_number"],
+                                 record["favorite_color"]]]}
+
+
+def _ce_headers(content_type=None):
+    headers = {
+        "ce-specversion": "1.0",
+        "ce-id": "36077800-0c23-4f38-a0b4-01f4369f670a",
+        "ce-source": "https://example.com/event-producer",
+        "ce-type": "com.example.sampletype1",
+    }
+    if content_type:
+        headers["content-type"] = content_type
+    return headers
+
+
+async def test_predict_ce_avro_binary():
+    """Avro-encoded binary CE flows through to the model as raw bytes
+    (reference test_server.py:306-314 contract)."""
+    model = AvroCEModel("TestModel")
+    model.load()
+    msg = {"name": "foo", "favorite_number": 1, "favorite_color": "pink"}
+    body = avro.encode(msg, USER_SCHEMA)
+    async with running_server([model]) as server:
+        status, resp_headers, resp = await http_request(
+            server.http_port, "POST", "/v1/models/TestModel:predict",
+            body, _ce_headers("application/x-www-form-urlencoded"))
+    assert status == 200
+    out = json.loads(resp)
+    assert out["predictions"] == [["foo", 1, "pink"]]
+    assert resp_headers["ce-specversion"] == "1.0"
+    assert resp_headers["ce-id"] == "36077800-0c23-4f38-a0b4-01f4369f670a"
+    assert resp_headers["ce-datacontenttype"] == \
+        "application/x-www-form-urlencoded"
+    assert resp_headers["content-type"] == "application/x-www-form-urlencoded"
+
+
+class EchoModel(Model):
+    def load(self):
+        self.ready = True
+        return self.ready
+
+    async def predict(self, request):
+        return {"predictions": request["instances"]}
+
+
+async def test_predict_ce_bytes_bad_format_400():
+    """JSON content-type + unparseable body -> 400, matching the reference
+    (test_server.py:283-293)."""
+    model = EchoModel("TestModel")
+    model.load()
+    async with running_server([model]) as server:
+        status, _, resp = await http_request(
+            server.http_port, "POST", "/v1/models/TestModel:predict",
+            b"{", _ce_headers("application/json"))
+    assert status == 400
+    assert b"Unrecognized request format" in resp
+
+
+async def test_predict_ce_bytes_bad_hex_format_400():
+    model = EchoModel("TestModel")
+    model.load()
+    async with running_server([model]) as server:
+        status, _, resp = await http_request(
+            server.http_port, "POST", "/v1/models/TestModel:predict",
+            b"0\x80\x80\x06World!\x00\x00", _ce_headers("application/json"))
+    assert status == 400
+    assert b"Unrecognized request format" in resp
+
+
+async def test_predict_ce_non_json_content_type_passthrough_unharmed():
+    """Without a JSON content type, undecodable bytes are the model's
+    problem, not a 400 (the avro path depends on this)."""
+    model = AvroCEModel("TestModel")
+    model.load()
+    msg = {"name": "z", "favorite_number": None, "favorite_color": None}
+    async with running_server([model]) as server:
+        status, _, resp = await http_request(
+            server.http_port, "POST", "/v1/models/TestModel:predict",
+            avro.encode(msg, USER_SCHEMA), _ce_headers())
+    assert status == 200
+    assert json.loads(resp)["predictions"] == [["z", None, None]]

@@ -424,3 +424,43 @@ async def test_subprocess_recycle_min_age_prevents_thrash(tmp_path):
         assert replica.handle.process.returncode is None
     finally:
         await orch.shutdown()
+
+
+async def test_recycle_drain_window_counts_as_pending_create():
+    """During an overlap=False recycle the old process's SIGTERM drain
+    must read as an in-flight create: otherwise a reconciler tick in
+    that window sees have=0 and double-spawns onto the chip the dying
+    process still owns."""
+    from kfserving_tpu.control.orchestrator import Replica
+    from kfserving_tpu.control.subprocess_orchestrator import (
+        RecyclePolicy,
+    )
+
+    orch = SubprocessOrchestrator(
+        recycle=RecyclePolicy(overlap=False, min_age_s=0.0))
+    cid, rev = "default/drain/predictor", "rev1"
+    pending_during = {}
+
+    class FakeHandle:
+        spec = PredictorSpec(framework="sklearn", storage_uri="/x")
+
+    replica = Replica(component_id=cid, revision=rev,
+                      host="127.0.0.1:1", handle=FakeHandle())
+
+    async def fake_delete(rep):
+        # mid-drain: what would a concurrent reconciler tick see?
+        pending_during["drain"] = orch.pending_creates(cid, rev)
+        await asyncio.sleep(0)
+
+    async def fake_create(cid_, rev_, spec_, placement=None):
+        pending_during["create"] = orch.pending_creates(cid_, rev_)
+        return replica
+
+    orch.delete_replica = fake_delete
+    orch.create_replica = fake_create
+    await orch._recycle_replica(replica, "test")
+    # the swap held a reservation through the drain...
+    assert pending_during["drain"] >= 1
+    # ...and released it when done
+    assert orch.pending_creates(cid, rev) == 0
+    assert orch.recycle_count == 1

@@ -458,3 +458,133 @@ def test_redirect_strips_auth_cross_host(tmp_path, monkeypatch):
     finally:
         origin.shutdown()
         cdn.shutdown()
+
+
+# -- client-side registration (reference api/creds_utils.py) ----------------
+
+def _aws_ini(tmp_path, profile="default"):
+    path = tmp_path / "aws_credentials"
+    path.write_text(
+        f"[{profile}]\n"
+        "aws_access_key_id = AKIDCLIENT\n"
+        "aws_secret_access_key = SKCLIENT\n")
+    return str(path)
+
+
+async def test_client_registers_credentials_end_to_end(tmp_path):
+    """set_s3/gcs/azure_credentials through the SDK -> control API ->
+    CredentialStore -> persisted store file -> replica env (the reference
+    splits this between creds_utils and the controller's builder)."""
+    from kfserving_tpu.client import KFServingClient
+    from kfserving_tpu.control.clusterconfig import ClusterConfig
+    from kfserving_tpu.control.manager import ServingManager
+
+    store_file = tmp_path / "credstore.json"
+    cfg = ClusterConfig.load(None)
+    cfg.credentials.store_file = str(store_file)
+    manager = ServingManager(cluster_config=cfg, orchestrator="inprocess",
+                             control_port=0, ingress_port=0)
+    await manager.start_async()
+    try:
+        async with KFServingClient(
+                f"http://127.0.0.1:{manager.api.http_port}") as client:
+            s3_name = await client.set_s3_credentials(
+                _aws_ini(tmp_path), s3_endpoint="minio.local:9000",
+                s3_use_https="0", s3_region="us-east-1")
+            assert s3_name == "kfserving-secret-0"
+
+            gcs_file = tmp_path / "gcloud.json"
+            gcs_file.write_text(json.dumps(
+                {"type": "service_account", "project_id": "p9"}))
+            gcs_name = await client.set_gcs_credentials(str(gcs_file))
+
+            az_file = tmp_path / "azure.json"
+            az_file.write_text(json.dumps(
+                {"clientId": "c9", "clientSecret": "s9",
+                 "subscriptionId": "sub9", "tenantId": "t9",
+                 "activeDirectoryEndpointUrl": "ignored"}))
+            az_name = await client.set_azure_credentials(
+                str(az_file), service_account="team-b")
+
+            # list never returns secret data
+            listing = await client.list_secrets()
+            names = {s["name"] for s in listing["items"]}
+            assert {s3_name, gcs_name, az_name} <= names
+            assert all("data" not in s for s in listing["items"])
+
+            # live store feeds the orchestrator's replica env immediately
+            env = manager.orchestrator.credentials.build_env("default")
+            assert env["AWS_ACCESS_KEY_ID"] == "AKIDCLIENT"
+            assert env["S3_ENDPOINT"] == "minio.local:9000"
+            assert env["GOOGLE_APPLICATION_CREDENTIALS"].endswith(
+                "gcloud-application-credentials.json")
+            env_b = manager.orchestrator.credentials.build_env("team-b")
+            assert env_b["AZ_CLIENT_ID"] == "c9"
+            assert "AWS_ACCESS_KEY_ID" not in env_b
+
+            # persisted with private perms; a fresh manager reloads it
+            assert store_file.exists()
+            assert os.stat(store_file).st_mode & 0o777 == 0o600
+            reloaded = CredentialStore.load(str(store_file))
+            assert reloaded.build_env("default")[
+                "AWS_SECRET_ACCESS_KEY"] == "SKCLIENT"
+
+            # attach an existing secret to a second account
+            await client.attach_secret("team-b", s3_name)
+            assert "AWS_ACCESS_KEY_ID" in \
+                manager.orchestrator.credentials.build_env("team-b")
+
+            # delete detaches everywhere and persists
+            await client.delete_secret(s3_name)
+            assert "AWS_ACCESS_KEY_ID" not in \
+                manager.orchestrator.credentials.build_env("default")
+            assert s3_name not in json.loads(
+                store_file.read_text())["secrets"]
+    finally:
+        await manager.stop_async()
+
+
+async def test_secret_validation_errors(tmp_path):
+    from kfserving_tpu.client import ClientError, KFServingClient
+    from kfserving_tpu.control.manager import ServingManager
+
+    manager = ServingManager(orchestrator="inprocess",
+                             control_port=0, ingress_port=0)
+    await manager.start_async()
+    try:
+        async with KFServingClient(
+                f"http://127.0.0.1:{manager.api.http_port}") as client:
+            with pytest.raises(ClientError) as exc:
+                await client.create_secret(
+                    {"type": "ftp", "data": {"x": "y"}})
+            assert exc.value.status == 422
+            with pytest.raises(ClientError) as exc:
+                await client.create_secret({"type": "s3", "data": {}})
+            assert exc.value.status == 422
+            with pytest.raises(ClientError) as exc:
+                await client.attach_secret("default", "nope")
+            assert exc.value.status == 404
+            with pytest.raises(ClientError) as exc:
+                await client.delete_secret("nope")
+            assert exc.value.status == 404
+    finally:
+        await manager.stop_async()
+
+
+def test_s3_payload_reads_named_profile(tmp_path):
+    from kfserving_tpu.client.creds import s3_secret_payload
+
+    payload = s3_secret_payload(_aws_ini(tmp_path, profile="prod"),
+                                s3_profile="prod", s3_verify_ssl="0")
+    assert payload["data"]["accessKeyId"] == "AKIDCLIENT"
+    assert payload["annotations"][
+        "serving.kfserving.io/s3-verifyssl"] == "0"
+
+
+def test_gcs_payload_rejects_non_json(tmp_path):
+    from kfserving_tpu.client.creds import gcs_secret_payload
+
+    bad = tmp_path / "notjson.txt"
+    bad.write_text("not a key file")
+    with pytest.raises(ValueError):
+        gcs_secret_payload(str(bad))
