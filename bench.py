@@ -57,6 +57,8 @@ def main():
         "multimodel": C.bench_multimodel,
         "chain": C.bench_chain,
         "longctx": C.bench_longctx,
+        "overload": C.bench_overload,
+        "bert_flash_ab": C.bench_bert_flash_ab,
     }
     results = {}
     for name, fn in matrix.items():

@@ -63,6 +63,7 @@ async def closed_loop(port: int, path: str, body: bytes,
 
     latencies: List[float] = []
     errors = 0
+    shed = 0
     first_error: Optional[str] = None
     sem = asyncio.Semaphore(concurrency)
     url = f"http://{host}:{port}{path}"
@@ -72,13 +73,24 @@ async def closed_loop(port: int, path: str, body: bytes,
             timeout=aiohttp.ClientTimeout(total=120)) as session:
 
         async def one():
-            nonlocal errors, first_error
+            nonlocal errors, shed, first_error
             async with sem:
                 t0 = time.perf_counter()
                 try:
                     async with session.post(
                             url, data=body, headers=headers) as resp:
                         payload = await resp.read()
+                        if resp.status == 503 and \
+                                b"concurrency limit" in payload:
+                            # Admission-gate shedding (server/app.py
+                            # "concurrency limit exceeded") is load
+                            # management, not failure: count it apart so
+                            # goodput-vs-shed is visible (reference
+                            # queue-proxy analysis, README.md:131-135).
+                            # Other 503s (no replicas / upstream down)
+                            # stay errors with first_error set.
+                            shed += 1
+                            return
                         if resp.status != 200:
                             errors += 1
                             if first_error is None:
@@ -95,21 +107,32 @@ async def closed_loop(port: int, path: str, body: bytes,
         t0 = time.perf_counter()
         await asyncio.gather(*[one() for _ in range(num_requests)])
         wall = time.perf_counter() - t0
-    return summarize(latencies, wall, errors, first_error)
+    out = summarize(latencies, wall, errors, first_error)
+    if shed:
+        out["shed"] = shed
+        out["shed_rate"] = shed / max(1, num_requests)
+        out["requests"] = len(latencies) + errors + shed
+        out["success_rate"] = len(latencies) / max(1, num_requests)
+    return out
 
 
 async def open_loop(port: int, path: str,
                     body_fn: Callable[[int], bytes],
                     rate_qps: float, duration_s: float,
                     host: str = "127.0.0.1",
-                    headers: Optional[Dict[str, str]] = None
+                    headers: Optional[Dict[str, str]] = None,
+                    label_fn: Optional[Callable[[int], str]] = None
                     ) -> Dict[str, Any]:
     """Vegeta-style fixed-rate attack: request i fires at t0 + i/rate
     regardless of outstanding requests (open loop — queueing shows up
-    as latency, exactly like the reference tables)."""
+    as latency, exactly like the reference tables).
+
+    label_fn classifies request i (e.g. by sequence-length class) so
+    mixed-traffic runs report per-class latency in out["by_label"]."""
     import aiohttp
 
     latencies: List[float] = []
+    by_label: Dict[str, List[float]] = {}
     errors = 0
     first_error: Optional[str] = None
     total = max(1, int(rate_qps * duration_s))
@@ -137,7 +160,10 @@ async def open_loop(port: int, path: str,
                 if first_error is None:
                     first_error = f"{type(exc).__name__}: {exc}"
                 return
-            latencies.append((time.perf_counter() - t0) * 1000.0)
+            dt = (time.perf_counter() - t0) * 1000.0
+            latencies.append(dt)
+            if label_fn is not None:
+                by_label.setdefault(label_fn(i), []).append(dt)
 
         start = time.perf_counter()
         tasks = []
@@ -151,6 +177,15 @@ async def open_loop(port: int, path: str,
         wall = time.perf_counter() - start
     out = summarize(latencies, wall, errors, first_error)
     out["rate_qps"] = rate_qps
+    if by_label:
+        out["by_label"] = {
+            label: {
+                "requests": len(vals),
+                "mean_ms": round(statistics.fmean(vals), 3),
+                "p50_ms": round(percentile(sorted(vals), 0.50), 3),
+                "p99_ms": round(percentile(sorted(vals), 0.99), 3),
+            }
+            for label, vals in sorted(by_label.items())}
     return out
 
 

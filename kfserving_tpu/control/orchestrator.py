@@ -84,6 +84,12 @@ class InProcessOrchestrator:
         # interleave across service accounts (shared os.environ).
         self._cred_lock = asyncio.Lock()
         self.state: Dict[str, _ComponentState] = {}
+        # Cluster-local gateway address ("host:port"), published by the
+        # ingress router at start.  Explainer/transformer replicas get
+        # their predictor_host derived from it — the reference injects
+        # the predictor's cluster-local URL into those containers
+        # (explainer_alibi.go:79-100 --predictor_host).
+        self.cluster_local_url: Optional[str] = None
 
     def replicas(self, component_id: str) -> List[Replica]:
         return list(self.state.get(component_id,
@@ -121,6 +127,7 @@ class InProcessOrchestrator:
             if model is not None and not model.ready:
                 loop = asyncio.get_running_loop()
                 await loop.run_in_executor(None, model.load)
+        self._inject_predictor_host(model, spec)
         server = ModelServer(
             http_port=0, enable_docs=False,
             container_concurrency=getattr(
@@ -135,6 +142,24 @@ class InProcessOrchestrator:
         logger.info("replica up: %s rev=%s at %s",
                     component_id, revision[:8], replica.host)
         return replica
+
+    def _inject_predictor_host(self, model, spec) -> None:
+        """Point an explainer/transformer replica's model at the isvc's
+        predictor through the router's direct lane (the reference's
+        cluster-local predictor URL, kfmodel.py:24-27)."""
+        from kfserving_tpu.control.spec import (
+            ExplainerSpec,
+            TransformerSpec,
+        )
+
+        if model is None or self.cluster_local_url is None:
+            return
+        if not isinstance(spec, (ExplainerSpec, TransformerSpec)):
+            return
+        if getattr(model, "predictor_host", None):
+            return  # explicitly configured wins
+        model.predictor_host = \
+            f"{self.cluster_local_url}/direct/predictor"
 
     async def delete_replica(self, replica: Replica) -> None:
         comp = self.state.get(replica.component_id)
@@ -208,6 +233,14 @@ def default_model_factory(component_id: str, spec):
             from kfserving_tpu.explainers import AnchorTabular
 
             return AnchorTabular(isvc_name, spec.storage_uri)
+        if spec.explainer_type == "lime_images":
+            from kfserving_tpu.explainers import LimeImages
+
+            return LimeImages(isvc_name, spec.storage_uri)
+        if spec.explainer_type == "square_attack":
+            from kfserving_tpu.explainers import AdversarialRobustness
+
+            return AdversarialRobustness(isvc_name, spec.storage_uri)
         from kfserving_tpu.explainers import SaliencyExplainer
 
         return SaliencyExplainer(isvc_name, spec.storage_uri)

@@ -73,6 +73,15 @@ class IngressRouter:
             connector=aiohttp.TCPConnector(force_close=True))
         await self.http_server.start(host, self.http_port)
         self.http_port = self.http_server.port
+        # Publish the cluster-local gateway address: explainer and
+        # transformer replicas built after this point get predictor_host
+        # injected (orchestrator._inject_predictor_host; subprocess
+        # replicas see it as KFS_CLUSTER_LOCAL_URL).  Overwrite
+        # unconditionally — a router restart binds a new ephemeral port
+        # and a stale address would point new replicas at a dead socket.
+        orch = self.controller.reconciler.orchestrator
+        if hasattr(orch, "cluster_local_url"):
+            orch.cluster_local_url = f"{host}:{self.http_port}"
 
     async def stop_async(self):
         if self._session is not None:

@@ -99,7 +99,8 @@ class TransformerSpec(ComponentSpec):
 
 @dataclass
 class ExplainerSpec(ComponentSpec):
-    explainer_type: str = "saliency"  # saliency | blackbox | custom
+    # saliency | anchor_tabular | lime_images | square_attack | custom
+    explainer_type: str = "saliency"
     storage_uri: str = ""
     command: Optional[List[str]] = None
 

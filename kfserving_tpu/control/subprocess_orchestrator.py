@@ -116,6 +116,10 @@ class SubprocessOrchestrator:
         # process per TPU).
         self._creating: Dict[tuple, int] = {}
         self.state: Dict[str, _ComponentState] = {}
+        # Cluster-local gateway address, published by the ingress router
+        # at start (router.py start_async); replicas get it as
+        # KFS_CLUSTER_LOCAL_URL.
+        self.cluster_local_url: Optional[str] = None
 
     def pending_creates(self, component_id: str, revision: str) -> int:
         return self._creating.get((component_id, revision), 0)
@@ -182,6 +186,11 @@ class SubprocessOrchestrator:
             # Slice discovery env — the TPU analogue of the reference's
             # injected nodeSelector (accelerator_injector.go:38-44).
             env.update(placement.env())
+        if self.cluster_local_url:
+            # Custom explainer/transformer commands reach the predictor
+            # through the gateway's direct lane (the reference injects
+            # --predictor_host into those containers).
+            env["KFS_CLUSTER_LOCAL_URL"] = self.cluster_local_url
         env.update(self.env_overrides)
         logger.info("spawning replica %s rev=%s: %s",
                     component_id, revision[:8], " ".join(argv))

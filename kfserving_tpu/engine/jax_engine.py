@@ -140,6 +140,11 @@ class JaxEngine:
         self.fetch_ms_total = 0.0     # device -> host slice
         self.flops_total = 0.0
         self._flops_by_bucket: Dict[Any, float] = {}
+        # Per-(batch,seq)-bucket execution counts + padded-slot waste:
+        # which compiled programs traffic actually lands on (seq-bucket
+        # coverage is a bench deliverable, BASELINE config #3).
+        self._bucket_hits: Dict[Any, int] = {}
+        self._bucket_waste: Dict[Any, float] = {}
         self._explicit_transfer = _params_on_single_device(jax, params)
         self._peak_flops = device_peak_flops()
         # One host<->device synchronization per batch, not two: the result
@@ -236,6 +241,11 @@ class JaxEngine:
                 self.fetch_ms_total += (t3 - t2) * 1e3
                 self.flops_total += self._flops_by_bucket.get(
                     flops_key, 0.0)
+                self._bucket_hits[flops_key] = \
+                    self._bucket_hits.get(flops_key, 0) + 1
+                self._bucket_waste[flops_key] = \
+                    self._bucket_waste.get(flops_key, 0.0) \
+                    + (bucket - n) / bucket
         return result
 
     async def predict(self, inputs: Any) -> Any:
@@ -370,4 +380,13 @@ class JaxEngine:
                 out["achieved_tflops"] = achieved / 1e12
                 if self._peak_flops:
                     out["mfu"] = achieved / self._peak_flops
+            if self._bucket_hits:
+                out["bucket_hits"] = {
+                    (f"b{b}" if s is None else f"b{b}s{s}"): hits
+                    for (b, s), hits in sorted(self._bucket_hits.items())}
+                out["bucket_pad_waste"] = {
+                    (f"b{b}" if s is None else f"b{b}s{s}"):
+                        round(waste / self._bucket_hits[key], 4)
+                    for key, waste in sorted(self._bucket_waste.items())
+                    for b, s in [key]}
         return out

@@ -10,9 +10,17 @@ slower than predicts.  A black-box (predictor_host-proxying) explainer is
 also provided for parity with the reference's deployment shape.
 """
 
+from kfserving_tpu.explainers.adversarial import (  # noqa: F401
+    AdversarialRobustness,
+    SquareAttack,
+)
 from kfserving_tpu.explainers.anchors import (  # noqa: F401
     AnchorSearch,
     AnchorTabular,
 )
 from kfserving_tpu.explainers.fairness import FairnessExplainer  # noqa: F401
+from kfserving_tpu.explainers.lime import (  # noqa: F401
+    LimeImages,
+    LimeImageSearch,
+)
 from kfserving_tpu.explainers.saliency import SaliencyExplainer  # noqa: F401

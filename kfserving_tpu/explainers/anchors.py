@@ -25,7 +25,6 @@ Differences from alibi, by design:
   (serving-grade simplicity; the confirm batch is 5x the search batch).
 """
 
-import asyncio
 import inspect
 import json
 import logging
@@ -34,7 +33,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from kfserving_tpu.model.model import Model
+from kfserving_tpu.explainers.proxy import PredictorProxyModel
 from kfserving_tpu.protocol import v1
 from kfserving_tpu.protocol.errors import InvalidInput
 
@@ -221,7 +220,7 @@ class AnchorSearch:
         }
 
 
-class AnchorTabular(Model):
+class AnchorTabular(PredictorProxyModel):
     """Served anchors explainer: sits on `:explain` and proxies model
     calls to the predictor (the alibiexplainer deployment shape:
     explainer.py:66-76 builds predict_fn from predictor_host).
@@ -236,22 +235,18 @@ class AnchorTabular(Model):
     def __init__(self, name: str, model_dir: str,
                  predictor_host: Optional[str] = None,
                  predict_fn: Optional[Callable] = None):
-        super().__init__(name)
+        super().__init__(name, predictor_host=predictor_host,
+                         predict_fn=predict_fn)
         self.model_dir = model_dir
-        self.predictor_host = predictor_host
-        self._predict_fn = predict_fn
         self.search: Optional[AnchorSearch] = None
         self.config: Dict[str, Any] = {}
 
     def load(self) -> bool:
-        from kfserving_tpu.storage import Storage
-
-        local = Storage.download(self.model_dir)
-        cfg_path = os.path.join(local, "anchors.json")
-        self.config = {}
-        if os.path.exists(cfg_path):
-            with open(cfg_path) as f:
-                self.config = json.load(f)
+        local, self.config = self._load_artifact_dir(self.model_dir,
+                                                     "anchors.json")
+        if local is None:
+            raise InvalidInput(
+                "anchors explainer needs a storage_uri with train.npy")
         train_path = os.path.join(local, "train.npy")
         if not os.path.exists(train_path):
             raise InvalidInput(
@@ -266,22 +261,6 @@ class AnchorTabular(Model):
             seed=int(self.config.get("seed", 0)))
         self.ready = True
         return True
-
-    async def _proxied_predict(self, batch: np.ndarray) -> np.ndarray:
-        if self._predict_fn is not None:
-            out = self._predict_fn(batch)
-            if inspect.isawaitable(out):
-                out = await out
-            return np.asarray(out)
-        if not self.predictor_host:
-            raise InvalidInput(
-                f"explainer {self.name} has no predictor_host")
-        resp = await super().predict(
-            {"instances": np.asarray(batch).tolist()})
-        if "predictions" not in resp:
-            raise InvalidInput(
-                "predictor response has no 'predictions' key")
-        return np.asarray(resp["predictions"])
 
     async def explain(self, request: Any) -> Any:
         if self.search is None:

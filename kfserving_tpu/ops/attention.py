@@ -15,6 +15,7 @@ keeps the running softmax in VMEM.
 
 import functools
 import logging
+import os
 from typing import Optional
 
 import jax
@@ -58,7 +59,10 @@ def _tpu_backend() -> bool:
 def _flash_eligible(q: jax.Array) -> bool:
     """Shape/backend gate for the fused kernel.  Mask handling is the
     dispatcher's job: suffix key padding rides the kernel as kv_lengths
-    (non-causal only); every other mask pattern serves via XLA."""
+    (non-causal only); every other mask pattern serves via XLA.
+    KFS_DISABLE_FLASH=1 forces the XLA path (A/B benchmarking)."""
+    if os.getenv("KFS_DISABLE_FLASH", "") not in ("", "0", "false"):
+        return False
     if not _tpu_backend():
         return False
     _, L, _, D = q.shape

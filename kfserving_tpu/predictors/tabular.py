@@ -19,6 +19,8 @@ import numpy as np
 from kfserving_tpu.model.model import Model
 from kfserving_tpu.protocol import v1
 from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
+from kfserving_tpu.protocol.v2 import InferRequest
+from kfserving_tpu.protocol.v2 import make_response as v2_make_response
 from kfserving_tpu.storage import Storage
 
 logger = logging.getLogger("kfserving_tpu.predictors.tabular")
@@ -76,15 +78,23 @@ class TabularModel(Model):
             return await super().predict(request)
         if self._model is None:
             raise InferenceError(f"model {self.name} not loaded")
+        if isinstance(request, InferRequest) or (
+                isinstance(request, dict)
+                and isinstance(request.get("inputs"), list)
+                and request["inputs"]
+                and isinstance(request["inputs"][0], dict)
+                and "datatype" in request["inputs"][0]):
+            # V2 (incl. the binary tensor extension): the reference's V2
+            # sklearn/xgb path is MLServer speaking the same protocol
+            # (predictor_sklearn.go:98-143); single-tensor requests map
+            # straight onto the batch-predict hook.
+            return self._predict_v2(request)
         instances = v1.get_instances(request)
         try:
             batch = np.asarray(instances)
         except Exception as e:
             raise InvalidInput(f"failed to build batch array: {e}")
-        try:
-            result = self._predict_batch(batch)
-        except Exception as e:
-            raise InferenceError(f"Failed to predict: {e}")
+        result = self._run(batch)
         if isinstance(result, np.ndarray):
             payload = result.tolist()
         else:
@@ -93,3 +103,24 @@ class TabularModel(Model):
             payload = [r.tolist() if isinstance(r, np.ndarray) else r
                        for r in result]
         return v1.make_response(payload)
+
+    def _predict_v2(self, request: Any) -> Any:
+        req = (request if isinstance(request, InferRequest)
+               else InferRequest.from_dict(request))
+        named = req.named_numpy()
+        if len(named) != 1:
+            raise InvalidInput(
+                f"tabular predictor takes one input tensor, got "
+                f"{sorted(named)}")
+        batch = next(iter(named.values()))
+        result = self._run(batch)
+        outputs = (result if isinstance(result, np.ndarray)
+                   else np.asarray(result))
+        return v2_make_response(self.name, {"output_0": outputs},
+                                id=req.id)
+
+    def _run(self, batch: np.ndarray) -> Any:
+        try:
+            return self._predict_batch(batch)
+        except Exception as e:
+            raise InferenceError(f"Failed to predict: {e}")

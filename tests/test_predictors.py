@@ -381,3 +381,44 @@ def test_fairness_explainer_scores_via_predictor(tmp_path):
     out = asyncio.run(run())
     assert out["predictions"] == [1, 1]
     assert out["metrics"]["num_instances"] == 2
+
+
+async def test_sklearn_v2_infer_json_and_binary(tmp_path):
+    """Tabular predictors speak V2 (the reference's V2 sklearn path is
+    MLServer on the same protocol, predictor_sklearn.go:98-143) — both
+    JSON tensors and the binary extension, which the explainers' proxy
+    binary hop relies on."""
+    import json
+
+    import joblib
+    from sklearn import datasets, svm
+
+    from kfserving_tpu.predictors.sklearnserver import SKLearnModel
+    from kfserving_tpu.protocol import v2 as v2proto
+    from tests.utils import http_json, http_request, running_server
+
+    X, y = datasets.load_iris(return_X_y=True)
+    clf = svm.SVC(gamma="scale").fit(X, y)
+    model_dir = tmp_path / "iris"
+    model_dir.mkdir()
+    joblib.dump(clf, str(model_dir / "model.joblib"))
+    model = SKLearnModel("iris", str(model_dir))
+    model.load()
+    rows = np.array([[6.8, 2.8, 4.8, 1.4], [5.1, 3.5, 1.4, 0.2]])
+    async with running_server([model]) as server:
+        # V2 JSON tensors
+        status, body = await http_json(
+            server.http_port, "POST", "/v2/models/iris/infer",
+            {"inputs": [{"name": "input_0", "datatype": "FP64",
+                         "shape": [2, 4],
+                         "data": rows.ravel().tolist()}]})
+        assert status == 200, body
+        assert body["outputs"][0]["data"] == [1, 0]
+        # V2 binary extension (raw tensor bytes)
+        bin_body, hlen = v2proto.make_binary_request({"input_0": rows})
+        status, _, payload = await http_request(
+            server.http_port, "POST", "/v2/models/iris/infer", bin_body,
+            {"Inference-Header-Content-Length": str(hlen)})
+        assert status == 200, payload
+        out = json.loads(payload)
+        assert out["outputs"][0]["data"] == [1, 0]
