@@ -229,21 +229,10 @@ def default_model_factory(component_id: str, spec):
             f"in-process orchestrator cannot run framework "
             f"{spec.framework!r}")
     if isinstance(spec, ExplainerSpec):
-        if spec.explainer_type == "anchor_tabular":
-            from kfserving_tpu.explainers import AnchorTabular
+        from kfserving_tpu.explainers import build_explainer
 
-            return AnchorTabular(isvc_name, spec.storage_uri)
-        if spec.explainer_type == "lime_images":
-            from kfserving_tpu.explainers import LimeImages
-
-            return LimeImages(isvc_name, spec.storage_uri)
-        if spec.explainer_type == "square_attack":
-            from kfserving_tpu.explainers import AdversarialRobustness
-
-            return AdversarialRobustness(isvc_name, spec.storage_uri)
-        from kfserving_tpu.explainers import SaliencyExplainer
-
-        return SaliencyExplainer(isvc_name, spec.storage_uri)
+        return build_explainer(isvc_name, spec.explainer_type,
+                               spec.storage_uri)
     if isinstance(spec, TransformerSpec):
         raise ValueError(
             "transformer replicas need a custom model_factory (their "

@@ -140,6 +140,31 @@ class SubprocessOrchestrator:
         if isinstance(spec, (TransformerSpec, ExplainerSpec)) and \
                 getattr(spec, "command", None):
             return list(spec.command) + ["--http_port", str(port)]
+        if isinstance(spec, ExplainerSpec):
+            # In-tree explainer types run via the standalone explainer
+            # server (the reference's per-explainer binaries,
+            # alibiexplainer/__main__.py); predictor_host arrives via
+            # the injected KFS_CLUSTER_LOCAL_URL.  Unknown types must
+            # fail HERE with a clear error — the child's stderr goes to
+            # DEVNULL, so an argparse rejection would surface only as
+            # an opaque readiness failure.
+            from kfserving_tpu.explainers import EXPLAINER_TYPES
+
+            if spec.explainer_type not in EXPLAINER_TYPES:
+                raise ValueError(
+                    f"explainer_type {spec.explainer_type!r} needs an "
+                    f"explicit command under the subprocess "
+                    f"orchestrator (in-tree: {list(EXPLAINER_TYPES)})")
+            argv = [sys.executable, "-m", "kfserving_tpu.explainers",
+                    "--model_name", isvc_name,
+                    "--explainer_type", spec.explainer_type,
+                    "--http_port", str(port)]
+            if spec.storage_uri:
+                argv += ["--storage_uri", spec.storage_uri]
+            if spec.container_concurrency:
+                argv += ["--container_concurrency",
+                         str(spec.container_concurrency)]
+            return argv
         if isinstance(spec, PredictorSpec):
             if spec.framework == "custom":
                 if not spec.command:

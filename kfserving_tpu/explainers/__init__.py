@@ -10,6 +10,8 @@ slower than predicts.  A black-box (predictor_host-proxying) explainer is
 also provided for parity with the reference's deployment shape.
 """
 
+from typing import Optional
+
 from kfserving_tpu.explainers.adversarial import (  # noqa: F401
     AdversarialRobustness,
     SquareAttack,
@@ -24,3 +26,32 @@ from kfserving_tpu.explainers.lime import (  # noqa: F401
     LimeImageSearch,
 )
 from kfserving_tpu.explainers.saliency import SaliencyExplainer  # noqa: F401
+
+# One dispatch table for every deployment shape: the in-process
+# orchestrator factory, the standalone explainer server (__main__), and
+# the subprocess command builder all resolve types here.
+EXPLAINER_TYPES = ("saliency", "anchor_tabular", "lime_images",
+                   "square_attack")
+
+
+def build_explainer(name: str, explainer_type: str,
+                    storage_uri: str = "",
+                    predictor_host: Optional[str] = None):
+    """Instantiate an in-tree explainer by type name."""
+    if explainer_type == "anchor_tabular":
+        return AnchorTabular(name, storage_uri,
+                             predictor_host=predictor_host)
+    if explainer_type == "lime_images":
+        return LimeImages(name, storage_uri,
+                          predictor_host=predictor_host)
+    if explainer_type == "square_attack":
+        return AdversarialRobustness(name, storage_uri,
+                                     predictor_host=predictor_host)
+    if explainer_type == "saliency":
+        model = SaliencyExplainer(name, storage_uri)
+        if predictor_host:
+            model.predictor_host = predictor_host
+        return model
+    raise ValueError(
+        f"unknown explainer_type {explainer_type!r} "
+        f"(one of {list(EXPLAINER_TYPES)}, or set an explicit command)")

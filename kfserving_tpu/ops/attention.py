@@ -24,8 +24,15 @@ import jax.numpy as jnp
 logger = logging.getLogger("kfserving_tpu.ops")
 
 # Pallas TPU kernels need the lane dimension (head_dim) to be a multiple of
-# 128 and benefit only past this sequence length.
-_FLASH_MIN_SEQ = 512
+# 128 and benefit only past a sequence length that depends on lane fill.
+# Measured on v5e (fori-chain device timing, B=8 H=12 D=64, 90%-full
+# suffix padding): at L=512 XLA is 3.1x FASTER than the kernel (0.13 vs
+# 0.42 ms/step — a half-lane head dim wastes the MXU and XLA's fused
+# softmax is excellent while the score tensor is small); the kernel wins
+# from L~1024 (1.5x) and dominates at long context (57x at L=8192 where
+# XLA materializes [B,H,L,L] scores).
+_FLASH_MIN_SEQ = 512        # full-lane head dims (D % 128 == 0)
+_FLASH_MIN_SEQ_HALF_LANE = 1024  # D % 128 != 0 pads the lane width
 # Head dims in multiples of 64 are flash-eligible: D=64 pads the
 # 128-lane width but measured 34 TF/s on v5e; smaller head dims waste
 # more than half the array and fall back to XLA.
@@ -66,7 +73,11 @@ def _flash_eligible(q: jax.Array) -> bool:
     if not _tpu_backend():
         return False
     _, L, _, D = q.shape
-    return L >= _FLASH_MIN_SEQ and D % _FLASH_HEAD_DIM_MULTIPLE == 0
+    if D % _FLASH_HEAD_DIM_MULTIPLE != 0:
+        return False
+    min_seq = (_FLASH_MIN_SEQ if D % 128 == 0
+               else _FLASH_MIN_SEQ_HALF_LANE)
+    return L >= min_seq
 
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,

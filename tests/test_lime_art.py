@@ -273,3 +273,54 @@ async def test_square_attack_high_label_never_observed():
     # already "misclassified" w.r.t. label 2; reported without crashing
     assert out["prediction"] in (0, 1)
     assert out["success"]
+
+
+async def test_subprocess_explainer_replica(tmp_path):
+    """ExplainerSpec without a custom command runs as a real subprocess
+    replica (`python -m kfserving_tpu.explainers`), finding the
+    predictor through the injected KFS_CLUSTER_LOCAL_URL (the
+    reference's per-explainer server binaries + --predictor_host)."""
+    import joblib
+    from sklearn import linear_model
+
+    from kfserving_tpu.client import KFServingClient
+    from kfserving_tpu.control.manager import ServingManager
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-1, 1, size=(256, 8))
+    y = (X.sum(axis=1) > 0).astype(int)
+    clf = linear_model.LogisticRegression(max_iter=500).fit(X, y)
+    pred_dir = tmp_path / "pred"
+    pred_dir.mkdir()
+    joblib.dump(clf, str(pred_dir / "model.joblib"))
+    exp_dir = tmp_path / "exp"
+    exp_dir.mkdir()
+    (exp_dir / "art.json").write_text(json.dumps(
+        {"eps": 1.0, "max_iter": 60}))
+
+    manager = ServingManager(orchestrator="subprocess",
+                             control_port=0, ingress_port=0)
+    manager.orchestrator.env_overrides = {"JAX_PLATFORMS": "cpu"}
+    await manager.start_async()
+    try:
+        async with KFServingClient(
+                f"http://127.0.0.1:{manager.api.http_port}",
+                f"http://127.0.0.1:{manager.router.http_port}") as client:
+            await client.create({
+                "name": "tab",
+                "predictor": {"framework": "sklearn",
+                              "storage_uri": str(pred_dir)},
+                "explainer": {"explainer_type": "square_attack",
+                              "storage_uri": str(exp_dir)}})
+            await client.wait_isvc_ready("tab")
+            replicas = manager.orchestrator.replicas(
+                "default/tab/explainer")
+            assert len(replicas) == 1  # a real separate process
+            assert replicas[0].handle.process.pid
+            out = await client.explain(
+                "tab", {"instances": [np.full(8, 0.02).tolist(), 1]})
+            exp = out["explanations"]
+            assert exp["prediction"] == 1
+            assert exp["success"] and exp["adversarial_prediction"] == 0
+    finally:
+        await manager.stop_async()
