@@ -99,7 +99,8 @@ class TransformerSpec(ComponentSpec):
 
 @dataclass
 class ExplainerSpec(ComponentSpec):
-    # saliency | anchor_tabular | lime_images | square_attack | custom
+    # saliency | anchor_tabular | lime_images | square_attack |
+    # fairness | custom (custom needs `command`)
     explainer_type: str = "saliency"
     storage_uri: str = ""
     command: Optional[List[str]] = None

@@ -155,6 +155,14 @@ class SubprocessOrchestrator:
                     f"explainer_type {spec.explainer_type!r} needs an "
                     f"explicit command under the subprocess "
                     f"orchestrator (in-tree: {list(EXPLAINER_TYPES)})")
+            if spec.explainer_type in ("saliency", "fairness") and \
+                    not spec.storage_uri:
+                # These types require an artifact dir (saliency loads a
+                # jax model, fairness its group config); without one the
+                # child dies in Storage.download with stderr discarded.
+                raise ValueError(
+                    f"{spec.explainer_type} explainer needs a "
+                    f"storage_uri")
             argv = [sys.executable, "-m", "kfserving_tpu.explainers",
                     "--model_name", isvc_name,
                     "--explainer_type", spec.explainer_type,

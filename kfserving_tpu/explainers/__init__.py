@@ -31,13 +31,38 @@ from kfserving_tpu.explainers.saliency import SaliencyExplainer  # noqa: F401
 # orchestrator factory, the standalone explainer server (__main__), and
 # the subprocess command builder all resolve types here.
 EXPLAINER_TYPES = ("saliency", "anchor_tabular", "lime_images",
-                   "square_attack")
+                   "square_attack", "fairness")
 
 
 def build_explainer(name: str, explainer_type: str,
                     storage_uri: str = "",
                     predictor_host: Optional[str] = None):
     """Instantiate an in-tree explainer by type name."""
+    if explainer_type == "fairness":
+        # The reference aifserver takes group definitions as CLI JSON
+        # args (aifserver/model.py:25-50); here they live in the
+        # artifact dir like every other explainer config.
+        import json
+        import os
+
+        from kfserving_tpu.storage import Storage
+
+        if not storage_uri:
+            raise ValueError(
+                "fairness explainer needs a storage_uri containing "
+                "fairness.json (feature_names + group definitions)")
+        local = Storage.download(storage_uri)
+        with open(os.path.join(local, "fairness.json")) as f:
+            cfg = json.load(f)
+        return FairnessExplainer(
+            name,
+            feature_names=cfg["feature_names"],
+            privileged_groups=cfg["privileged_groups"],
+            unprivileged_groups=cfg["unprivileged_groups"],
+            favorable_label=cfg.get("favorable_label", 1.0),
+            unfavorable_label=cfg.get("unfavorable_label", 0.0),
+            n_neighbors=int(cfg.get("n_neighbors", 5)),
+            predictor_host=predictor_host)
     if explainer_type == "anchor_tabular":
         return AnchorTabular(name, storage_uri,
                              predictor_host=predictor_host)

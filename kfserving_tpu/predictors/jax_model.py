@@ -216,6 +216,7 @@ class JaxModel(Model):
 
         mesh_cfg = MeshConfig(**{k: int(v) for k, v in cfg.mesh.items()
                                  if k in ("dp", "tp", "sp")})
+        mesh = None
         if mesh_cfg.num_devices > 1:
             mesh = build_mesh(mesh_cfg)
             with mesh:
@@ -223,6 +224,29 @@ class JaxModel(Model):
                     **variables,
                     "params": shard_params(variables["params"], mesh),
                 }
+        if mesh is not None and mesh_cfg.sp > 1:
+            # Sequence parallelism: rebuild the serving module with ring
+            # attention closed over the mesh (models/bert.py attn_fn
+            # hook; parameters are attention-impl-independent, so the
+            # restored checkpoint applies unchanged).  Architectures
+            # without a pluggable attention can't shard the sequence
+            # axis — fail at load, not silently serve unsharded.
+            from kfserving_tpu.models import create_model
+            from kfserving_tpu.parallel.ring_attention import (
+                ring_attention_sharded,
+            )
+
+            try:
+                spec = create_model(
+                    cfg.architecture,
+                    attn_fn=ring_attention_sharded(mesh),
+                    **cfg.arch_kwargs)
+            except TypeError as e:
+                raise InvalidInput(
+                    f"architecture {cfg.architecture!r} does not "
+                    f"support sequence parallelism (no pluggable "
+                    f"attention hook): {e}")
+            self._spec = spec
 
         base_apply = apply_fn_for(spec)
         self._base_apply = base_apply

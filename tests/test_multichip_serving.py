@@ -80,10 +80,14 @@ async def _predict_http(port: int, model: str, ids: np.ndarray):
                               np.float32)
 
 
-@pytest.mark.parametrize("mesh", [{"tp": 2}, {"dp": 2, "tp": 2}])
+@pytest.mark.parametrize("mesh", [{"tp": 2}, {"dp": 2, "tp": 2},
+                                  {"sp": 2}, {"dp": 2, "sp": 2}])
 async def test_mesh_sharded_model_serves_with_parity(tmp_path, mesh):
     """A config-mesh JaxModel serves through ModelServer with numeric
-    parity against the unsharded model (same seed-0 init)."""
+    parity against the unsharded model (same seed-0 init).  sp meshes
+    serve with ring attention injected into the model's attn_fn hook
+    (jax_model._build_engine), so parity here proves the sequence-
+    parallel serving path end-to-end, not just the kernel."""
     from kfserving_tpu.predictors.jax_model import JaxModel
     from kfserving_tpu.server.app import ModelServer
 
@@ -163,3 +167,36 @@ async def test_spec_parallelism_reaches_served_engine(tmp_path):
     finally:
         await router.stop_async()
         await orch.shutdown()
+
+
+async def test_sp_mesh_injects_ring_attention(tmp_path):
+    """The sp path swaps the serving module's attention for the
+    ring-sharded closure — observable via the module config hook."""
+    from kfserving_tpu.predictors.jax_model import JaxModel
+
+    model = JaxModel("sp", _write_model_dir(tmp_path, mesh={"sp": 2},
+                                            name="sp"))
+    model.load()
+    try:
+        attn = model._spec.module.config.attn_fn
+        assert attn is not None and callable(attn)
+    finally:
+        model.unload()
+
+
+async def test_sp_mesh_rejects_non_pluggable_arch(tmp_path):
+    """sp>1 on an architecture without an attention hook must fail at
+    load with a clear error, never silently serve unsharded."""
+    from kfserving_tpu.predictors.jax_model import JaxModel
+    from kfserving_tpu.protocol.errors import InvalidInput
+
+    d = tmp_path / "mlp"
+    d.mkdir()
+    (d / "config.json").write_text(json.dumps({
+        "architecture": "mlp",
+        "arch_kwargs": {"input_dim": 8, "features": [16],
+                        "num_classes": 4},
+        "mesh": {"sp": 2}, "warmup": False}))
+    model = JaxModel("m", str(d))
+    with pytest.raises(InvalidInput, match="sequence parallelism"):
+        model.load()

@@ -422,3 +422,38 @@ async def test_sklearn_v2_infer_json_and_binary(tmp_path):
         assert status == 200, payload
         out = json.loads(payload)
         assert out["outputs"][0]["data"] == [1, 0]
+
+
+def test_fairness_explainer_deployable_from_artifact(tmp_path):
+    """explainer_type=fairness builds from a fairness.json artifact
+    through the shared factory (the reference aifserver passes the
+    group definitions as CLI args; here they live in the artifact)."""
+    import json as _json
+
+    from kfserving_tpu.explainers import (
+        FairnessExplainer,
+        build_explainer,
+    )
+
+    d = tmp_path / "fair"
+    d.mkdir()
+    (d / "fairness.json").write_text(_json.dumps({
+        "feature_names": ["age", "income"],
+        "privileged_groups": [{"age": 1}],
+        "unprivileged_groups": [{"age": 0}],
+    }))
+    ex = build_explainer("fair", "fairness", str(d))
+    assert isinstance(ex, FairnessExplainer)
+    X = [[1, 10], [1, 20], [1, 30], [0, 10], [0, 20], [0, 30]]
+
+    async def run():
+        return await ex.explain(
+            {"instances": X, "outputs": [1, 1, 0, 1, 0, 0]})
+
+    out = asyncio.run(run())
+    assert out["metrics"]["disparate_impact"] == pytest.approx(0.5)
+
+    with pytest.raises(ValueError, match="storage_uri"):
+        build_explainer("fair", "fairness", "")
+    with pytest.raises(ValueError, match="unknown explainer_type"):
+        build_explainer("x", "nope", "")
