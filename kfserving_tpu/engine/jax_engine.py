@@ -145,6 +145,8 @@ class JaxEngine:
         # coverage is a bench deliverable, BASELINE config #3).
         self._bucket_hits: Dict[Any, int] = {}
         self._bucket_waste: Dict[Any, float] = {}
+        self._slots_total = 0
+        self._padded_slots_total = 0
         self._explicit_transfer = _params_on_single_device(jax, params)
         self._peak_flops = device_peak_flops()
         # One host<->device synchronization per batch, not two: the result
@@ -246,6 +248,8 @@ class JaxEngine:
                 self._bucket_waste[flops_key] = \
                     self._bucket_waste.get(flops_key, 0.0) \
                     + (bucket - n) / bucket
+                self._slots_total += bucket
+                self._padded_slots_total += bucket - n
         return result
 
     async def predict(self, inputs: Any) -> Any:
@@ -362,6 +366,13 @@ class JaxEngine:
                 "last_execute_ms": self.last_execute_ms,
                 "avg_pad_waste": (self.padded_waste_total / n
                                   if n else 0.0),
+                # Slot-weighted companion: fraction of executed batch
+                # SLOTS that were padding.  The unweighted mean above
+                # over-counts small deadline flushes (a half-empty b4
+                # and a half-empty b128 average the same there).
+                "slot_pad_waste": (
+                    self._padded_slots_total / self._slots_total
+                    if self._slots_total else 0.0),
                 "avg_prepare_ms": self.prepare_ms_total / n if n else 0.0,
                 "avg_device_ms": self.device_ms_total / n if n else 0.0,
                 "avg_fetch_ms": self.fetch_ms_total / n if n else 0.0,
