@@ -102,7 +102,7 @@ async def bench_resnet(smoke: bool) -> Dict[str, Any]:
             "resnet50", max_batch_size=128,
             # Finer ladder + the batcher's bucket-aligned flushing keep
             # executed batches exactly bucket-sized (round-2 misaligned
-            # flushes padded 62% of slots).  The 4/8 floor buckets
+            # flushes averaged 62% padding per batch, unweighted).  The 4/8 floor buckets
             # catch deadline flushes of a few stragglers that would
             # otherwise pad a b16 program half-empty — device FLOPs are
             # ~3% of wall here, but the padding metric should measure

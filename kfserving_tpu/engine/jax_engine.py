@@ -299,6 +299,16 @@ class JaxEngine:
                 self.compile_count += 1
                 self._record_flops(b, batch)
         dt = time.perf_counter() - start
+        # Warmup executes exactly-full batches of every program; leaving
+        # them in the traffic counters would report phantom bucket hits
+        # and dilute slot_pad_waste toward 0 on short runs.  Timing /
+        # MFU totals keep warmup (pre-existing semantics); the
+        # batching-quality counters restart at zero.
+        with self._stats_lock:
+            self._bucket_hits.clear()
+            self._bucket_waste.clear()
+            self._slots_total = 0
+            self._padded_slots_total = 0
         logger.info("warmup compiled %d batch x %d seq buckets in %.1fs",
                     len(batch_buckets), len(seq_buckets), dt)
         return dt
