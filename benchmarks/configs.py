@@ -196,10 +196,16 @@ async def bench_overload(smoke: bool) -> Dict[str, Any]:
     body = np_json_body("instances", image[None])
     out: Dict[str, Any] = {"concurrency": conc,
                            "container_concurrency": cc}
+    # Queue sized so admitted ~= client concurrency: shedding exercises
+    # the gate's edge without a 503 retry-storm — the closed-loop client
+    # SHARES the host core with the server, so a deep shed rate turns
+    # the bench into a core-thrash measurement (queue=cc/2 measured
+    # goodput 31.8 vs 53.4 gateless purely from rejected-request churn;
+    # queue=cc measured the real effect: 75.5 vs 55.2 with 7.3% shed).
     for mode, server_kwargs in (
             ("gateless", {}),
             ("admission", {"container_concurrency": cc,
-                           "max_queue_depth": cc // 2})):
+                           "max_queue_depth": cc})):
         model_dir = _write_jax_model_dir(arch_args[0], arch_args[1],
                                          **model_cfg)
         model = JaxModel("resnet", model_dir)
@@ -271,7 +277,11 @@ async def bench_bert(smoke: bool) -> Dict[str, Any]:
     # 128-token instance for bert-base's 30k vocab).
     model_dir = _write_jax_model_dir(
         arch, {}, max_batch_size=8 if smoke else 16,
-        batch_buckets=[8] if smoke else [4, 16],
+        # b1 floor: mixed-length traffic splits across 5 seq buckets,
+        # so per-bucket arrival is sparse and deadline flushes are often
+        # singletons — padding them to 4 slots showed 35-47% waste on
+        # the b4 programs.  3 batch x 5 seq = 15 warmup compiles.
+        batch_buckets=[8] if smoke else [1, 4, 16],
         max_latency_ms=5.0, warmup=True, seq_buckets=seq_buckets,
         output="topk", topk=5)
     model = JaxModel("bert", model_dir)
@@ -446,8 +456,13 @@ async def bench_bert_flash_ab(smoke: bool) -> Dict[str, Any]:
                 if good else None,
                 "req_per_s_median": round(_stats.median(
                     r["req_per_s"] for r in good), 2) if good else None,
-                "avg_device_ms": round(
-                    stats.get("avg_device_ms", 0.0), 3),
+                # device+fetch SUM: on the tunneled backend
+                # block_until_ready is a dispatch ack (ROOFLINE "MFU
+                # accounting" traps), so device_ms alone is queue
+                # pressure; only the fetch joins the device timeline.
+                "avg_sync_ms": round(
+                    stats.get("avg_device_ms", 0.0)
+                    + stats.get("avg_fetch_ms", 0.0), 3),
                 "errors": sum(r["errors"] for r in lat[mode]),
             }
             first_errors = [r["first_error"] for r in lat[mode]
@@ -456,10 +471,9 @@ async def bench_bert_flash_ab(smoke: bool) -> Dict[str, Any]:
                 out[mode]["first_error"] = first_errors[0]
     finally:
         await server.stop_async()
-    if out["flash"]["avg_device_ms"] and out["xla"]["avg_device_ms"]:
-        out["xla_over_flash_device"] = round(
-            out["xla"]["avg_device_ms"] / out["flash"]["avg_device_ms"],
-            3)
+    if out["flash"]["avg_sync_ms"] and out["xla"]["avg_sync_ms"]:
+        out["xla_over_flash_sync"] = round(
+            out["xla"]["avg_sync_ms"] / out["flash"]["avg_sync_ms"], 3)
     if out["flash"]["p50_ms_median"] and out["xla"]["p50_ms_median"]:
         out["xla_over_flash_p50"] = round(
             out["xla"]["p50_ms_median"] / out["flash"]["p50_ms_median"],

@@ -122,6 +122,37 @@ class IngressRouter:
         self._rr[cid] = idx + 1
         return replicas[idx % len(replicas)].host
 
+    async def _replica_alive(self, host: str) -> bool:
+        """Quick liveness probe (the server's `/` route) deciding
+        whether a mid-request failure came from a dead process or a
+        transient glitch on a live one.  Only a refused/unroutable
+        connection means dead; a probe TIMEOUT is indeterminate (a
+        tabular replica chewing a multi-second batch on its event loop
+        can't answer) and must classify as alive — evicting a busy
+        replica would duplicate its in-flight inference and destroy
+        healthy capacity, the exact mistakes the timeout branch of
+        _proxy refuses to make."""
+        try:
+            async with self._session.get(
+                    f"http://{host}/",
+                    timeout=aiohttp.ClientTimeout(total=2.0)) as resp:
+                return resp.status < 500
+        except (aiohttp.ClientConnectorError, ConnectionRefusedError,
+                OSError):
+            return False
+        except Exception:
+            return True
+
+    async def _mark_failed_and_evict(self, name: str, cname: str,
+                                     host: str, failed: set) -> None:
+        """Shared failure bookkeeping for the retry loop: exclude the
+        host from further attempts and evict its replica."""
+        failed.add(host)
+        isvc = self.controller.get(name)
+        if isvc is not None:
+            cid = self.controller.reconciler.component_id(isvc, cname)
+            await self._evict_replica(cid, host)
+
     async def _evict_replica(self, cid: str, host: str) -> None:
         """Drop a replica whose transport failed (crashed process) so
         rotation skips it; the reconciler/autoscaler recreates capacity
@@ -289,24 +320,31 @@ class IngressRouter:
                     # never retried.
                     logger.warning("proxy to %s failed (attempt %d): %s",
                                    url, attempt + 1, e)
-                    failed.add(host)
-                    isvc = self.controller.get(name)
-                    if isvc is not None:
-                        cid = self.controller.reconciler.component_id(
-                            isvc, cname)
-                        await self._evict_replica(cid, host)
+                    await self._mark_failed_and_evict(
+                        name, cname, host, failed)
                 except aiohttp.ClientError as e:
                     # Mid-request/-response failure (reset after
-                    # dispatch, truncated read): the upstream may have
-                    # executed the inference, so neither retry (would
-                    # duplicate work) nor evict (possibly transient,
-                    # e.g. one dropped keep-alive socket) — surface a
-                    # 502 like the timeout case surfaces 504.
+                    # dispatch, truncated read).  Disambiguate with a
+                    # liveness probe: a replica that just DIED (crash /
+                    # SIGKILL lands here as ECONNRESET when the kill
+                    # races an in-flight connect) cannot have returned a
+                    # response, so evicting and retrying is safe — the
+                    # kubelet-restart role this fabric owns (SURVEY
+                    # §5.3).  A replica that still answers its liveness
+                    # route had a genuine mid-request glitch: neither
+                    # retry (would duplicate inference) nor evict.
                     logger.warning("proxy to %s failed mid-request: %s",
                                    url, e)
-                    return Response(
-                        body=b'{"error": "upstream connection failed"}',
-                        status=502)
+                    if await self._replica_alive(host):
+                        return Response(
+                            body=b'{"error": "upstream connection '
+                                 b'failed"}',
+                            status=502)
+                    logger.warning(
+                        "replica %s dead after mid-request failure: "
+                        "evicting and retrying", host)
+                    await self._mark_failed_and_evict(
+                        name, cname, host, failed)
             return Response(
                 body=b'{"error": "upstream unavailable"}', status=503)
         finally:

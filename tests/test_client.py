@@ -464,3 +464,69 @@ async def test_recycle_drain_window_counts_as_pending_create():
     # ...and released it when done
     assert orch.pending_creates(cid, rev) == 0
     assert orch.recycle_count == 1
+
+
+async def test_replica_crash_failover_and_respawn(tmp_path):
+    """Chaos: SIGKILL a live subprocess replica under concurrent load.
+    The router must evict it and fail over (no client sees the crash as
+    anything but a retried success), and the autoscaler tick must
+    restore min_replicas with a fresh process (the reference delegates
+    this to kubelet restart + readiness gates, SURVEY §5.3; this fabric
+    owns the whole loop)."""
+    import signal as _signal
+
+    from kfserving_tpu.control.clusterconfig import ClusterConfig
+
+    artifact = str(tmp_path / "iris")
+    _write_sklearn_artifact(artifact)
+    cfg = ClusterConfig.load(None)
+    cfg.autoscaler.tick_seconds = 0.3
+    manager = ServingManager(cluster_config=cfg,
+                             orchestrator="subprocess",
+                             control_port=0, ingress_port=0)
+    manager.orchestrator.env_overrides = {"JAX_PLATFORMS": "cpu"}
+    await manager.start_async()
+    try:
+        async with KFServingClient(
+                f"http://127.0.0.1:{manager.api.http_port}",
+                f"http://127.0.0.1:{manager.router.http_port}") as client:
+            await client.create(isvc_spec(
+                "chaos", "sklearn", f"file://{artifact}",
+                min_replicas=2, max_replicas=2))
+            await client.wait_isvc_ready("chaos")
+            cid = "default/chaos/predictor"
+            replicas = manager.orchestrator.replicas(cid)
+            assert len(replicas) == 2
+            victim = replicas[0]
+            victim_pid = victim.handle.process.pid
+
+            async def hammer(n):
+                ok = 0
+                for _ in range(n):
+                    r = await client.predict(
+                        "chaos", {"instances": IRIS_ROWS})
+                    assert r == {"predictions": [1, 1]}
+                    ok += 1
+                return ok
+
+            # load before, kill mid-stream, load after
+            assert await hammer(4) == 4
+            os.kill(victim_pid, _signal.SIGKILL)
+            # every request during the outage still succeeds (router
+            # evicts the dead replica pre-dispatch and retries)
+            assert await hammer(12) == 12
+            # autoscaler restores min_replicas with a NEW process
+            for _ in range(100):
+                reps = manager.orchestrator.replicas(cid)
+                live = [r for r in reps
+                        if r.handle.process.returncode is None]
+                if len(live) == 2:
+                    break
+                await asyncio.sleep(0.3)
+            live = [r for r in manager.orchestrator.replicas(cid)
+                    if r.handle.process.returncode is None]
+            assert len(live) == 2, "min_replicas not restored"
+            assert all(r.handle.process.pid != victim_pid for r in live)
+            assert await hammer(4) == 4
+    finally:
+        await manager.stop_async()

@@ -537,10 +537,13 @@ async def test_router_timeout_does_not_evict(tmp_path):
 
 
 async def test_router_mid_response_failure_no_retry_no_evict(tmp_path):
-    """A connection that drops AFTER dispatch (mid-response) must not be
-    retried (the upstream may have executed the inference — a retry
+    """A connection that drops AFTER dispatch (mid-response) on a
+    replica that is still ALIVE (answers its liveness route) must not
+    be retried (the upstream may have executed the inference — a retry
     would duplicate work) and must not evict the replica (possibly one
-    transient socket): the client gets 502 (ADVICE r2 router.py:260)."""
+    transient socket): the client gets 502 (ADVICE r2 router.py:260).
+    A replica whose liveness probe also fails is dead and IS evicted +
+    retried — covered by test_replica_crash_failover_and_respawn."""
     from kfserving_tpu import Model
 
     hits = {"n": 0}
@@ -561,12 +564,20 @@ async def test_router_mid_response_failure_no_retry_no_evict(tmp_path):
     router = IngressRouter(controller)
     await router.start_async()
 
-    # A raw socket listener that reads the request then slams the
-    # connection shut: aiohttp surfaces ServerDisconnectedError (a
-    # ClientError that is NOT ClientConnectorError).
+    # A raw socket listener that answers the liveness route (so the
+    # router classifies it alive) but slams predict connections shut
+    # after reading the request: aiohttp surfaces
+    # ServerDisconnectedError (a ClientError that is NOT
+    # ClientConnectorError).
     async def slam(reader, writer):
+        head = await reader.read(1024)
+        if head.startswith(b"GET / "):
+            writer.write(b"HTTP/1.1 200 OK\r\ncontent-length: 5\r\n"
+                         b"connection: close\r\n\r\nAlive")
+            await writer.drain()
+            writer.close()
+            return
         hits["n"] += 1
-        await reader.read(1024)
         writer.close()
 
     slam_server = await asyncio.start_server(slam, "127.0.0.1", 0)
