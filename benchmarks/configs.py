@@ -282,6 +282,11 @@ async def bench_bert(smoke: bool) -> Dict[str, Any]:
         # singletons — padding them to 4 slots showed 35-47% waste on
         # the b4 programs.  3 batch x 5 seq = 15 warmup compiles.
         batch_buckets=[8] if smoke else [1, 4, 16],
+        # pipeline_depth stays at the default 2: measured depth 3 at
+        # this concurrency left throughput flat (129.7 vs 128-145
+        # req/s) and worsened p99 (426 vs 275 ms) — BERT here is
+        # client-concurrency/latency-capped, not RTT-serialization-
+        # bound like the 151KB-per-request ResNet wire.
         max_latency_ms=5.0, warmup=True, seq_buckets=seq_buckets,
         output="topk", topk=5)
     model = JaxModel("bert", model_dir)

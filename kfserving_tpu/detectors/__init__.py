@@ -1,0 +1,32 @@
+"""Payload detectors: outlier + drift monitoring on served traffic.
+
+The reference ships these as alibi-detect samples fed by the payload
+logger over Knative eventing (reference docs/samples/outlier-detection/
+alibi-detect/cifar10).  Here they are first-party Models: deploy one as
+a standalone server (`python -m kfserving_tpu.detectors`) and point an
+InferenceService's `logger.url` at it — every mirrored request payload
+gets scored as it is served.
+"""
+
+from kfserving_tpu.detectors.drift import (  # noqa: F401
+    KSDriftDetector,
+    ks_p_value,
+    ks_statistic,
+)
+from kfserving_tpu.detectors.outlier import (  # noqa: F401
+    MahalanobisScorer,
+    OutlierDetector,
+)
+
+DETECTOR_TYPES = ("outlier", "drift")
+
+
+def build_detector(name: str, detector_type: str, storage_uri: str,
+                   alert_url=None):
+    if detector_type == "outlier":
+        return OutlierDetector(name, storage_uri, alert_url=alert_url)
+    if detector_type == "drift":
+        return KSDriftDetector(name, storage_uri)
+    raise ValueError(
+        f"unknown detector type {detector_type!r} "
+        f"(one of {list(DETECTOR_TYPES)})")

@@ -1,0 +1,161 @@
+"""Outlier detection on served payloads (alibi-detect sample parity).
+
+The reference wires outlier detection as a separate service consuming
+the payload logger's CloudEvents stream (reference
+docs/samples/outlier-detection/alibi-detect/cifar10: a KService running
+alibi-detect receives mirrored inference requests via `logger.url` and
+emits alerts).  This is the first-party equivalent: a Mahalanobis
+detector served as a Model — point an InferenceService's
+`logger.url` at its `:predict` route and every request payload is
+scored as it is served.
+
+Artifact layout (`storage_uri`):
+    train.npy      — [m, d] reference sample (fit: mean + covariance)
+    outlier.json   — {"threshold_percentile": 99.5} or
+                     {"threshold": 12.3}  (optional; percentile of the
+                     train sample's own scores by default)
+"""
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.protocol import v1
+from kfserving_tpu.protocol.errors import InvalidInput
+
+logger = logging.getLogger("kfserving_tpu.detectors.outlier")
+
+
+class MahalanobisScorer:
+    """Closed-form Mahalanobis distance to a fitted Gaussian."""
+
+    def __init__(self, train: np.ndarray, regularization: float = 1e-6):
+        train = np.asarray(train, np.float64)
+        if train.ndim != 2 or len(train) < 2:
+            raise InvalidInput("outlier train data must be [m>=2, d]")
+        self.mean = train.mean(axis=0)
+        cov = np.cov(train, rowvar=False)
+        cov = np.atleast_2d(cov)
+        cov += regularization * np.eye(cov.shape[0])
+        self.precision = np.linalg.inv(cov)
+
+    def score(self, batch: np.ndarray) -> np.ndarray:
+        """[n] Mahalanobis distances; rows flattened to the fitted d."""
+        x = np.asarray(batch, np.float64).reshape(len(batch), -1)
+        if x.shape[1] != self.mean.shape[0]:
+            raise InvalidInput(
+                f"instance dim {x.shape[1]} != fitted dim "
+                f"{self.mean.shape[0]}")
+        delta = x - self.mean
+        return np.sqrt(np.einsum("ni,ij,nj->n", delta, self.precision,
+                                 delta))
+
+
+class OutlierDetector(Model):
+    """Served detector: scores request payloads against the training
+    distribution; responds (and counts) per-instance verdicts.
+
+    As a logger sink it receives CloudEvents; response events
+    (org.kubeflow.serving.inference.response) are acknowledged and
+    skipped — only request payloads carry feature vectors."""
+
+    def __init__(self, name: str, model_dir: str,
+                 alert_url: Optional[str] = None):
+        super().__init__(name)
+        self.model_dir = model_dir
+        self.alert_url = alert_url
+        self.scorer: Optional[MahalanobisScorer] = None
+        self.threshold: Optional[float] = None
+        self.seen = 0
+        self.flagged = 0
+        self.alerts_sent = 0
+        self.alert_errors = 0
+
+    def load(self) -> bool:
+        from kfserving_tpu.storage import Storage
+
+        local = Storage.download(self.model_dir)
+        train = np.load(os.path.join(local, "train.npy"))
+        cfg: Dict[str, Any] = {}
+        cfg_path = os.path.join(local, "outlier.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+        self.scorer = MahalanobisScorer(
+            train, regularization=float(cfg.get("regularization", 1e-6)))
+        if "threshold" in cfg:
+            self.threshold = float(cfg["threshold"])
+        else:
+            pct = float(cfg.get("threshold_percentile", 99.5))
+            self.threshold = float(np.percentile(
+                self.scorer.score(train), pct))
+        self.ready = True
+        return True
+
+    async def predict(self, request: Any) -> Any:
+        if self.scorer is None:
+            raise InvalidInput(f"detector {self.name} not loaded")
+        # Logger response events carry predictions, not features.
+        if isinstance(request, dict) and "predictions" in request \
+                and "instances" not in request and "inputs" not in request:
+            return {"ignored": "response event"}
+        try:
+            instances = np.asarray(v1.get_instances(request), np.float64)
+        except (ValueError, TypeError) as e:
+            # Ragged / non-numeric mirrored payloads are the sender's
+            # shape, not a server fault.
+            raise InvalidInput(f"non-numeric payload: {e}")
+        if instances.ndim == 1:
+            instances = instances[None]
+        scores = self.scorer.score(instances)
+        outliers = scores > self.threshold
+        self.seen += len(scores)
+        self.flagged += int(outliers.sum())
+        if outliers.any() and self.alert_url:
+            # Fire-and-forget: a slow alert broker must not stall the
+            # logger sink (its workers await this response; a blocked
+            # sink drops mirrored payloads).
+            import asyncio
+
+            asyncio.get_running_loop().create_task(
+                self._alert(scores[outliers]))
+        return {
+            "outlier": outliers.astype(int).tolist(),
+            "score": np.round(scores, 6).tolist(),
+            "threshold": self.threshold,
+        }
+
+    async def _alert(self, scores: np.ndarray) -> None:
+        """Emit an alert CloudEvent (the sample posts to a broker).
+        Uses the inherited Model.http_session so close() cleans it up."""
+        from kfserving_tpu.protocol import cloudevents
+
+        try:
+            event = cloudevents.new_event(
+                "io.kfserving_tpu.detector.outlier",
+                f"detector/{self.name}",
+                {"count": int(len(scores)),
+                 "max_score": float(scores.max()),
+                 "threshold": self.threshold,
+                 "ts": time.time()})
+            headers, body = cloudevents.to_binary(event)
+            async with self.http_session.post(
+                    self.alert_url, data=body, headers=headers) as resp:
+                await resp.read()
+            self.alerts_sent += 1
+        except Exception as e:  # alerting must never fail serving
+            self.alert_errors += 1
+            logger.warning("outlier alert to %s failed: %s",
+                           self.alert_url, e)
+
+    def metadata(self) -> Dict[str, Any]:
+        meta = super().metadata()
+        meta.update({"detector": "mahalanobis", "seen": self.seen,
+                     "flagged": self.flagged,
+                     "threshold": self.threshold})
+        return meta

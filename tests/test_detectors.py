@@ -1,0 +1,223 @@
+"""Outlier + drift detector tests (alibi-detect sample parity).
+
+Mirrors the reference's outlier/drift deployment shape (reference
+docs/samples/outlier-detection/alibi-detect/cifar10: a detector service
+fed by the payload logger) with first-party Mahalanobis / KS detectors.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.detectors import (
+    KSDriftDetector,
+    MahalanobisScorer,
+    OutlierDetector,
+    build_detector,
+    ks_p_value,
+    ks_statistic,
+)
+
+
+# -- scoring unit tests -----------------------------------------------------
+
+def test_mahalanobis_identity_covariance():
+    """Unit-variance isotropic data: distance == euclidean distance to
+    the mean (up to the regularizer)."""
+    rng = np.random.default_rng(0)
+    train = rng.normal(size=(5000, 4))
+    scorer = MahalanobisScorer(train)
+    x = np.array([[3.0, 0.0, 0.0, 0.0]])
+    d = scorer.score(x + train.mean(axis=0))[0]
+    assert d == pytest.approx(3.0, rel=0.1)
+
+
+def test_mahalanobis_accounts_for_correlation():
+    """A point along the major axis of a stretched distribution scores
+    LOWER than an equally-euclidean-distant point off-axis."""
+    rng = np.random.default_rng(1)
+    base = rng.normal(size=(4000, 2))
+    train = base @ np.array([[3.0, 0.0], [0.0, 0.3]])  # stretch x
+    scorer = MahalanobisScorer(train)
+    on_axis = scorer.score(np.array([[3.0, 0.0]]))[0]
+    off_axis = scorer.score(np.array([[0.0, 3.0]]))[0]
+    assert off_axis > 3 * on_axis
+
+
+def test_ks_statistic_known_values():
+    # identical samples -> 0; disjoint supports -> 1
+    a = np.arange(10.0)
+    assert ks_statistic(a, a) == 0.0
+    assert ks_statistic(a, a + 100.0) == 1.0
+    # half-shifted: D for [0,1] vs [0.5,1.5] uniform grids
+    b = np.array([0.0, 1.0, 2.0, 3.0])
+    c = np.array([2.0, 3.0, 4.0, 5.0])
+    assert ks_statistic(b, c) == pytest.approx(0.5)
+
+
+def test_ks_p_value_calibration():
+    """Same-distribution samples should not reject; a gross shift
+    should reject hard."""
+    rng = np.random.default_rng(2)
+    a, b = rng.normal(size=500), rng.normal(size=500)
+    d = ks_statistic(a, b)
+    assert ks_p_value(d, 500, 500) > 0.01
+    shifted = rng.normal(loc=2.0, size=500)
+    d2 = ks_statistic(a, shifted)
+    assert ks_p_value(d2, 500, 500) < 1e-6
+    assert ks_p_value(0.0, 100, 100) == 1.0
+
+
+# -- served detectors -------------------------------------------------------
+
+def _outlier_dir(tmp_path, rng, cfg=None):
+    d = tmp_path / "od"
+    d.mkdir(exist_ok=True)
+    np.save(str(d / "train.npy"), rng.normal(size=(1000, 4)))
+    if cfg:
+        (d / "outlier.json").write_text(json.dumps(cfg))
+    return str(d)
+
+
+async def test_outlier_detector_flags_far_points(tmp_path):
+    rng = np.random.default_rng(3)
+    det = OutlierDetector("od", _outlier_dir(tmp_path, rng))
+    det.load()
+    normal = rng.normal(size=(4, 4))
+    out = await det.predict({"instances": normal.tolist()})
+    assert out["outlier"] == [0, 0, 0, 0]
+    far = np.full((1, 4), 10.0)
+    out = await det.predict({"instances": far.tolist()})
+    assert out["outlier"] == [1]
+    assert out["score"][0] > out["threshold"]
+    assert det.seen == 5 and det.flagged == 1
+    # logger response events are acknowledged, not scored
+    out = await det.predict({"predictions": [1, 2]})
+    assert out == {"ignored": "response event"}
+    assert det.seen == 5
+
+
+async def test_outlier_detector_explicit_threshold(tmp_path):
+    rng = np.random.default_rng(4)
+    det = OutlierDetector(
+        "od", _outlier_dir(tmp_path, rng, {"threshold": 0.0}))
+    det.load()
+    out = await det.predict({"instances": rng.normal(size=(3, 4)).tolist()})
+    assert out["outlier"] == [1, 1, 1]  # everything beats threshold 0
+
+
+async def test_drift_detector_fill_then_verdicts(tmp_path):
+    rng = np.random.default_rng(5)
+    d = tmp_path / "drift"
+    d.mkdir()
+    np.save(str(d / "train.npy"), rng.normal(size=(400, 3)))
+    (d / "drift.json").write_text(json.dumps(
+        {"window": 64, "p_value": 0.05}))
+    det = KSDriftDetector("dd", str(d))
+    det.load()
+    # same-distribution traffic: fills, then no drift
+    out = None
+    for _ in range(8):
+        out = await det.predict(
+            {"instances": rng.normal(size=(8, 3)).tolist()})
+    assert out["drift"] is False
+    # shifted traffic floods the window -> drift
+    for _ in range(8):
+        out = await det.predict(
+            {"instances": (rng.normal(size=(8, 3)) + 3.0).tolist()})
+    assert out["drift"] is True
+    assert det.drift_events >= 1
+    assert min(out["p_values"]) < out["threshold"]
+
+
+def test_build_detector_dispatch(tmp_path):
+    rng = np.random.default_rng(6)
+    path = _outlier_dir(tmp_path, rng)
+    assert isinstance(build_detector("x", "outlier", path),
+                      OutlierDetector)
+    assert isinstance(build_detector("x", "drift", path),
+                      KSDriftDetector)
+    with pytest.raises(ValueError, match="unknown detector"):
+        build_detector("x", "nope", path)
+
+
+async def test_logger_feeds_detector_end_to_end(tmp_path):
+    """The reference deployment shape: an isvc's logger.url points at a
+    live detector server; served predictions get mirrored as CloudEvents
+    and scored — an outlier in the traffic shows up in the detector's
+    counters without touching the serving path."""
+    from kfserving_tpu import Model
+    from kfserving_tpu.agent.logger import RequestLogger
+    from tests.utils import http_request, running_server
+
+    rng = np.random.default_rng(7)
+
+    class Echo(Model):
+        def load(self):
+            self.ready = True
+            return True
+
+        async def predict(self, request):
+            return {"predictions": [0] * len(request["instances"])}
+
+    det = OutlierDetector("od", _outlier_dir(tmp_path, rng))
+    det.load()
+    async with running_server([det]) as det_server:
+        model = Echo("m")
+        model.load()
+        async with running_server([model]) as server:
+            logger_ = RequestLogger(
+                log_url=(f"http://127.0.0.1:{det_server.http_port}"
+                         f"/v1/models/od:predict"),
+                log_mode="request", inference_service="m")
+            await logger_.start()
+            logger_.attach(server)
+            try:
+                normal = rng.normal(size=(2, 4)).tolist()
+                status, _, _ = await http_request(
+                    server.http_port, "POST", "/v1/models/m:predict",
+                    json.dumps({"instances": normal}).encode())
+                assert status == 200
+                status, _, _ = await http_request(
+                    server.http_port, "POST", "/v1/models/m:predict",
+                    json.dumps(
+                        {"instances": [[9.0, 9.0, 9.0, 9.0]]}).encode())
+                assert status == 200
+                for _ in range(50):  # logger tees asynchronously
+                    if det.seen >= 3:
+                        break
+                    await asyncio.sleep(0.1)
+                assert det.seen >= 3
+                assert det.flagged == 1
+            finally:
+                await logger_.stop()
+
+
+async def test_detector_rejects_non_numeric_payload(tmp_path):
+    """A text model's mirrored payloads are the sender's shape — 400,
+    not a 500 per event."""
+    from kfserving_tpu.protocol.errors import InvalidInput
+
+    rng = np.random.default_rng(8)
+    det = OutlierDetector("od", _outlier_dir(tmp_path, rng))
+    det.load()
+    with pytest.raises(InvalidInput, match="non-numeric"):
+        await det.predict({"instances": [["hello", "world"]]})
+
+
+async def test_outlier_alert_fire_and_forget(tmp_path):
+    """A dead alert broker must not stall or fail the scoring path."""
+    rng = np.random.default_rng(9)
+    det = OutlierDetector("od", _outlier_dir(tmp_path, rng),
+                          alert_url="http://127.0.0.1:1/unreachable")
+    det.load()
+    out = await det.predict({"instances": [[9.0, 9.0, 9.0, 9.0]]})
+    assert out["outlier"] == [1]
+    for _ in range(50):
+        if det.alert_errors:
+            break
+        await asyncio.sleep(0.05)
+    assert det.alert_errors == 1 and det.alerts_sent == 0
+    await det.close()
