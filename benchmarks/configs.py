@@ -20,7 +20,7 @@ import json
 import os
 import tempfile
 import time
-from typing import Any, Dict
+from typing import Any, Dict, List
 
 import numpy as np
 
@@ -150,15 +150,74 @@ async def bench_resnet(smoke: bool) -> Dict[str, Any]:
             num_requests=256 if smoke else 4096,
             connections=4 if smoke else 8,
             headers={"Inference-Header-Content-Length": str(hlen)})
+        grpc_res = await _grpc_closed_loop(
+            server, "resnet", image[None],
+            num_requests=128 if smoke else 1024,
+            concurrency=16 if smoke else 64)
         stats = model.engine_stats()
         return {"closed_loop": peak, "fixed_rate": fixed,
                 "binary_wire_closed_loop": binary,
                 "binary_wire_pipelined": piped,
+                "grpc_closed_loop": grpc_res,
                 "compile_s": round(compile_s, 1),
                 "engine": {k: (round(v, 4) if isinstance(v, float) else v)
                            for k, v in stats.items()}}
     finally:
         await server.stop_async()
+
+
+async def _grpc_closed_loop(server, model_name: str, arr,
+                            num_requests: int, concurrency: int
+                            ) -> Dict[str, Any]:
+    """V2 gRPC ModelInfer with raw_input_contents (the native tensor
+    wire over HTTP/2) — the protocol row's perf leg."""
+    try:
+        import grpc
+    except ImportError:
+        return {"skipped": "grpcio not installed"}
+    from benchmarks.harness import summarize
+    from kfserving_tpu.protocol.grpc import pb2
+    from kfserving_tpu.protocol.v2 import datatype_of
+
+    if getattr(server, "grpc_server", None) is None:
+        from kfserving_tpu.server.grpc_server import GRPCServer
+
+        server.grpc_server = GRPCServer(server.dataplane, port=0)
+        await server.grpc_server.start()
+    port = server.grpc_server.port
+    req = pb2.ModelInferRequest(model_name=model_name)
+    tensor = req.inputs.add()
+    tensor.name = "input_0"
+    tensor.datatype = datatype_of(arr)
+    tensor.shape.extend(arr.shape)
+    req.raw_input_contents.append(np.ascontiguousarray(arr).tobytes())
+    payload = req.SerializeToString()
+
+    channel = grpc.aio.insecure_channel(f"127.0.0.1:{port}")
+    call = channel.unary_unary(
+        "/inference.GRPCInferenceService/ModelInfer",
+        request_serializer=lambda b: b,
+        response_deserializer=pb2.ModelInferResponse.FromString)
+    latencies: List[float] = []
+    errors = 0
+    sem = asyncio.Semaphore(concurrency)
+
+    async def one():
+        nonlocal errors
+        async with sem:
+            t0 = time.perf_counter()
+            try:
+                await call(payload)
+            except Exception:
+                errors += 1
+                return
+            latencies.append((time.perf_counter() - t0) * 1e3)
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[one() for _ in range(num_requests)])
+    wall = time.perf_counter() - t0
+    await channel.close()
+    return summarize(latencies, wall, errors)
 
 
 async def bench_overload(smoke: bool) -> Dict[str, Any]:

@@ -148,18 +148,20 @@ class SubprocessOrchestrator:
             # fail HERE with a clear error — the child's stderr goes to
             # DEVNULL, so an argparse rejection would surface only as
             # an opaque readiness failure.
-            from kfserving_tpu.explainers import EXPLAINER_TYPES
+            from kfserving_tpu.explainers import (
+                ARTIFACT_REQUIRED_TYPES,
+                EXPLAINER_TYPES,
+            )
 
             if spec.explainer_type not in EXPLAINER_TYPES:
                 raise ValueError(
                     f"explainer_type {spec.explainer_type!r} needs an "
                     f"explicit command under the subprocess "
                     f"orchestrator (in-tree: {list(EXPLAINER_TYPES)})")
-            if spec.explainer_type in ("saliency", "fairness") and \
+            if spec.explainer_type in ARTIFACT_REQUIRED_TYPES and \
                     not spec.storage_uri:
-                # These types require an artifact dir (saliency loads a
-                # jax model, fairness its group config); without one the
-                # child dies in Storage.download with stderr discarded.
+                # Without the artifact dir the child dies in
+                # Storage.download with stderr discarded.
                 raise ValueError(
                     f"{spec.explainer_type} explainer needs a "
                     f"storage_uri")

@@ -64,6 +64,26 @@ def validate(isvc: InferenceService) -> None:
                 errors.append(f"{cname}.batcher.max_batch_size must be > 0")
             if comp.batcher.max_latency_ms <= 0:
                 errors.append(f"{cname}.batcher.max_latency_ms must be > 0")
+    if isvc.explainer is not None:
+        # Admission-time type check (the reference's validating webhook
+        # catches bad specs at apply, not replica actuation).
+        from kfserving_tpu.explainers import (
+            ARTIFACT_REQUIRED_TYPES,
+            EXPLAINER_TYPES,
+        )
+
+        etype = isvc.explainer.explainer_type
+        if etype == "custom":
+            if not isvc.explainer.command:
+                errors.append("custom explainer requires command")
+        elif etype not in EXPLAINER_TYPES:
+            errors.append(
+                f"explainer.explainer_type {etype!r} must be one of "
+                f"{list(EXPLAINER_TYPES)} or 'custom' (with command)")
+        elif etype in ARTIFACT_REQUIRED_TYPES and \
+                not isvc.explainer.storage_uri:
+            errors.append(
+                f"{etype} explainer requires storage_uri")
     par = pred.parallelism
     if par is not None and (par.dp < 1 or par.tp < 1 or par.sp < 1):
         errors.append("parallelism axes must be >= 1")

@@ -75,6 +75,9 @@ class OutlierDetector(Model):
         self.flagged = 0
         self.alerts_sent = 0
         self.alert_errors = 0
+        # Strong refs: the loop holds tasks weakly — an un-referenced
+        # fire-and-forget alert can be GC'd mid-POST.
+        self._alert_tasks: set = set()
 
     def load(self) -> bool:
         from kfserving_tpu.storage import Storage
@@ -122,8 +125,10 @@ class OutlierDetector(Model):
             # sink drops mirrored payloads).
             import asyncio
 
-            asyncio.get_running_loop().create_task(
+            task = asyncio.get_running_loop().create_task(
                 self._alert(scores[outliers]))
+            self._alert_tasks.add(task)
+            task.add_done_callback(self._alert_tasks.discard)
         return {
             "outlier": outliers.astype(int).tolist(),
             "score": np.round(scores, 6).tolist(),
@@ -152,6 +157,15 @@ class OutlierDetector(Model):
             self.alert_errors += 1
             logger.warning("outlier alert to %s failed: %s",
                            self.alert_url, e)
+
+    async def close(self) -> None:
+        """Drain in-flight alerts before the session closes."""
+        import asyncio
+
+        if self._alert_tasks:
+            await asyncio.gather(*list(self._alert_tasks),
+                                 return_exceptions=True)
+        await super().close()
 
     def metadata(self) -> Dict[str, Any]:
         meta = super().metadata()

@@ -32,6 +32,11 @@ from kfserving_tpu.explainers.saliency import SaliencyExplainer  # noqa: F401
 # the subprocess command builder all resolve types here.
 EXPLAINER_TYPES = ("saliency", "anchor_tabular", "lime_images",
                    "square_attack", "fairness")
+# Types whose load() dies without an artifact dir (saliency serves a
+# jax model, anchors needs train.npy, fairness its group config) —
+# admission validation and the subprocess command builder both reject
+# missing storage_uri for these up front, where the error is visible.
+ARTIFACT_REQUIRED_TYPES = ("saliency", "anchor_tabular", "fairness")
 
 
 def build_explainer(name: str, explainer_type: str,

@@ -640,3 +640,29 @@ async def test_activation_fails_fast_on_deterministic_scale_error():
     finally:
         await router.stop_async()
         await orch.shutdown()
+
+
+def test_validation_rejects_bad_explainer_specs():
+    """Admission-time explainer checks (reference validating-webhook
+    role): unknown type, custom without command, artifact-requiring
+    types without storage_uri."""
+    from kfserving_tpu.control.spec import ExplainerSpec
+    from kfserving_tpu.control.validation import ValidationError, validate
+
+    def isvc_with(explainer):
+        return InferenceService(
+            name="v",
+            predictor=PredictorSpec(framework="sklearn",
+                                    storage_uri="file:///m"),
+            explainer=explainer)
+
+    with pytest.raises(ValidationError, match="explainer_type"):
+        validate(isvc_with(ExplainerSpec(explainer_type="alibi")))
+    with pytest.raises(ValidationError, match="requires command"):
+        validate(isvc_with(ExplainerSpec(explainer_type="custom")))
+    with pytest.raises(ValidationError, match="requires storage_uri"):
+        validate(isvc_with(ExplainerSpec(explainer_type="anchor_tabular")))
+    # valid: artifact-less types need no storage_uri
+    validate(isvc_with(ExplainerSpec(explainer_type="square_attack")))
+    validate(isvc_with(ExplainerSpec(
+        explainer_type="anchor_tabular", storage_uri="file:///exp")))
