@@ -200,16 +200,19 @@ async def _grpc_closed_loop(server, model_name: str, arr,
         response_deserializer=pb2.ModelInferResponse.FromString)
     latencies: List[float] = []
     errors = 0
+    first_error = None
     sem = asyncio.Semaphore(concurrency)
 
     async def one():
-        nonlocal errors
+        nonlocal errors, first_error
         async with sem:
             t0 = time.perf_counter()
             try:
                 await call(payload)
-            except Exception:
+            except Exception as exc:
                 errors += 1
+                if first_error is None:
+                    first_error = f"{type(exc).__name__}: {exc}"
                 return
             latencies.append((time.perf_counter() - t0) * 1e3)
 
@@ -217,7 +220,7 @@ async def _grpc_closed_loop(server, model_name: str, arr,
     await asyncio.gather(*[one() for _ in range(num_requests)])
     wall = time.perf_counter() - t0
     await channel.close()
-    return summarize(latencies, wall, errors)
+    return summarize(latencies, wall, errors, first_error)
 
 
 async def bench_overload(smoke: bool) -> Dict[str, Any]:

@@ -73,9 +73,12 @@ def validate(isvc: InferenceService) -> None:
         )
 
         etype = isvc.explainer.explainer_type
-        if etype == "custom":
-            if not isvc.explainer.command:
-                errors.append("custom explainer requires command")
+        if isvc.explainer.command:
+            # An explicit command serves any type (the orchestrator's
+            # command-first branch); no in-tree checks apply.
+            pass
+        elif etype == "custom":
+            errors.append("custom explainer requires command")
         elif etype not in EXPLAINER_TYPES:
             errors.append(
                 f"explainer.explainer_type {etype!r} must be one of "
@@ -84,6 +87,12 @@ def validate(isvc: InferenceService) -> None:
                 not isvc.explainer.storage_uri:
             errors.append(
                 f"{etype} explainer requires storage_uri")
+        if isvc.explainer.storage_uri and \
+                not isvc.explainer.storage_uri.startswith(
+                    tuple(STORAGE_URI_PREFIXES)):
+            errors.append(
+                f"explainer.storage_uri {isvc.explainer.storage_uri!r} "
+                f"must start with one of {STORAGE_URI_PREFIXES}")
     par = pred.parallelism
     if par is not None and (par.dp < 1 or par.tp < 1 or par.sp < 1):
         errors.append("parallelism axes must be >= 1")

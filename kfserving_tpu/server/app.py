@@ -366,9 +366,21 @@ class ModelServer:
                 continue
             try:
                 for key, value in engine_stats().items():
-                    self.metrics.set_gauge(
-                        f"kfserving_tpu_engine_{key}", float(value),
-                        labels={"model": model.name})
+                    if isinstance(value, dict):
+                        # Per-bucket stats (bucket_hits/..._pad_waste)
+                        # export as labeled series.
+                        for bucket, v in value.items():
+                            if isinstance(v, (int, float)):
+                                self.metrics.set_gauge(
+                                    f"kfserving_tpu_engine_{key}",
+                                    float(v),
+                                    labels={"model": model.name,
+                                            "bucket": str(bucket)})
+                        continue
+                    if isinstance(value, (int, float)):
+                        self.metrics.set_gauge(
+                            f"kfserving_tpu_engine_{key}", float(value),
+                            labels={"model": model.name})
             except Exception:
                 logger.exception("engine stats for %s failed", model.name)
         return Response(self.metrics.render().encode("utf-8"),

@@ -666,3 +666,27 @@ def test_validation_rejects_bad_explainer_specs():
     validate(isvc_with(ExplainerSpec(explainer_type="square_attack")))
     validate(isvc_with(ExplainerSpec(
         explainer_type="anchor_tabular", storage_uri="file:///exp")))
+
+
+def test_validation_explainer_command_and_uri_prefix():
+    """An explicit command serves any explainer type (orchestrator's
+    command-first branch); storage_uri schemes are checked like the
+    predictor's."""
+    from kfserving_tpu.control.spec import ExplainerSpec
+    from kfserving_tpu.control.validation import ValidationError, validate
+
+    def isvc_with(explainer):
+        return InferenceService(
+            name="v",
+            predictor=PredictorSpec(framework="sklearn",
+                                    storage_uri="file:///m"),
+            explainer=explainer)
+
+    # command overrides the in-tree type checks
+    validate(isvc_with(ExplainerSpec(explainer_type="saliency",
+                                     command=["my-server"])))
+    validate(isvc_with(ExplainerSpec(explainer_type="alibi",
+                                     command=["alibi-server"])))
+    with pytest.raises(ValidationError, match="must start with"):
+        validate(isvc_with(ExplainerSpec(
+            explainer_type="anchor_tabular", storage_uri="bogus://x")))

@@ -472,3 +472,41 @@ def test_left_padded_mask_allowed_with_flag(tmp_path):
 
     resp = asyncio.run(run())
     assert np.asarray(resp["predictions"][0]).shape == (8, 1024)
+
+
+async def test_metrics_exports_engine_and_bucket_gauges(tmp_path):
+    """/metrics must survive (and export) the dict-valued engine stats:
+    bucket_hits/bucket_pad_waste become per-bucket labeled series — a
+    regression here once silently dropped every gauge after the first
+    dict value."""
+    import json as _json
+
+    from kfserving_tpu.predictors.jax_model import JaxModel
+    from tests.utils import http_request, running_server
+
+    model_dir = tmp_path / "m"
+    model_dir.mkdir()
+    (model_dir / "config.json").write_text(_json.dumps({
+        "architecture": "mlp",
+        "arch_kwargs": {"input_dim": 4, "features": [8],
+                        "num_classes": 3},
+        "batch_buckets": [2, 4], "max_latency_ms": 2,
+        "warmup": False, "output": "argmax"}))
+    model = JaxModel("m", str(model_dir))
+    model.load()
+    async with running_server([model]) as server:
+        body = _json.dumps({"instances": [[0.1, 0.2, 0.3, 0.4]]}).encode()
+        status, _, _ = await http_request(
+            server.http_port, "POST", "/v1/models/m:predict", body)
+        assert status == 200
+        status, _, payload = await http_request(
+            server.http_port, "GET", "/metrics")
+        assert status == 200
+        text = payload.decode()
+        assert 'kfserving_tpu_engine_bucket_hits{bucket="b2",model="m"}' \
+            in text or \
+            'kfserving_tpu_engine_bucket_hits{model="m",bucket="b2"}' \
+            in text
+        # scalar gauges after the dict ones still export
+        assert "kfserving_tpu_engine_execute_count" in text
+        assert "kfserving_tpu_engine_slot_pad_waste" in text
