@@ -15,7 +15,11 @@ kubeflow/kfserving ~v0.5, see /root/reference) designed TPU-first:
   validation, a reconciler, canary traffic splitting, and a KPA-style
   concurrency autoscaler with scale-to-zero — in-process, cluster-free.
 - Parallelism: jax.sharding Mesh over ICI for models larger than one chip
-  (tensor parallel), ring attention for long-context serving.
+  (tensor parallel), ring attention injected into served models for
+  sequence-parallel long-context serving.
+- Explainers (anchors, LIME, square-attack, saliency, fairness) and
+  payload detectors (Mahalanobis outlier, KS drift) as first-party
+  Models, served on :explain or as payload-logger sinks.
 """
 
 __version__ = "0.1.0"
