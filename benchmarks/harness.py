@@ -34,24 +34,56 @@ def percentile(sorted_values: List[float], q: float) -> float:
 
 def summarize(latencies_ms: List[float], wall_s: float,
               errors: int = 0,
-              first_error: Optional[str] = None) -> Dict[str, Any]:
+              first_error: Optional[str] = None,
+              shed: int = 0) -> Dict[str, Any]:
+    """shed: admission-gate 503s — load management, reported apart from
+    errors so goodput-vs-shed is visible."""
     lat = sorted(latencies_ms)
     n = len(lat)
+    total = n + errors + shed
     out = {
-        "requests": n + errors,
+        "requests": total,
         "errors": errors,
-        "success_rate": n / (n + errors) if (n + errors) else 0.0,
+        "success_rate": n / total if total else 0.0,
         "req_per_s": n / wall_s if wall_s > 0 else 0.0,
         "mean_ms": round(statistics.fmean(lat), 3) if lat else None,
         "p50_ms": round(percentile(lat, 0.50), 3) if lat else None,
         "p95_ms": round(percentile(lat, 0.95), 3) if lat else None,
         "p99_ms": round(percentile(lat, 0.99), 3) if lat else None,
     }
+    if shed:
+        out["shed"] = shed
+        out["shed_rate"] = shed / total
     if first_error:
         # A failing config must say WHY in the results JSON — an
         # all-errors run once shipped as silent zeros.
         out["first_error"] = first_error[:500]
     return out
+
+
+def aggregate_rounds(rounds: List[Dict[str, Any]],
+                     keys: tuple = ("req_per_s", "p50_ms", "p99_ms")
+                     ) -> Dict[str, Any]:
+    """Median-of-rounds aggregation for interleaved A/B benches: rounds
+    whose percentiles are None (all-error) are excluded from medians but
+    their errors/first_error still surface."""
+    good = [r for r in rounds if r.get("p99_ms") is not None]
+    agg: Dict[str, Any] = {
+        "req_per_s_rounds": [round(r.get("req_per_s", 0.0), 2)
+                             for r in rounds],
+        "shed": sum(r.get("shed", 0) for r in rounds),
+        "errors": sum(r.get("errors", 0) for r in rounds),
+    }
+    for key in keys:
+        agg[f"{key}_median"] = round(statistics.median(
+            r[key] for r in good), 2) if good else None
+    firsts = [r["first_error"] for r in rounds if r.get("first_error")]
+    if firsts:
+        agg["first_error"] = firsts[0]
+    total = sum(r.get("requests", 0) for r in rounds)
+    if total:
+        agg["shed_rate"] = round(agg["shed"] / total, 4)
+    return agg
 
 
 async def closed_loop(port: int, path: str, body: bytes,
@@ -107,13 +139,7 @@ async def closed_loop(port: int, path: str, body: bytes,
         t0 = time.perf_counter()
         await asyncio.gather(*[one() for _ in range(num_requests)])
         wall = time.perf_counter() - t0
-    out = summarize(latencies, wall, errors, first_error)
-    if shed:
-        out["shed"] = shed
-        out["shed_rate"] = shed / max(1, num_requests)
-        out["requests"] = len(latencies) + errors + shed
-        out["success_rate"] = len(latencies) / max(1, num_requests)
-    return out
+    return summarize(latencies, wall, errors, first_error, shed=shed)
 
 
 async def open_loop(port: int, path: str,
@@ -134,6 +160,7 @@ async def open_loop(port: int, path: str,
     latencies: List[float] = []
     by_label: Dict[str, List[float]] = {}
     errors = 0
+    shed = 0
     first_error: Optional[str] = None
     total = max(1, int(rate_qps * duration_s))
     url = f"http://{host}:{port}{path}"
@@ -143,12 +170,17 @@ async def open_loop(port: int, path: str,
             timeout=aiohttp.ClientTimeout(total=120)) as session:
 
         async def one(i: int):
-            nonlocal errors, first_error
+            nonlocal errors, shed, first_error
             t0 = time.perf_counter()
             try:
                 async with session.post(
                         url, data=body_fn(i), headers=headers) as resp:
                     payload = await resp.read()
+                    if resp.status == 503 and \
+                            b"concurrency limit" in payload:
+                        # Admission-gate shedding (see closed_loop).
+                        shed += 1
+                        return
                     if resp.status != 200:
                         errors += 1
                         if first_error is None:
@@ -175,7 +207,7 @@ async def open_loop(port: int, path: str,
             tasks.append(asyncio.ensure_future(one(i)))
         await asyncio.gather(*tasks)
         wall = time.perf_counter() - start
-    out = summarize(latencies, wall, errors, first_error)
+    out = summarize(latencies, wall, errors, first_error, shed=shed)
     out["rate_qps"] = rate_qps
     if by_label:
         out["by_label"] = {
