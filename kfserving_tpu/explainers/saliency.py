@@ -19,7 +19,7 @@ from typing import Any, Dict
 
 import numpy as np
 
-from kfserving_tpu.model.model import PREDICTOR_URL_FORMAT, Model
+from kfserving_tpu.explainers.proxy import PredictorProxyModel
 from kfserving_tpu.predictors.jax_model import JaxModel
 from kfserving_tpu.protocol import v1
 from kfserving_tpu.protocol.errors import InferenceError
@@ -81,7 +81,7 @@ class SaliencyExplainer(JaxModel):
         return meta
 
 
-class BlackBoxExplainer(Model):
+class BlackBoxExplainer(PredictorProxyModel):
     """Parity shape with the reference explainer pods: explain() perturbs
     inputs locally and scores them against predictor_host over HTTP
     (reference explainer_wrapper.py _predict_fn pattern).  Feature
@@ -89,8 +89,9 @@ class BlackBoxExplainer(Model):
     (noise-based so single-instance requests perturb too)."""
 
     def __init__(self, name: str, num_samples: int = 32,
-                 noise_scale: float = 1.0, seed: int = 0):
-        super().__init__(name)
+                 noise_scale: float = 1.0, seed: int = 0,
+                 predict_fn=None):
+        super().__init__(name, predict_fn=predict_fn)
         self.num_samples = num_samples
         self.noise_scale = noise_scale
         self.seed = seed
@@ -100,7 +101,7 @@ class BlackBoxExplainer(Model):
         return True
 
     async def explain(self, request: Any) -> Any:
-        if not self.predictor_host:
+        if not self.predictor_host and self._predict_fn is None:
             raise InferenceError(
                 "BlackBoxExplainer requires predictor_host")
         instances = v1.get_instances(request)
@@ -133,6 +134,7 @@ class BlackBoxExplainer(Model):
         return meta
 
     async def _remote_predict(self, batch: np.ndarray):
-        url = PREDICTOR_URL_FORMAT.format(self.predictor_host, self.name)
-        resp = await self._proxy(url, {"instances": batch.tolist()})
-        return resp["predictions"]
+        # Shared proxy hop (ndarray payload -> V2 binary wire when the
+        # predictor speaks it, clean error on a malformed response);
+        # kept as a named method because tests monkeypatch it.
+        return await self._proxied_predict(batch)

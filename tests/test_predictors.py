@@ -457,3 +457,32 @@ def test_fairness_explainer_deployable_from_artifact(tmp_path):
         build_explainer("fair", "fairness", "")
     with pytest.raises(ValueError, match="unknown explainer_type"):
         build_explainer("x", "nope", "")
+
+
+async def test_blackbox_explainer_live_predictor_hop(tmp_path):
+    """BlackBoxExplainer's predictor hop through a real server (its
+    other tests monkeypatch _remote_predict; this pins the actual
+    Model.predict proxy path, incl. the ndarray payload)."""
+    import joblib
+    from sklearn import linear_model
+
+    from kfserving_tpu.explainers.saliency import BlackBoxExplainer
+    from tests.utils import running_server
+
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-1, 1, size=(128, 3))
+    y = (X[:, 1] > 0).astype(int)  # only feature 1 matters
+    clf = linear_model.LogisticRegression(max_iter=300).fit(X, y)
+    pred_dir = tmp_path / "pred"
+    pred_dir.mkdir()
+    joblib.dump(clf, str(pred_dir / "model.joblib"))
+    predictor = SKLearnModel("bb", str(pred_dir))
+    predictor.load()
+    async with running_server([predictor]) as server:
+        ex = BlackBoxExplainer("bb", num_samples=8)
+        ex.predictor_host = f"127.0.0.1:{server.http_port}"
+        ex.load()
+        out = await ex.explain({"instances": [[0.0, 0.05, 0.0]]})
+        imp = out["explanations"][0]["feature_importance"]
+        assert imp[1] > 0  # the decisive feature flips predictions
+        await ex.close()
