@@ -173,4 +173,12 @@ def main():
 
 
 if __name__ == "__main__":
+    # Direct-script invocation (`python benchmarks/soak.py`) puts
+    # benchmarks/ itself on sys.path, breaking the in-function
+    # `from benchmarks.harness import ...` — add the repo root so both
+    # that and `python -m benchmarks.soak` work.
+    import sys
+
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
     main()
