@@ -48,14 +48,24 @@ def s3_secret_payload(credentials_file: str, s3_profile: str = "default",
                       ) -> Dict[str, Any]:
     config = configparser.ConfigParser()
     config.read([expanduser(credentials_file)])
-    payload: Dict[str, Any] = {
-        "type": "s3",
-        "data": {
-            "accessKeyId": config.get(s3_profile, "aws_access_key_id"),
-            "secretAccessKey": config.get(s3_profile,
-                                          "aws_secret_access_key"),
-        },
-    }
+    try:
+        payload: Dict[str, Any] = {
+            "type": "s3",
+            "data": {
+                "accessKeyId": config.get(s3_profile,
+                                          "aws_access_key_id"),
+                "secretAccessKey": config.get(s3_profile,
+                                              "aws_secret_access_key"),
+            },
+        }
+    except configparser.Error as e:
+        # Fail early with the file+profile named, matching the gcs
+        # payload's validation, instead of a raw configparser traceback
+        # from the CLI.
+        raise ValueError(
+            f"profile {s3_profile!r} with aws_access_key_id/"
+            f"aws_secret_access_key not found in "
+            f"{credentials_file}: {e}") from e
     annotations = {}
     for value, key in ((s3_endpoint, S3_ENDPOINT_ANNOTATION),
                        (s3_region, S3_REGION_ANNOTATION),

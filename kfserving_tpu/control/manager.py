@@ -82,8 +82,13 @@ class ServingManager:
 
     # -- lifecycle ----------------------------------------------------------
     async def start_async(self) -> None:
-        await self.api.start_async(self.host)
+        # Router first: it publishes cluster_local_url, which the
+        # orchestrator bakes into explainer/transformer replicas as
+        # predictor_host.  Starting the control API first would open a
+        # window where an apply builds replicas with predictor_host
+        # None permanently.
         await self.router.start_async(self.host)
+        await self.api.start_async(self.host)
         await self.autoscaler.start()
         logger.info("control API on %s:%d, ingress on %s:%d",
                     self.host, self.api.http_port,
@@ -91,8 +96,8 @@ class ServingManager:
 
     async def stop_async(self) -> None:
         await self.autoscaler.stop()
-        await self.router.stop_async()
         await self.api.stop_async()
+        await self.router.stop_async()
         for name in list(self.controller.specs):
             ns, isvc_name = name.split("/", 1)
             await self.controller.remove(isvc_name, ns)

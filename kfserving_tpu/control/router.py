@@ -333,6 +333,15 @@ class IngressRouter:
                     # §5.3).  A replica that still answers its liveness
                     # route had a genuine mid-request glitch: neither
                     # retry (would duplicate inference) nor evict.
+                    #
+                    # Known window: if the replica executed the request
+                    # and crashed while writing the response, the retry
+                    # below re-runs the inference — side-effect sinks
+                    # (payload-logger mirrors, drift/outlier detector
+                    # counters) may observe the request twice.  This is
+                    # the availability-over-exactly-once trade the
+                    # reference's activator also makes; consumers that
+                    # need dedup should key on the logger's request id.
                     logger.warning("proxy to %s failed mid-request: %s",
                                    url, e)
                     if await self._replica_alive(host):

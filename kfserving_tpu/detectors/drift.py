@@ -94,10 +94,21 @@ class KSDriftDetector(Model):
         if os.path.exists(cfg_path):
             with open(cfg_path) as f:
                 cfg = json.load(f)
-        self.window_size = int(self._window_override
-                               or cfg.get("window", 128))
-        self.p_value = float(self._p_override
-                             or cfg.get("p_value", 0.05))
+        # `is not None` (not truthiness): an explicit override of 0 must
+        # be rejected by the range checks below, not silently replaced
+        # by the config default.
+        self.window_size = int(
+            self._window_override if self._window_override is not None
+            else cfg.get("window", 128))
+        self.p_value = float(
+            self._p_override if self._p_override is not None
+            else cfg.get("p_value", 0.05))
+        if self.window_size < 1:
+            raise InvalidInput(
+                f"drift window must be >= 1, got {self.window_size}")
+        if not 0.0 < self.p_value < 1.0:
+            raise InvalidInput(
+                f"drift p_value must be in (0, 1), got {self.p_value}")
         self.window = deque(maxlen=self.window_size)
         # Pre-sort the static reference once; re-test at a stride, not
         # per event (d KS tests over a high-dim payload per mirrored

@@ -16,6 +16,14 @@ from kfserving_tpu.explainers.adversarial import (  # noqa: F401
     AdversarialRobustness,
     SquareAttack,
 )
+from kfserving_tpu.explainers.anchor_images import (  # noqa: F401
+    AnchorImages,
+    AnchorImageSearch,
+)
+from kfserving_tpu.explainers.anchor_text import (  # noqa: F401
+    AnchorText,
+    AnchorTextSearch,
+)
 from kfserving_tpu.explainers.anchors import (  # noqa: F401
     AnchorSearch,
     AnchorTabular,
@@ -30,8 +38,9 @@ from kfserving_tpu.explainers.saliency import SaliencyExplainer  # noqa: F401
 # One dispatch table for every deployment shape: the in-process
 # orchestrator factory, the standalone explainer server (__main__), and
 # the subprocess command builder all resolve types here.
-EXPLAINER_TYPES = ("saliency", "anchor_tabular", "lime_images",
-                   "square_attack", "fairness")
+EXPLAINER_TYPES = ("saliency", "anchor_tabular", "anchor_images",
+                   "anchor_text", "lime_images", "square_attack",
+                   "fairness")
 # Types whose load() dies without an artifact dir (saliency serves a
 # jax model, anchors needs train.npy, fairness its group config) —
 # admission validation and the subprocess command builder both reject
@@ -71,6 +80,12 @@ def build_explainer(name: str, explainer_type: str,
     if explainer_type == "anchor_tabular":
         return AnchorTabular(name, storage_uri,
                              predictor_host=predictor_host)
+    if explainer_type == "anchor_images":
+        return AnchorImages(name, storage_uri,
+                            predictor_host=predictor_host)
+    if explainer_type == "anchor_text":
+        return AnchorText(name, storage_uri,
+                          predictor_host=predictor_host)
     if explainer_type == "lime_images":
         return LimeImages(name, storage_uri,
                           predictor_host=predictor_host)

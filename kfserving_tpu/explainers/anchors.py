@@ -17,16 +17,17 @@ predictor (Ribeiro et al. 2018, "Anchors: High-Precision
 Model-Agnostic Explanations").
 
 Differences from alibi, by design:
-- the sampler and beam search are ~200 lines of numpy with *batched*
-  predictor calls — every precision estimate is one `predict(batch)`
-  round trip, which on this stack rides the dynamic batcher and the
-  TPU engine's padded buckets (alibi's sampler loops row-by-row);
+- the sampler and beam search are ~200 lines of numpy with *coalesced*
+  predictor calls — every beam level's candidate set (d features x beam
+  width precision estimates) is ONE `predict(batch)` round trip, with
+  the labels sliced back per candidate, so `:explain` latency scales
+  with anchor size, not candidate count (alibi's sampler loops
+  row-by-row);
 - precision confirmation is a fixed-budget re-estimate, not KL-LUCB
   (serving-grade simplicity; the confirm batch is 5x the search batch).
 """
 
 import inspect
-import json
 import logging
 import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -38,6 +39,143 @@ from kfserving_tpu.protocol import v1
 from kfserving_tpu.protocol.errors import InvalidInput
 
 logger = logging.getLogger("kfserving_tpu.explainers.anchors")
+
+
+async def call_labels(predict_fn: Callable, batch) -> np.ndarray:
+    """Run a (sync or async) predictor and normalize to class labels
+    [n] — probability/logit outputs are argmax'd, matching the
+    reference's ArgmaxTransformer wrap (anchor_tabular.py:47-56).
+    Shared by all three anchor modalities."""
+    out = predict_fn(batch)
+    if inspect.isawaitable(out):
+        out = await out
+    out = np.asarray(out)
+    if out.ndim > 1:
+        out = np.argmax(out, axis=-1)
+    return out.reshape(-1)
+
+
+async def estimate_precisions(predict_fn: Callable,
+                              sample_fn: Callable,
+                              label,
+                              anchors: Sequence[Tuple[int, ...]],
+                              n: int,
+                              max_rows_per_call: Optional[int] = None
+                              ) -> Dict[Tuple[int, ...], float]:
+    """Estimate every anchor's precision with COALESCED predictor round
+    trips: each anchor's n perturbations (from sample_fn(anchor, k) —
+    ndarray rows or a list, e.g. perturbed sentences) are packed into as
+    few predict calls as max_rows_per_call allows — exactly one when
+    unbounded.  d features x beam width estimates per beam level
+    therefore cost one HTTP hop (one padded TPU bucket dispatch), not
+    d x beam; the row cap exists for modalities whose rows are large
+    (full images), where a single unbounded concatenation would be
+    gigabytes.
+    """
+    if not anchors:
+        return {}
+    cap = max(1, max_rows_per_call or len(anchors) * n)
+    # Work list of (anchor, k) pieces; an anchor whose n exceeds the
+    # cap is split across calls and its hit slices re-joined below.
+    pieces: List[Tuple[Tuple[int, ...], int]] = []
+    for a in anchors:
+        remaining = n
+        while remaining > 0:
+            take = min(remaining, cap)
+            pieces.append((a, take))
+            remaining -= take
+    hits: Dict[Tuple[int, ...], List[np.ndarray]] = {a: [] for a in anchors}
+    buf: List[Any] = []
+    meta: List[Tuple[Tuple[int, ...], int]] = []
+
+    async def flush() -> None:
+        if not buf:
+            return
+        if isinstance(buf[0], np.ndarray):
+            z: Any = np.concatenate(buf, axis=0)
+        else:
+            z = [row for piece in buf for row in piece]
+        labels = await call_labels(predict_fn, z)
+        i = 0
+        for a, k in meta:
+            hits[a].append(np.asarray(labels[i:i + k]) == label)
+            i += k
+        buf.clear()
+        meta.clear()
+
+    rows = 0
+    for a, k in pieces:
+        if rows + k > cap and buf:
+            await flush()
+            rows = 0
+        buf.append(sample_fn(a, k))
+        meta.append((a, k))
+        rows += k
+    await flush()
+    return {a: float(np.mean(np.concatenate(hits[a]))) for a in anchors}
+
+
+async def beam_anchor_search(d: int,
+                             estimate_many: Callable,
+                             coverage_fn: Callable,
+                             base_precision: float,
+                             threshold: float,
+                             batch_size: int,
+                             beam_size: int,
+                             max_size: int):
+    """Shared precision-guided beam search over d boolean predicates.
+
+    The modality-specific part of every anchor explainer (tabular
+    predicates, image superpixels, text tokens) is only its sampler and
+    coverage measure; the search itself — expand the beam, estimate all
+    candidates' precision in ONE coalesced predictor call, confirm
+    passing anchors at 5x budget, prefer widest coverage — is identical
+    (Ribeiro 2018 §3; the reference reuses alibi's one AnchorBaseBeam
+    the same way, alibi explainers/anchor_base.py).
+
+    estimate_many(anchors, n) -> {anchor: precision} must issue a
+    single predict round trip for the whole level.
+    Returns (anchor, precision, met_threshold).
+    """
+    beam: List[Tuple[Tuple[int, ...], float]] = [((), base_precision)]
+    best: Optional[Tuple[Tuple[int, ...], float]] = None
+    for _ in range(max_size):
+        expansions: List[Tuple[int, ...]] = []
+        for anchor, _ in beam:
+            for j in range(d):
+                if j in anchor:
+                    continue
+                cand = tuple(sorted(anchor + (j,)))
+                if cand not in expansions:
+                    expansions.append(cand)
+        candidates = await estimate_many(expansions, batch_size)
+        if not candidates:
+            break
+        ranked = sorted(candidates.items(),
+                        key=lambda kv: (-kv[1], len(kv[0])))
+        passing = [c for c in ranked if c[1] >= threshold]
+        if passing:
+            # Confirm with a 5x budget (one more coalesced call);
+            # prefer the widest-coverage confirmed anchor of this
+            # (smallest passing) size.
+            finalists = [a for a, _ in passing[:beam_size + 1]]
+            confirm = await estimate_many(finalists, batch_size * 5)
+            confirmed = []
+            for anchor, prec in confirm.items():
+                if prec >= threshold:
+                    confirmed.append((anchor, prec, coverage_fn(anchor)))
+            if confirmed:
+                confirmed.sort(key=lambda t: -t[2])
+                anchor, prec, _ = confirmed[0]
+                return anchor, prec, True
+        beam = ranked[:beam_size]
+        if best is None or beam[0][1] > best[1]:
+            best = beam[0]
+    # No anchor met the threshold (noisy boundary instance): return the
+    # best found, flagged — the reference surfaces alibi's best-effort
+    # result the same way.
+    anchor, prec = best if best else ((), base_precision)
+    return anchor, prec, False
 
 
 class AnchorSearch:
@@ -125,19 +263,20 @@ class AnchorSearch:
         return z
 
     async def _labels(self, batch: np.ndarray) -> np.ndarray:
-        out = self.predict_fn(batch)
-        if inspect.isawaitable(out):
-            out = await out
-        out = np.asarray(out)
-        if out.ndim > 1:  # probabilities/logits -> class
-            out = np.argmax(out, axis=-1)
-        return out.reshape(-1)
+        return await call_labels(self.predict_fn, batch)
 
     async def _precision(self, x: np.ndarray, label,
                          anchor: Tuple[int, ...], n: int) -> float:
-        z = self._sample(x, anchor, n)
-        labels = await self._labels(z)
-        return float(np.mean(labels == label))
+        out = await self._precision_many(x, label, [anchor], n)
+        return out[anchor]
+
+    async def _precision_many(self, x: np.ndarray, label,
+                              anchors: Sequence[Tuple[int, ...]],
+                              n: int) -> Dict[Tuple[int, ...], float]:
+        return await estimate_precisions(
+            self.predict_fn,
+            lambda anchor, k: self._sample(x, anchor, k),
+            label, anchors, n)
 
     def _coverage(self, x: np.ndarray, anchor: Tuple[int, ...]) -> float:
         mask = np.ones(len(self.train), bool)
@@ -166,46 +305,12 @@ class AnchorSearch:
         if base_prec >= threshold:
             return self._result(x, label, (), base_prec)
 
-        beam: List[Tuple[Tuple[int, ...], float]] = [((), base_prec)]
-        best: Optional[Tuple[Tuple[int, ...], float]] = None
-        for _ in range(max_size):
-            candidates: Dict[Tuple[int, ...], float] = {}
-            for anchor, _ in beam:
-                for j in range(d):
-                    if j in anchor:
-                        continue
-                    cand = tuple(sorted(anchor + (j,)))
-                    if cand in candidates:
-                        continue
-                    candidates[cand] = await self._precision(
-                        x, label, cand, batch_size)
-            if not candidates:
-                break
-            ranked = sorted(candidates.items(),
-                            key=lambda kv: (-kv[1], len(kv[0])))
-            passing = [c for c in ranked if c[1] >= threshold]
-            if passing:
-                # Confirm with a 5x budget; prefer the widest-coverage
-                # confirmed anchor of this (smallest passing) size.
-                confirmed = []
-                for anchor, _ in passing[:beam_size + 1]:
-                    prec = await self._precision(
-                        x, label, anchor, batch_size * 5)
-                    if prec >= threshold:
-                        confirmed.append(
-                            (anchor, prec, self._coverage(x, anchor)))
-                if confirmed:
-                    confirmed.sort(key=lambda t: -t[2])
-                    anchor, prec, _ = confirmed[0]
-                    return self._result(x, label, anchor, prec)
-            beam = ranked[:beam_size]
-            if best is None or beam[0][1] > best[1]:
-                best = beam[0]
-        # No anchor met the threshold (noisy boundary instance): return
-        # the best found, flagged — the reference surfaces alibi's
-        # best-effort result the same way.
-        anchor, prec = best if best else ((), base_prec)
-        return self._result(x, label, anchor, prec, met_threshold=False)
+        anchor, prec, met = await beam_anchor_search(
+            d,
+            lambda anchors, n: self._precision_many(x, label, anchors, n),
+            lambda anchor: self._coverage(x, anchor),
+            base_prec, threshold, batch_size, beam_size, max_size)
+        return self._result(x, label, anchor, prec, met_threshold=met)
 
     def _result(self, x, label, anchor, precision,
                 met_threshold: bool = True) -> Dict[str, Any]:

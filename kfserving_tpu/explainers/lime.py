@@ -129,7 +129,11 @@ class LimeImageSearch:
         # Exponential kernel on cosine distance to the full image.
         frac = z.sum(axis=1) / s
         dist = 1.0 - frac  # cosine distance to all-ones for binary z
-        weights = np.sqrt(np.exp(-(dist ** 2) / self.kernel_width ** 2))
+        # _ridge applies this once when forming the normal equations
+        # (gram = (X*w)^T X), so it must be the full kernel value, not
+        # its square root, for the solved system to be
+        # X^T diag(kernel) X (LIME's weighted least squares).
+        weights = np.exp(-(dist ** 2) / self.kernel_width ** 2)
 
         order = np.argsort(y[0])[::-1][:top_labels]
         masks: List[List[List[int]]] = []

@@ -1,0 +1,281 @@
+"""AnchorImages + AnchorText explainer tests (VERDICT r3 item 7).
+
+Mirrors the reference's remaining two anchor modalities: alibiexplainer
+dispatches AnchorImages / AnchorText alongside AnchorTabular (reference
+python/alibiexplainer/alibiexplainer/explainer.py:54-60,
+anchor_images.py:26-50, anchor_text.py:28-61).  Done-criteria from the
+verdict: an image anchor test (segment set with precision >= threshold)
+and a text anchor test, served via ExplainerSpec like anchor_tabular.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.explainers import build_explainer
+from kfserving_tpu.explainers.anchor_images import (
+    AnchorImages,
+    AnchorImageSearch,
+)
+from kfserving_tpu.explainers.anchor_text import (
+    AnchorText,
+    AnchorTextSearch,
+)
+
+# ---------------------------------------------------------------- images
+
+
+def bright_pixel_classifier(batch):
+    """Class 1 iff the sentinel pixel (2, 2) is bright.  Dropping the
+    segment that contains it (mean fill over a dark segment) flips the
+    class, so that segment is the ground-truth anchor."""
+    batch = np.asarray(batch, np.float64)
+    return (batch[:, 2, 2, 0] > 0.5).astype(np.int64)
+
+
+def _sentinel_image(h=16, w=16):
+    img = np.zeros((h, w, 1))
+    img[2, 2, 0] = 1.0
+    return img
+
+
+async def test_image_anchor_finds_discriminative_segment():
+    search = AnchorImageSearch(bright_pixel_classifier, n_segments=16,
+                               seed=0)
+    exp = await search.explain(_sentinel_image(), threshold=0.95)
+    assert exp["met_threshold"]
+    assert exp["precision"] >= 0.95
+    assert exp["prediction"] == 1
+    # The anchor is exactly the superpixel holding the sentinel pixel.
+    assert len(exp["anchor_segments"]) == 1
+    mask = np.asarray(exp["mask"])
+    assert mask.shape == (16, 16)
+    assert mask[2, 2] == 1
+    assert 0.0 < exp["coverage"] <= 1.0
+
+
+async def test_image_anchor_one_predictor_call_per_beam_level():
+    """The coalescing contract extends to images: each beam level's
+    candidate superpixel sets ride one predictor batch."""
+    calls = []
+
+    def counting(batch):
+        calls.append(len(batch))
+        return bright_pixel_classifier(batch)
+
+    search = AnchorImageSearch(counting, n_segments=16, seed=0)
+    exp = await search.explain(_sentinel_image(), threshold=0.95,
+                               batch_size=16)
+    assert exp["met_threshold"]
+    levels = len(exp["anchor_segments"]) or 1
+    # 1 label call + 1 base-precision call + per level <= 2 coalesced.
+    assert len(calls) <= 2 + 2 * levels, calls
+    assert max(calls) > 16  # whole levels, not per-candidate calls
+
+
+async def test_image_anchor_transport_chunked_by_bytes():
+    """Large images must not be concatenated into one unbounded predict
+    payload: max_call_bytes caps rows per call while precision stays
+    per-level exact (code-review r4: a 224px image at defaults would
+    otherwise build a ~2 GB batch)."""
+    calls = []
+
+    def counting(batch):
+        calls.append(np.asarray(batch).nbytes)
+        return bright_pixel_classifier(batch)
+
+    # 16x16x1 float64 images are 2048 bytes; cap at 8 rows per call.
+    search = AnchorImageSearch(counting, n_segments=16,
+                               max_call_bytes=8 * 2048, seed=0)
+    exp = await search.explain(_sentinel_image(), threshold=0.95,
+                               batch_size=16)
+    assert exp["met_threshold"]
+    assert exp["precision"] >= 0.95
+    # Every call respected the byte budget (the first label call is one
+    # image and trivially under it).
+    assert max(calls) <= 8 * 2048
+
+
+async def test_image_anchor_probability_predictor_argmaxed():
+    def proba(batch):
+        hot = bright_pixel_classifier(batch)
+        return np.stack([1.0 - hot, hot.astype(np.float64)], axis=-1)
+
+    search = AnchorImageSearch(proba, n_segments=16, seed=1)
+    exp = await search.explain(_sentinel_image(), threshold=0.9)
+    assert exp["prediction"] == 1
+    assert exp["precision"] >= 0.9
+
+
+async def test_image_anchor_grayscale_2d_input():
+    search = AnchorImageSearch(
+        lambda b: (np.asarray(b)[:, 2, 2, 0] > 0.5).astype(int),
+        n_segments=16, seed=0)
+    exp = await search.explain(_sentinel_image()[..., 0], threshold=0.9)
+    assert exp["met_threshold"]
+
+
+# ----------------------------------------------------------------- text
+
+
+def keyword_classifier(batch):
+    return np.asarray(
+        [1 if "good" in str(s).split() else 0 for s in batch])
+
+
+async def test_text_anchor_finds_keyword():
+    search = AnchorTextSearch(keyword_classifier, seed=0)
+    exp = await search.explain("this movie is good really",
+                               threshold=0.95)
+    assert exp["met_threshold"]
+    assert exp["precision"] >= 0.95
+    assert exp["anchor"] == ["good"]
+    assert exp["positions"] == [3]
+    assert exp["prediction"] == 1
+
+
+async def test_text_anchor_negative_class_base_rate():
+    """A document the classifier rejects everywhere: the empty anchor
+    already has precision 1.0 (UNK never introduces the keyword)."""
+    search = AnchorTextSearch(keyword_classifier, seed=0)
+    exp = await search.explain("a plainly dull film", threshold=0.95)
+    assert exp["met_threshold"]
+    assert exp["anchor"] == []
+    assert exp["prediction"] == 0
+
+
+async def test_text_anchor_conjunction():
+    """Two keywords required -> two-token anchor."""
+    def both(batch):
+        return np.asarray(
+            [1 if {"very", "good"} <= set(str(s).split()) else 0
+             for s in batch])
+
+    search = AnchorTextSearch(both, seed=0)
+    exp = await search.explain("a very good film indeed",
+                               threshold=0.95)
+    assert exp["met_threshold"]
+    assert sorted(exp["anchor"]) == ["good", "very"]
+
+
+async def test_text_anchor_transport_chunked_by_bytes():
+    """Long documents must not coalesce into one predict payload past
+    the byte budget (the server caps bodies at 100 MB)."""
+    calls = []
+
+    def counting(batch):
+        calls.append(sum(len(str(s)) for s in batch))
+        return keyword_classifier(batch)
+
+    doc = "filler " * 40 + "good ending"  # 42 tokens, ~290 bytes
+    search = AnchorTextSearch(counting, max_call_bytes=16_000, seed=0)
+    exp = await search.explain(doc, threshold=0.95, batch_size=32)
+    assert exp["met_threshold"]
+    assert "good" in exp["anchor"]
+    assert max(calls) <= 16_000
+
+
+async def test_text_anchor_rejects_empty():
+    from kfserving_tpu.protocol.errors import InvalidInput
+
+    search = AnchorTextSearch(keyword_classifier)
+    with pytest.raises(InvalidInput):
+        await search.explain("   ")
+
+
+# ------------------------------------------------------------- dispatch
+
+
+def test_build_explainer_dispatch_media(tmp_path):
+    img = build_explainer("e", "anchor_images", "",
+                          predictor_host="h:1")
+    assert isinstance(img, AnchorImages)
+    txt = build_explainer("e", "anchor_text", "",
+                          predictor_host="h:1")
+    assert isinstance(txt, AnchorText)
+
+
+def test_media_anchor_config_artifact(tmp_path):
+    d = tmp_path / "cfg"
+    d.mkdir()
+    (d / "anchor_text.json").write_text(json.dumps(
+        {"unk_token": "<mask>", "p_sample": 0.4, "seed": 3}))
+    txt = AnchorText("e", str(d), predict_fn=keyword_classifier)
+    txt.load()
+    assert txt.search.unk_token == "<mask>"
+    assert txt.search.p_sample == 0.4
+
+
+# ------------------------------------------------------------- serving
+
+
+async def test_served_anchor_text_through_control_plane(tmp_path):
+    """ExplainerSpec(explainer_type=anchor_text) deploys through the
+    controller next to an sklearn text-pipeline predictor and serves
+    :explain via the router's verb split — the reference's alibi
+    deployment shape for text models."""
+    import aiohttp
+    import joblib
+    import pytest
+
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.feature_extraction.text import CountVectorizer
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.pipeline import make_pipeline
+
+    from kfserving_tpu.control.controller import Controller
+    from kfserving_tpu.control.orchestrator import InProcessOrchestrator
+    from kfserving_tpu.control.router import IngressRouter
+    from kfserving_tpu.control.spec import (
+        ExplainerSpec,
+        InferenceService,
+        PredictorSpec,
+    )
+
+    docs = (["a good movie", "really good film", "good fun overall",
+             "so good it hurts"] * 5
+            + ["a dull movie", "really bad film", "awful slog overall",
+               "so bad it hurts"] * 5)
+    labels = [1] * 20 + [0] * 20
+    clf = make_pipeline(CountVectorizer(), LogisticRegression())
+    clf.fit(docs, labels)
+
+    pred_dir = tmp_path / "pred"
+    pred_dir.mkdir()
+    joblib.dump(clf, str(pred_dir / "model.joblib"))
+    exp_dir = tmp_path / "exp"
+    exp_dir.mkdir()
+    (exp_dir / "anchor_text.json").write_text(json.dumps(
+        {"precision_threshold": 0.9, "batch_size": 32}))
+
+    orch = InProcessOrchestrator()
+    controller = Controller(orch)
+    router = IngressRouter(controller)
+    await router.start_async()
+    try:
+        isvc = InferenceService(
+            name="senti",
+            predictor=PredictorSpec(framework="sklearn",
+                                    storage_uri=str(pred_dir)),
+            explainer=ExplainerSpec(explainer_type="anchor_text",
+                                    storage_uri=str(exp_dir)))
+        await controller.apply(isvc)
+        for comp in orch.state["default/senti/explainer"].replicas:
+            comp.handle.repository.get_model("senti").predictor_host = \
+                f"127.0.0.1:{router.http_port}/direct/predictor"
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f"http://127.0.0.1:{router.http_port}"
+                    "/v1/models/senti:explain",
+                    json={"instances": ["a good movie overall"]}) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+        assert out["meta"]["name"] == "AnchorText"
+        data = out["data"]
+        assert data["precision"] >= 0.9
+        assert data["met_threshold"]
+        assert "good" in data["anchor"]
+    finally:
+        await router.stop_async()
+        await orch.shutdown()

@@ -581,6 +581,16 @@ def test_s3_payload_reads_named_profile(tmp_path):
         "serving.kfserving.io/s3-verifyssl"] == "0"
 
 
+def test_s3_payload_bad_profile_is_value_error(tmp_path):
+    """A wrong --profile surfaces as a clean ValueError naming the file
+    and profile, not a raw configparser traceback (advisor r3)."""
+    from kfserving_tpu.client.creds import s3_secret_payload
+
+    with pytest.raises(ValueError, match="staging"):
+        s3_secret_payload(_aws_ini(tmp_path, profile="prod"),
+                          s3_profile="staging")
+
+
 def test_gcs_payload_rejects_non_json(tmp_path):
     from kfserving_tpu.client.creds import gcs_secret_payload
 

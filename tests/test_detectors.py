@@ -132,6 +132,20 @@ async def test_drift_detector_fill_then_verdicts(tmp_path):
     assert min(out["p_values"]) < out["threshold"]
 
 
+async def test_drift_detector_rejects_zero_overrides(tmp_path):
+    """Explicit window=0 / p_value=0.0 must be rejected, not silently
+    replaced by the config default (advisor r3)."""
+    rng = np.random.default_rng(7)
+    d = tmp_path / "drift0"
+    d.mkdir()
+    np.save(str(d / "train.npy"), rng.normal(size=(100, 3)))
+    from kfserving_tpu.protocol.errors import InvalidInput
+    with pytest.raises(InvalidInput, match="window"):
+        KSDriftDetector("dd", str(d), window=0).load()
+    with pytest.raises(InvalidInput, match="p_value"):
+        KSDriftDetector("dd", str(d), p_value=0.0).load()
+
+
 def test_build_detector_dispatch(tmp_path):
     rng = np.random.default_rng(6)
     path = _outlier_dir(tmp_path, rng)
