@@ -59,6 +59,7 @@ def main():
         "longctx": C.bench_longctx,
         "overload": C.bench_overload,
         "bert_flash_ab": C.bench_bert_flash_ab,
+        "generate": C.bench_generate,
     }
     results = {}
     for name, fn in matrix.items():

@@ -29,6 +29,11 @@ DEFAULT_PREDICTOR_RUNTIMES = {
         "multiModel": True,
         "defaultTimeout": 300,
     },
+    "generative": {
+        "module": "kfserving_tpu.predictors.llmserver",
+        "multiModel": False,
+        "defaultTimeout": 300,
+    },
     "sklearn": {
         "module": "kfserving_tpu.predictors.sklearnserver",
         "multiModel": False,

@@ -205,6 +205,16 @@ def default_model_factory(component_id: str, spec):
                     "dp": par.dp, "tp": par.tp, "sp": par.sp}
             return JaxModel(isvc_name, spec.storage_uri,
                             config_overrides=overrides)
+        if spec.framework == "generative":
+            from kfserving_tpu.predictors.llm import GenerativeModel
+
+            par = getattr(spec, "parallelism", None)
+            overrides = {}
+            if par is not None and par.chips_per_replica > 1:
+                overrides["mesh"] = {
+                    "dp": par.dp, "tp": par.tp, "sp": par.sp}
+            return GenerativeModel(isvc_name, spec.storage_uri,
+                                   config_overrides=overrides)
         if spec.framework == "sklearn":
             from kfserving_tpu.predictors.sklearnserver import SKLearnModel
 

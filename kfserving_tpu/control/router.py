@@ -51,6 +51,13 @@ class IngressRouter:
         r.add("POST", "/v1/models/{name}:explain", self._explain)
         r.add("POST", "/v2/models/{name}/infer", self._predict)
         r.add("POST", "/v2/models/{name}/explain", self._explain)
+        # Generative verb: routes to the predictor component like
+        # :predict (generation IS prediction in the component model).
+        # Non-streaming only at the ingress — token streams are served
+        # on the replica's own /generate_stream route; a buffering
+        # proxy would defeat them.
+        r.add("POST", "/v1/models/{name}:generate", self._generate)
+        r.add("POST", "/v2/models/{name}/generate", self._generate)
         r.add("GET", "/v1/models/{name}", self._health)
         # Direct-to-predictor lane for transformer->predictor hops (the
         # reference's cluster-local gateway, constants.go:121-127).
@@ -227,6 +234,9 @@ class IngressRouter:
 
     async def _explain(self, req: Request) -> Response:
         return await self._proxy(req, "explain")
+
+    async def _generate(self, req: Request) -> Response:
+        return await self._proxy(req, "predict", component="predictor")
 
     async def _predict_direct(self, req: Request) -> Response:
         return await self._proxy(req, "predict", component="predictor",

@@ -22,7 +22,8 @@ from typing import Any, Dict, List, Optional
 # tfserving — those artifacts convert offline; 'pytorch' serves the
 # reference's pytorchserver contract on the host CPU for migration).
 PREDICTOR_FRAMEWORKS = (
-    "jax", "sklearn", "xgboost", "lightgbm", "pmml", "pytorch", "custom")
+    "jax", "generative", "sklearn", "xgboost", "lightgbm", "pmml",
+    "pytorch", "custom")
 
 NAME_REGEX = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")  # k8s DNS-1035
 STORAGE_URI_PREFIXES = (

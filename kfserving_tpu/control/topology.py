@@ -14,7 +14,7 @@ So the selector is a small solver over the published slice shapes:
 
     placement = select_topology(predictor_spec, isvc.annotations)
 
-- gate: only chip-owning predictors (framework "jax", or "custom" with
+- gate: only chip-owning predictors (framework "jax"/"generative", or "custom" with
   an explicit generation annotation) get a placement — CPU frameworks
   return None, mirroring the reference's "GPU requested" gate;
 - the mesh size `parallelism.chips_per_replica` picks the smallest
@@ -117,7 +117,8 @@ def select_topology(predictor_spec,
     annotations = annotations or {}
     generation = annotations.get(ANNOTATION_GENERATION)
     framework = getattr(predictor_spec, "framework", None)
-    if framework != "jax" and not (framework == "custom" and generation):
+    if framework not in ("jax", "generative") and not (
+            framework == "custom" and generation):
         return None
     generation = generation or DEFAULT_GENERATION
     shapes = GENERATIONS.get(generation)

@@ -1,0 +1,498 @@
+"""GenerationEngine: KV-cache incremental decoding with continuous
+batching.
+
+The reference has no generative serving at all — models are opaque
+request/response artifacts (reference pkg/apis/serving/v1beta1/
+predictor.go:33-59) and its batcher coalesces whole requests
+(pkg/batcher/handler.go:129-150).  Token generation breaks that model:
+one request is hundreds of sequential device steps, and throughput
+comes from batching *steps across requests*, not requests.  This engine
+is the TPU-first design for that:
+
+- **slot caches, static shapes**: the KV cache is a fixed pool of
+  `max_slots` sequence slots, per layer [S, max_seq, H, D].  The decode
+  step is ONE jit-compiled program over all S slots, compiled once and
+  reused for the life of the server — requests joining or leaving never
+  change a shape, so XLA never recompiles (the continuous-batching
+  analogue of the engine's batch buckets).
+- **prefill/decode split**: prompt ingestion runs as a separate
+  bucketed forward (suffix-padded, flash-eligible at long L, one
+  compile per bucket) that returns the prompt's k/v for every layer;
+  a jitted scatter inserts them into a free slot.  Decode then costs
+  O(1) tokens per step.
+- **continuous batching**: new requests are admitted at step
+  boundaries — prefill, insert, then the request's slot joins the next
+  decode step alongside in-flight sequences; finished slots free
+  immediately (EOS or token budget).  The admission policy is
+  prefill-priority: arrivals never wait for the current generation
+  wave to drain (the "continuous" in continuous batching).
+- **on-device sampling**: greedy and temperature (Gumbel trick) per
+  slot; only the [S] int32 token vector crosses the host boundary per
+  step — never the [S, V] logits (1.6 MB/step for a GPT-2 vocab; the
+  host link is the serving bottleneck, ROOFLINE.md).
+- **donated caches**: the decode step donates the cache buffers, so
+  XLA updates them in place — HBM holds ONE cache pool, not
+  step-transient copies.
+
+Cache HBM is accounted via `cache_bytes()` so the predictor can admit
+params + cache against engine/hbm.py's budget.
+"""
+
+import asyncio
+import concurrent.futures
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
+
+logger = logging.getLogger("kfserving_tpu.engine.generator")
+
+
+@dataclass
+class _Request:
+    prompt_ids: np.ndarray
+    max_new_tokens: int
+    temperature: float
+    out: asyncio.Queue = field(default_factory=asyncio.Queue)
+
+
+@dataclass
+class _Active:
+    req: _Request
+    length: int          # valid cache entries (prompt + generated so far)
+    last_token: int      # token to feed at position `length`
+    generated: int
+
+
+class GenerationEngine:
+    """Continuous-batching token generation over one device/mesh.
+
+    module: a DecoderLM-contract Flax module (models/decoder.py): full
+        forward with `return_cache=True` and decode with `kv_cache` +
+        `positions`.
+    variables: initialized/restored model variables.
+    """
+
+    def __init__(self, module, variables, *,
+                 max_slots: int = 8,
+                 max_seq: int = 512,
+                 prefill_buckets: Optional[List[int]] = None,
+                 eos_id: Optional[int] = None,
+                 rng_seed: int = 0,
+                 mesh=None,
+                 name: str = "decoder"):
+        import jax
+        import jax.numpy as jnp
+
+        self._jax = jax
+        self._jnp = jnp
+        self.module = module
+        self.variables = variables
+        self.max_slots = int(max_slots)
+        self.max_seq = int(max_seq)
+        cfg = module.config
+        if self.max_seq > cfg.max_seq:
+            raise InvalidInput(
+                f"engine max_seq {self.max_seq} exceeds the model's "
+                f"position table {cfg.max_seq}")
+        self.eos_id = eos_id
+        self.name = name
+        self.mesh = mesh
+        buckets = sorted(set(prefill_buckets or
+                             _pow2_buckets(self.max_seq)))
+        if buckets[-1] > self.max_seq:
+            raise InvalidInput(
+                f"prefill bucket {buckets[-1]} exceeds max_seq "
+                f"{self.max_seq}")
+        self.prefill_buckets = buckets
+        self._rng = jax.random.PRNGKey(rng_seed)
+        self._step_counter = 0
+
+        n_layers = cfg.num_layers
+        cache_shape = (self.max_slots, self.max_seq, cfg.num_heads,
+                       cfg.head_dim)
+        cache_dtype = cfg.dtype
+        self._cache_shape = cache_shape
+        self._cache_dtype = cache_dtype
+        self._caches = [
+            (jnp.zeros(cache_shape, cache_dtype),
+             jnp.zeros(cache_shape, cache_dtype))
+            for _ in range(n_layers)
+        ]
+        if mesh is not None:
+            # Tensor parallelism: the cache shards on the heads axis,
+            # exactly like the q/k/v projections that fill it
+            # (parallel/sharding.py transformer_rules) — cache writes
+            # and decode attention stay device-local per head group;
+            # the per-layer psum after the out-projection is the only
+            # collective.  Callers pass variables already sharded.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            tp = mesh.shape.get("tp", 1)
+            heads_axis = "tp" if cfg.num_heads % max(tp, 1) == 0 else None
+            sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, heads_axis, None))
+            self._caches = [
+                (jax.device_put(k, sharding), jax.device_put(v, sharding))
+                for k, v in self._caches
+            ]
+
+        def sample(logits, rng, temps):
+            # logits [B, V] float32; temps [B]; 0 = greedy.
+            greedy = jnp.argmax(logits, axis=-1)
+            gumbel = jax.random.gumbel(rng, logits.shape)
+            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            sampled = jnp.argmax(scaled + gumbel, axis=-1)
+            return jnp.where(temps <= 0.0, greedy,
+                             sampled).astype(jnp.int32)
+
+        def decode_fn(variables, caches, tokens, positions, rng, temps):
+            logits, new_caches = module.apply(
+                variables, tokens[:, None], positions=positions,
+                kv_cache=caches)
+            next_tokens = sample(logits[:, 0], rng, temps)
+            return next_tokens, new_caches
+
+        # Donate the caches: in-place HBM update, one resident pool.
+        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+
+        def prefill_fn(variables, ids, lengths, rng, temps):
+            logits, caches = module.apply(variables, ids,
+                                          kv_lengths=lengths,
+                                          return_cache=True)
+            idx = (lengths - 1)[:, None, None]
+            last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+            first_tokens = sample(last, rng, temps)
+            return first_tokens, caches
+
+        # One executable per prompt bucket (jit caches by shape).
+        self._prefill = jax.jit(prefill_fn)
+
+        def insert_fn(caches, new_caches, slot):
+            out = []
+            for (k_cache, v_cache), (k_new, v_new) in zip(caches,
+                                                          new_caches):
+                lb = k_new.shape[1]
+                out.append((
+                    k_cache.at[slot, :lb].set(
+                        k_new[0].astype(k_cache.dtype)),
+                    v_cache.at[slot, :lb].set(
+                        v_new[0].astype(v_cache.dtype)),
+                ))
+            return out
+
+        self._insert = jax.jit(insert_fn, donate_argnums=(0,))
+
+        # Single worker: device steps are sequential by design; the
+        # executor keeps them off the asyncio serving loop.
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1,
+            thread_name_prefix=f"generator-{name}")
+        self._slots: List[Optional[_Active]] = [None] * self.max_slots
+        self._pending: deque = deque()
+        self._wakeup: Optional[asyncio.Event] = None
+        self._loop_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+        # stats
+        self.tokens_generated = 0
+        self.decode_steps = 0
+        self.prefills = 0
+        self.requests_finished = 0
+        self._occupied_slot_steps = 0
+        self._decode_device_s = 0.0
+        self._prefill_device_s = 0.0
+
+    # -- public API --------------------------------------------------------
+    def cache_bytes(self) -> int:
+        per_buf = int(np.prod(self._cache_shape)) * \
+            np.dtype(self._cache_dtype).itemsize
+        return per_buf * 2 * len(self._caches)
+
+    def param_bytes(self) -> int:
+        jax = self._jax
+        return sum(int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize
+                   for x in jax.tree.leaves(self.variables))
+
+    async def generate(self, prompt_ids, max_new_tokens: int = 32,
+                       temperature: float = 0.0
+                       ) -> AsyncIterator[Tuple[int, Optional[str]]]:
+        """Yields (token_id, finish_reason) events.  Intermediate
+        tokens arrive as (id, None); the stream ends with either
+        (id, 'length') — the budget-final token — or (None, 'eos'),
+        since EOS is a stop signal, not content.  Engine failures
+        surface as InferenceError mid-stream."""
+        req = self.submit(prompt_ids, max_new_tokens, temperature)
+        async for event in self.stream(req):
+            yield event
+
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               temperature: float = 0.0) -> _Request:
+        """Validate and enqueue a request NOW (InvalidInput surfaces to
+        the caller before any response bytes are committed — the
+        streaming route depends on this).  Pair with `stream()`."""
+        return self._submit(prompt_ids, max_new_tokens, temperature)
+
+    async def stream(self, req: _Request
+                     ) -> AsyncIterator[Tuple[Optional[int],
+                                              Optional[str]]]:
+        while True:
+            token, reason = await req.out.get()
+            if reason is not None and reason.startswith("error"):
+                raise InferenceError(reason)
+            yield token, reason
+            if reason is not None:
+                return
+
+    async def complete(self, prompt_ids, max_new_tokens: int = 32,
+                       temperature: float = 0.0
+                       ) -> Tuple[List[int], str]:
+        tokens: List[int] = []
+        reason = "length"
+        async for token, fin in self.generate(prompt_ids,
+                                              max_new_tokens,
+                                              temperature):
+            if token is not None:
+                tokens.append(token)
+            if fin is not None:
+                reason = fin
+        return tokens, reason
+
+    def _submit(self, prompt_ids, max_new_tokens, temperature) -> _Request:
+        if self._closed:
+            raise InvalidInput(f"generator {self.name} is closed")
+        ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise InvalidInput("empty prompt")
+        if ids.size > self.prefill_buckets[-1]:
+            raise InvalidInput(
+                f"prompt length {ids.size} exceeds the largest prefill "
+                f"bucket {self.prefill_buckets[-1]}")
+        if max_new_tokens < 1:
+            raise InvalidInput("max_new_tokens must be >= 1")
+        # Clamp the budget to cache capacity: prompt + generated tokens
+        # must fit max_seq.
+        budget = min(int(max_new_tokens), self.max_seq - int(ids.size))
+        if budget < 1:
+            raise InvalidInput(
+                f"prompt length {ids.size} leaves no room to generate "
+                f"within max_seq {self.max_seq}")
+        req = _Request(ids, budget, float(temperature))
+        self._pending.append(req)
+        self._ensure_loop()
+        return req
+
+    def _ensure_loop(self):
+        if self._loop_task is None or self._loop_task.done():
+            self._wakeup = asyncio.Event()
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._run())
+        self._wakeup.set()
+
+    async def close(self):
+        self._closed = True
+        if self._loop_task is not None:
+            if self._wakeup is not None:
+                self._wakeup.set()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+        self._executor.shutdown(wait=True)
+
+    def shutdown_nowait(self):
+        """Synchronous best-effort teardown (repository unload runs
+        outside async context): stop admitting, let the scheduler task
+        drain, release the worker thread without joining."""
+        self._closed = True
+        if self._wakeup is not None:
+            self._wakeup.set()
+        self._executor.shutdown(wait=False)
+
+    def stats(self) -> Dict[str, Any]:
+        steps = max(1, self.decode_steps)
+        return {
+            "tokens_generated": self.tokens_generated,
+            "decode_steps": self.decode_steps,
+            "prefills": self.prefills,
+            "requests_finished": self.requests_finished,
+            "slot_occupancy": round(
+                self._occupied_slot_steps / (steps * self.max_slots), 4),
+            "max_slots": self.max_slots,
+            "max_seq": self.max_seq,
+            "cache_bytes": self.cache_bytes(),
+            "decode_device_s": round(self._decode_device_s, 4),
+            "prefill_device_s": round(self._prefill_device_s, 4),
+        }
+
+    # -- scheduler ---------------------------------------------------------
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _next_rng(self):
+        jax = self._jax
+        self._step_counter += 1
+        return jax.random.fold_in(self._rng, self._step_counter)
+
+    async def _run(self):
+        try:
+            await self._run_inner()
+        except Exception as e:  # decode/device failure: global
+            logger.exception("generation scheduler failed")
+            self._fail_all(f"error: generation failed: {e}")
+        finally:
+            # A close()/unload() with work in flight must not strand
+            # awaiters on queues that will never receive a terminal
+            # event.
+            if self._closed:
+                self._fail_all("error: generator closed")
+
+    def _fail_all(self, reason: str):
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                s.req.out.put_nowait((None, reason))
+                self._slots[i] = None
+        while self._pending:
+            self._pending.popleft().out.put_nowait((None, reason))
+
+    async def _run_inner(self):
+        loop = asyncio.get_event_loop()
+        while not self._closed:
+            admitted = False
+            while self._pending and self._free_slot() is not None:
+                req = self._pending.popleft()
+                slot = self._free_slot()
+                try:
+                    first = await loop.run_in_executor(
+                        self._executor, self._do_prefill, req, slot)
+                except Exception as e:
+                    # A prefill failure (e.g. OOM compiling a new
+                    # bucket) fails THAT request; in-flight slots
+                    # keep decoding.
+                    logger.exception("prefill failed")
+                    req.out.put_nowait(
+                        (None, f"error: prefill failed: {e}"))
+                    continue
+                # Slot bookkeeping and token delivery happen here on
+                # the loop thread: asyncio.Queue is not thread-safe.
+                self._slots[slot] = _Active(
+                    req=req, length=req.prompt_ids.size,
+                    last_token=first, generated=0)
+                self._emit(slot, first)
+                admitted = True
+            active = [i for i, s in enumerate(self._slots)
+                      if s is not None]
+            if not active:
+                if not self._pending:
+                    self._wakeup.clear()
+                    if admitted:
+                        continue
+                    try:
+                        await asyncio.wait_for(self._wakeup.wait(),
+                                               timeout=1.0)
+                    except asyncio.TimeoutError:
+                        if not self._pending and not any(
+                                s is not None for s in self._slots):
+                            return  # idle: let the loop die; resubmit restarts
+                continue
+            tokens = await loop.run_in_executor(
+                self._executor, self._do_decode_step)
+            self._distribute(tokens)
+
+    def _do_prefill(self, req: _Request, slot: int) -> int:
+        """Runs on the executor thread: bucket-pad, prefill, insert.
+        Returns the first generated token; slot state is installed by
+        the scheduler on the loop thread."""
+        jnp = self._jnp
+        n = req.prompt_ids.size
+        bucket = next(b for b in self.prefill_buckets if b >= n)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = req.prompt_ids
+        lengths = np.asarray([n], np.int32)
+        temps = np.asarray([req.temperature], np.float32)
+        t0 = time.perf_counter()
+        first, new_caches = self._prefill(
+            self.variables, jnp.asarray(ids), jnp.asarray(lengths),
+            self._next_rng(), jnp.asarray(temps))
+        self._caches = self._insert(self._caches, new_caches,
+                                    np.int32(slot))
+        first = int(self._jax.block_until_ready(first)[0])
+        self._prefill_device_s += time.perf_counter() - t0
+        self.prefills += 1
+        return first
+
+    def _do_decode_step(self) -> np.ndarray:
+        jnp = self._jnp
+        tokens = np.zeros(self.max_slots, np.int32)
+        positions = np.zeros(self.max_slots, np.int32)
+        temps = np.zeros(self.max_slots, np.float32)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tokens[i] = s.last_token
+            positions[i] = s.length
+            temps[i] = s.req.temperature
+        t0 = time.perf_counter()
+        next_tokens, self._caches = self._decode(
+            self.variables, self._caches, jnp.asarray(tokens),
+            jnp.asarray(positions), self._next_rng(),
+            jnp.asarray(temps))
+        out = np.asarray(self._jax.block_until_ready(next_tokens))
+        self._decode_device_s += time.perf_counter() - t0
+        return out
+
+    def _emit(self, slot: int, token: int):
+        """Account a newly produced token for `slot` and deliver it (or
+        the finish marker) to the request's stream.
+
+        Invariant: `length` counts tokens whose k/v are IN the cache;
+        `last_token` is the token the next decode step feeds at
+        position `length`.  The produced token's k/v are NOT in the
+        cache yet — the step that consumes it writes them (so this
+        method never touches `length`)."""
+        s = self._slots[slot]
+        s.generated += 1
+        self.tokens_generated += 1
+        finished = None
+        if self.eos_id is not None and token == self.eos_id:
+            finished = "eos"
+        elif s.generated >= s.req.max_new_tokens:
+            finished = "length"
+        if finished == "eos":
+            # EOS is a stop signal, not content.
+            s.req.out.put_nowait((None, "eos"))
+        else:
+            s.req.out.put_nowait((token, finished))
+        if finished is not None:
+            self._slots[slot] = None
+            self.requests_finished += 1
+        else:
+            s.last_token = token
+
+    def _distribute(self, tokens: np.ndarray):
+        self.decode_steps += 1
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            self._occupied_slot_steps += 1
+            # The step just executed wrote the fed token's k/v at
+            # position s.length: the cache grew by one.
+            s.length += 1
+            self._emit(i, int(tokens[i]))
+
+
+def _pow2_buckets(max_seq: int) -> List[int]:
+    out, b = [], 16
+    while b < max_seq:
+        out.append(b)
+        b *= 2
+    out.append(max_seq)
+    return out

@@ -141,12 +141,14 @@ async def beam_anchor_search(d: int,
     best: Optional[Tuple[Tuple[int, ...], float]] = None
     for _ in range(max_size):
         expansions: List[Tuple[int, ...]] = []
+        seen = set()
         for anchor, _ in beam:
             for j in range(d):
                 if j in anchor:
                     continue
                 cand = tuple(sorted(anchor + (j,)))
-                if cand not in expansions:
+                if cand not in seen:
+                    seen.add(cand)
                     expansions.append(cand)
         candidates = await estimate_many(expansions, batch_size)
         if not candidates:

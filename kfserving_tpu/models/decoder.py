@@ -1,0 +1,217 @@
+"""Decoder-only transformer (GPT-class) with KV-cache serving modes.
+
+The reference treats generative models as opaque request/response
+artifacts behind the same predict route as everything else (reference
+pkg/apis/serving/v1beta1/predictor.go:33-59 — no decoder-aware serving
+exists anywhere in it).  A TPU-native serving framework needs the
+decoder to be a first-class citizen: incremental decoding with a KV
+cache is what makes generation O(L) instead of O(L^2), and the cache
+layout decides whether the decode step maps onto the MXU.
+
+One Flax module, three executions (all static-shape, jit-friendly):
+
+- **full**: `input_ids [B, L] -> logits [B, L, V]` — causal attention
+  over the whole sequence.  Teacher-forcing / parity baseline.
+- **prefill**: same forward pass with `return_cache=True` — also
+  returns every layer's (k, v) [B, L, H, D] so the serving engine can
+  scatter them into slot caches.  Suffix padding is masked via
+  `kv_lengths` and rides the padding-aware flash kernel at long L.
+- **decode**: `input_ids [B, 1]` with `kv_cache` — writes the step's
+  k/v into the caches at per-row `positions` (one scatter per layer)
+  and attends over the valid prefix.  B here is the engine's slot
+  count: one compiled program serves continuous batching forever.
+
+TPU notes:
+- pre-LN blocks (GPT-2 style): the residual stream stays bf16; logits
+  come back float32 for stable sampling.
+- the LM head ties the embedding matrix (one [V, H] tensor in HBM).
+- caches are [B, max_seq, H, D] per layer — sequence-major so the
+  decode attention reads are contiguous along the lane dimension, and
+  the slot axis (B) is shardable for tensor parallelism on heads.
+- attention dispatches through ops.dot_product_attention: causal
+  full/prefill hits the flash kernel when eligible; decode's
+  Lq=1 masked read is a skinny matmul XLA fuses well.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from kfserving_tpu.ops import dot_product_attention
+
+
+class DecoderConfig:
+    def __init__(self, vocab_size=32000, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=3072, max_seq=1024,
+                 layer_norm_eps=1e-5, dtype=jnp.bfloat16,
+                 attn_fn=None):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.max_seq = max_seq
+        self.layer_norm_eps = layer_norm_eps
+        self.dtype = dtype
+        # Pluggable full/prefill attention (q, k, v, mask) -> out for
+        # sequence-parallel serving (ring attention), mirroring
+        # models/bert.py.  Decode-mode cache attention is not pluggable:
+        # its Lq=1 reads are latency-bound, not sequence-shardable.
+        self.attn_fn = attn_fn
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+
+class DecoderBlock(nn.Module):
+    config: DecoderConfig
+
+    @nn.compact
+    def __call__(self, hidden, *, mask=None, kv_lengths=None,
+                 cache=None, positions=None):
+        """cache: optional (k_cache, v_cache) [B, max_seq, H, D] pair —
+        decode mode.  positions: [B] absolute position of the current
+        token (decode) — the scatter index for the cache write."""
+        cfg = self.config
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="attn_norm")(hidden)
+
+        def proj(name):
+            return nn.DenseGeneral((cfg.num_heads, cfg.head_dim),
+                                   dtype=cfg.dtype, name=name)
+
+        q = proj("query")(x)
+        k = proj("key")(x)
+        v = proj("value")(x)
+        new_cache = None
+        if cache is not None:
+            k_cache, v_cache = cache
+            b = k_cache.shape[0]
+            rows = jnp.arange(b)
+            k_cache = k_cache.at[rows, positions].set(
+                k[:, 0].astype(k_cache.dtype))
+            v_cache = v_cache.at[rows, positions].set(
+                v[:, 0].astype(v_cache.dtype))
+            new_cache = (k_cache, v_cache)
+            # Valid keys are exactly positions <= current position.
+            max_seq = k_cache.shape[1]
+            attn_mask = (jnp.arange(max_seq)[None, :]
+                         <= positions[:, None])[:, None, None, :]
+            out = dot_product_attention(q, k_cache, v_cache,
+                                        mask=attn_mask)
+        elif cfg.attn_fn is not None:
+            attn_mask = None
+            lq = q.shape[1]
+            causal = jnp.tril(jnp.ones((lq, lq), jnp.bool_))[None, None]
+            if kv_lengths is not None:
+                pad = (jnp.arange(lq)[None, :]
+                       < kv_lengths[:, None])[:, None, None, :]
+                attn_mask = causal & pad
+            else:
+                attn_mask = causal
+            out = cfg.attn_fn(q, k, v, attn_mask)
+        else:
+            out = dot_product_attention(q, k, v, causal=True,
+                                        kv_lengths=kv_lengths)
+            new_cache = (k, v)
+        out = nn.DenseGeneral(cfg.hidden_size, axis=(-2, -1),
+                              dtype=cfg.dtype, name="out")(out)
+        hidden = hidden + out
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="mlp_norm")(hidden)
+        x = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     name="mlp_in")(x)
+        x = nn.gelu(x, approximate=True)
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlp_out")(x)
+        return hidden + x, new_cache
+
+
+class DecoderLM(nn.Module):
+    """Token ids -> next-token logits, with optional KV-cache modes.
+
+    full/prefill: input_ids [B, L]; kv_lengths optional [B] (suffix
+        real-token counts — bucket padding).  Returns logits [B, L, V]
+        (float32), plus per-layer (k, v) [B, L, H, D] when
+        return_cache=True.
+    decode: input_ids [B, 1] + kv_cache (list of per-layer (k, v)
+        [B, max_seq, H, D]) + positions [B].  Returns logits [B, 1, V]
+        and the updated caches.
+    """
+
+    config: DecoderConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions: Optional[Any] = None,
+                 kv_cache: Optional[Any] = None,
+                 kv_lengths: Optional[Any] = None,
+                 return_cache: bool = False):
+        cfg = self.config
+        b, l = input_ids.shape
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                         dtype=cfg.dtype, name="wte")
+        if positions is None:
+            pos = jnp.arange(l)[None, :]
+        else:
+            pos = positions.reshape(b, -1)
+        hidden = embed(input_ids)
+        hidden += nn.Embed(cfg.max_seq, cfg.hidden_size, dtype=cfg.dtype,
+                           name="wpe")(pos)
+        caches = []
+        for i in range(cfg.num_layers):
+            layer_cache = None if kv_cache is None else kv_cache[i]
+            layer_pos = (None if kv_cache is None
+                         else pos.reshape(b))
+            hidden, new_cache = DecoderBlock(cfg, name=f"layer_{i}")(
+                hidden, kv_lengths=kv_lengths, cache=layer_cache,
+                positions=layer_pos)
+            caches.append(new_cache)
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                              name="final_norm")(hidden)
+        logits = embed.attend(hidden.astype(embed.embedding.dtype))
+        logits = logits.astype(jnp.float32)
+        if kv_cache is not None:
+            return logits, caches
+        if return_cache:
+            return logits, caches
+        return logits
+
+
+def decoder_small(**overrides):
+    """GPT-2-small-class config (124M at vocab 50257)."""
+    defaults = dict(vocab_size=50257, hidden_size=768, num_layers=12,
+                    num_heads=12, intermediate_size=3072, max_seq=1024)
+    defaults.update(overrides)
+    return DecoderConfig(**defaults)
+
+
+def decoder_tiny(**overrides):
+    """4-layer/128-wide config for hermetic CPU tests.  vocab 384
+    covers the byte tokenizer (258 ids) rounded up to a lane-friendly
+    multiple of 128."""
+    defaults = dict(vocab_size=384, hidden_size=128, num_layers=4,
+                    num_heads=4, intermediate_size=512, max_seq=256,
+                    dtype=jnp.float32)
+    defaults.update(overrides)
+    return DecoderConfig(**defaults)
+
+
+def create_decoder(config: Optional[DecoderConfig] = None,
+                   seq_len: int = 64):
+    cfg = config or decoder_small()
+    module = DecoderLM(cfg)
+    example = jnp.zeros((1, seq_len), jnp.int32)
+    return module, example
+
+
+def _create_decoder_small(**kw):
+    """Registry factory: 'decoder'."""
+    seq_len = kw.pop("seq_len", 64)
+    return create_decoder(decoder_small(**kw) if kw else None,
+                          seq_len=seq_len)
+
+
+def _create_decoder_tiny(seq_len=32, **kw):
+    """Registry factory: 'decoder_tiny'."""
+    return create_decoder(decoder_tiny(**kw), seq_len=seq_len)

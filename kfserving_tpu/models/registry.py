@@ -29,6 +29,9 @@ _LAZY_BUILTINS: Dict[str, Tuple[str, str]] = {
     "vit_b16": ("kfserving_tpu.models.vit", "_create_vit_b16"),
     "vit_tiny": ("kfserving_tpu.models.vit", "_create_vit_tiny"),
     "mlp": ("kfserving_tpu.models.mlp", "create_mlp"),
+    "decoder": ("kfserving_tpu.models.decoder", "_create_decoder_small"),
+    "decoder_tiny": ("kfserving_tpu.models.decoder",
+                     "_create_decoder_tiny"),
 }
 
 

@@ -1,0 +1,339 @@
+"""GenerativeModel: the decoder-serving predictor.
+
+Extends the predictor plugin boundary (reference pkg/apis/serving/
+v1beta1/predictor.go:33-59 — the reference's frameworks are all
+request/response; generation is this framework's TPU-native addition)
+with KV-cache incremental decoding and continuous batching
+(engine/generator.py).
+
+Model directory layout (the `storage_uri` artifact):
+
+    config.json          — required; see GenerativeConfig
+    checkpoint.msgpack   — flax.serialization blob (optional: absent ->
+                           random init, which tests/benchmarks use)
+
+config.json schema:
+    {
+      "architecture": "decoder" | "decoder_tiny" | <registered>,
+      "arch_kwargs": {...},
+      "max_slots": 8,              # continuous-batching slot count
+      "max_seq": 512,              # KV-cache capacity per slot
+      "prefill_buckets": [64, 128, 256, 512],
+      "max_new_tokens": 64,        # default generation budget
+      "temperature": 0.0,          # default sampling temperature
+      "tokenizer": "byte",         # "byte" | "hf:<name>"
+      "mesh": {"tp": 2}            # within-replica tensor parallelism
+    }
+
+Request shapes (both V1 predict and the generate routes):
+    {"instances": ["a prompt", {"prompt": "...", "max_tokens": 32,
+                                "temperature": 0.7}]}
+    {"text_input": "...", "parameters": {...}}   # v2 generate ext.
+Response:
+    {"predictions": [{"text": ..., "token_count": n,
+                      "finish_reason": "eos"|"length"}]}
+
+The byte tokenizer (ids = UTF-8 bytes, BOS=256, EOS=257) keeps the
+stack dependency-free and lossless for any input; "hf:<name>" resolves
+a transformers tokenizer for real checkpoints.
+"""
+
+import json
+import logging
+import os
+from typing import Any, AsyncIterator, Dict, List, Optional
+
+import numpy as np
+
+from kfserving_tpu.engine.generator import GenerationEngine
+from kfserving_tpu.engine.hbm import HBMManager
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.protocol import v1
+from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
+from kfserving_tpu.storage import Storage
+
+logger = logging.getLogger("kfserving_tpu.llm")
+
+BOS_ID = 256
+EOS_ID = 257
+
+
+class ByteTokenizer:
+    """Lossless byte-level tokenizer: ids 0-255 are UTF-8 bytes, 256 is
+    BOS, 257 is EOS.  vocab_size 258 — the decoder_tiny config rounds
+    its embedding table up to a lane-friendly 384."""
+
+    vocab_size = 258
+    bos_id = BOS_ID
+    eos_id = EOS_ID
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: List[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+def build_tokenizer(spec: str):
+    if spec == "byte":
+        return ByteTokenizer()
+    if spec.startswith("hf:"):
+        from transformers import AutoTokenizer  # baked-in dependency
+
+        tok = AutoTokenizer.from_pretrained(spec[3:])
+
+        class _HF:
+            vocab_size = tok.vocab_size
+            bos_id = tok.bos_token_id
+            eos_id = tok.eos_token_id
+
+            def encode(self, text, add_bos=True):
+                return tok.encode(text)
+
+            def decode(self, ids):
+                return tok.decode(ids)
+
+        return _HF()
+    raise InvalidInput(f"unknown tokenizer spec {spec!r}")
+
+
+class GenerativeConfig:
+    def __init__(self, architecture: str,
+                 arch_kwargs: Optional[Dict] = None,
+                 max_slots: int = 8, max_seq: int = 512,
+                 prefill_buckets: Optional[List[int]] = None,
+                 max_new_tokens: int = 64, temperature: float = 0.0,
+                 tokenizer: str = "byte",
+                 mesh: Optional[Dict[str, int]] = None,
+                 **_ignored):
+        self.architecture = architecture
+        self.arch_kwargs = arch_kwargs or {}
+        self.max_slots = int(max_slots)
+        self.max_seq = int(max_seq)
+        self.prefill_buckets = prefill_buckets
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.tokenizer = tokenizer
+        self.mesh = mesh or {}
+
+    @classmethod
+    def from_file(cls, path: str,
+                  overrides: Optional[Dict[str, Any]] = None):
+        with open(path) as f:
+            data = json.load(f)
+        if overrides:
+            data.update(overrides)
+        if "architecture" not in data:
+            raise InvalidInput(
+                f"{path} missing required key 'architecture'")
+        return cls(**data)
+
+
+class GenerativeModel(Model):
+    """A served decoder with continuous batching and token streaming."""
+
+    def __init__(self, name: str, model_dir: str,
+                 config: Optional[GenerativeConfig] = None,
+                 hbm: Optional[HBMManager] = None,
+                 config_overrides: Optional[Dict[str, Any]] = None):
+        super().__init__(name)
+        self.model_dir = model_dir
+        self.config = config
+        self.hbm = hbm
+        self.config_overrides = dict(config_overrides or {})
+        self.engine: Optional[GenerationEngine] = None
+        self.tokenizer = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def load(self) -> bool:
+        from flax import serialization
+
+        from kfserving_tpu.models import create_model, init_params
+
+        local = Storage.download(self.model_dir)
+        cfg = self.config
+        if cfg is None:
+            cfg = GenerativeConfig.from_file(
+                os.path.join(local, "config.json"),
+                overrides=self.config_overrides)
+            self.config = cfg
+        self.tokenizer = build_tokenizer(cfg.tokenizer)
+
+        spec = create_model(cfg.architecture, **cfg.arch_kwargs)
+        variables = init_params(spec, seed=0)
+        ckpt = os.path.join(local, "checkpoint.msgpack")
+        if os.path.exists(ckpt):
+            with open(ckpt, "rb") as f:
+                variables = serialization.from_bytes(variables, f.read())
+            logger.info("restored checkpoint %s", ckpt)
+        else:
+            logger.warning("no checkpoint at %s; serving random init",
+                           ckpt)
+
+        mesh = None
+        if cfg.mesh:
+            from kfserving_tpu.parallel import build_mesh, shard_params
+            from kfserving_tpu.parallel.mesh import MeshConfig
+
+            mesh_cfg = MeshConfig(**{k: int(v)
+                                     for k, v in cfg.mesh.items()
+                                     if k in ("dp", "tp", "sp")})
+            if mesh_cfg.num_devices > 1:
+                mesh = build_mesh(mesh_cfg)
+                variables = {
+                    **variables,
+                    "params": shard_params(variables["params"], mesh),
+                }
+
+        engine = GenerationEngine(
+            spec.module, variables,
+            max_slots=cfg.max_slots, max_seq=cfg.max_seq,
+            prefill_buckets=cfg.prefill_buckets,
+            eos_id=getattr(self.tokenizer, "eos_id", None),
+            mesh=mesh, name=self.name)
+        if self.hbm is not None:
+            # Generation residency = params + the slot cache pool.
+            self.hbm.admit(self.name,
+                           engine.param_bytes() + engine.cache_bytes())
+        self.engine = engine
+        self.ready = True
+        return True
+
+    def unload(self) -> None:
+        if self.engine is not None:
+            self.engine.shutdown_nowait()
+            self.engine = None
+        if self.hbm is not None:
+            self.hbm.release(self.name)
+        self.ready = False
+
+    async def close(self) -> None:
+        if self.engine is not None:
+            await self.engine.close()
+            self.engine = None
+        await super().close()
+
+    # -- request parsing ---------------------------------------------------
+    def _parse_instance(self, inst: Any) -> Dict[str, Any]:
+        cfg = self.config
+        if isinstance(inst, str):
+            return {"prompt": inst, "max_tokens": cfg.max_new_tokens,
+                    "temperature": cfg.temperature}
+        if isinstance(inst, dict):
+            if "prompt" not in inst and "text_input" not in inst:
+                raise InvalidInput(
+                    "generate instance needs 'prompt' (or 'text_input')")
+            return {
+                "prompt": str(inst.get("prompt",
+                                       inst.get("text_input"))),
+                "max_tokens": int(inst.get("max_tokens",
+                                           inst.get("max_new_tokens",
+                                                    cfg.max_new_tokens))),
+                "temperature": float(inst.get("temperature",
+                                              cfg.temperature)),
+            }
+        raise InvalidInput(
+            f"generate instance must be a string or object, got "
+            f"{type(inst).__name__}")
+
+    async def _run_one(self, parsed: Dict[str, Any]) -> Dict[str, Any]:
+        ids = self.tokenizer.encode(parsed["prompt"])
+        tokens, reason = await self.engine.complete(
+            ids, max_new_tokens=parsed["max_tokens"],
+            temperature=parsed["temperature"])
+        return {
+            "text": self.tokenizer.decode(tokens),
+            "token_count": len(tokens),
+            "finish_reason": reason,
+        }
+
+    # -- serving entry points ----------------------------------------------
+    async def predict(self, request: Any) -> Any:
+        if self.predictor_host:
+            return await super().predict(request)
+        if self.engine is None:
+            raise InferenceError(f"model {self.name} not loaded")
+        import asyncio
+
+        instances = v1.get_instances(request)
+        if not instances:
+            raise InvalidInput("generate needs at least one instance")
+        parsed = [self._parse_instance(i) for i in instances]
+        # Submit all instances at once: the engine's continuous batcher
+        # shares decode steps across them (the request-level analogue of
+        # the dynamic batcher).
+        results = await asyncio.gather(*[self._run_one(p)
+                                         for p in parsed])
+        return v1.make_response(list(results))
+
+    async def generate(self, request: Any) -> Any:
+        """Non-streaming :generate — v2 generate-extension shape in,
+        single result out."""
+        if self.engine is None:
+            raise InferenceError(f"model {self.name} not loaded")
+        parsed = self._parse_generate_body(request)
+        result = await self._run_one(parsed)
+        return {"model_name": self.name, "text_output": result["text"],
+                "details": {"token_count": result["token_count"],
+                            "finish_reason": result["finish_reason"]}}
+
+    def _parse_generate_body(self, request: Any) -> Dict[str, Any]:
+        if isinstance(request, dict) and (
+                "text_input" in request or "prompt" in request):
+            merged = dict(request)
+            merged.update(request.get("parameters") or {})
+            return self._parse_instance(merged)
+        instances = v1.get_instances(request)
+        if not instances:
+            raise InvalidInput("generate needs a prompt")
+        return self._parse_instance(instances[0])
+
+    async def generate_stream(self, request: Any
+                              ) -> AsyncIterator[Dict[str, Any]]:
+        """Streaming :generate — an async iterator of per-token events:
+        {"token": {"id", "text"}, ...} with a terminal event carrying
+        finish_reason + the aggregate text.
+
+        Validation and submission happen HERE, eagerly — before the
+        caller commits response headers — so a bad prompt is a clean
+        4xx, not a 200 followed by a dropped connection."""
+        if self.engine is None:
+            raise InferenceError(f"model {self.name} not loaded")
+        parsed = self._parse_generate_body(request)
+        ids = self.tokenizer.encode(parsed["prompt"])
+        req = self.engine.submit(
+            ids, max_new_tokens=parsed["max_tokens"],
+            temperature=parsed["temperature"])
+
+        async def events():
+            collected: List[int] = []
+            async for token, reason in self.engine.stream(req):
+                if token is not None:
+                    collected.append(token)
+                    event = {"token": {"id": int(token),
+                                       "text": self.tokenizer.decode(
+                                           [token])}}
+                else:
+                    event = {}
+                if reason is not None:
+                    event["finish_reason"] = reason
+                    event["generated_text"] = self.tokenizer.decode(
+                        collected)
+                    event["details"] = {"token_count": len(collected)}
+                yield event
+
+        return events()
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return dict(self.engine.stats()) if self.engine else {}
+
+    def metadata(self) -> Dict[str, Any]:
+        meta = super().metadata()
+        if self.config is not None:
+            meta["platform"] = "jax-generate"
+            meta["architecture"] = self.config.architecture
+            meta["max_slots"] = self.config.max_slots
+            meta["max_seq"] = self.config.max_seq
+        return meta

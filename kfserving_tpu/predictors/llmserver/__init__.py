@@ -1,0 +1,5 @@
+from kfserving_tpu.predictors.llm import (  # noqa: F401
+    ByteTokenizer,
+    GenerativeConfig,
+    GenerativeModel,
+)

@@ -195,6 +195,14 @@ class ModelServer:
               self._infer_v2)
         r.add("POST", "/v1/models/{name}:explain", self._explain)
         r.add("POST", "/v2/models/{name}/explain", self._explain)
+        # Generative routes (the v2 generate extension; no reference
+        # counterpart — its server predates generative serving).  The
+        # V1 spelling mirrors :predict; {"stream": true} upgrades to a
+        # chunked token stream, as does the dedicated _stream route.
+        r.add("POST", "/v1/models/{name}:generate", self._generate)
+        r.add("POST", "/v2/models/{name}/generate", self._generate)
+        r.add("POST", "/v2/models/{name}/generate_stream",
+              self._generate_stream)
         r.add("POST", "/v2/repository/models/{name}/load", self._load)
         r.add("POST", "/v2/repository/models/{name}/unload", self._unload)
         r.add("GET", "/v2/repository/index", self._repository_index)
@@ -338,6 +346,61 @@ class ModelServer:
                     "content-type": "application/octet-stream",
                     "inference-header-content-length": str(hlen)})
         return _json(response)
+
+    async def _generate(self, req: Request) -> Response:
+        # Cheap pre-scan avoids a duplicate json.loads on the hot
+        # non-streaming path (_inference decodes the body itself).
+        if b'"stream"' in req.body:
+            try:
+                body = json.loads(req.body) if req.body else {}
+            except ValueError:
+                return _json({"error": "malformed JSON body"},
+                             status=400)
+            if isinstance(body, dict) and body.get("stream"):
+                return await self._generate_stream(req, body=body)
+        return await self._inference(req, "generate",
+                                     self.dataplane.generate)
+
+    async def _generate_stream(self, req: Request,
+                               body: Any = None) -> Response:
+        from kfserving_tpu.server.http import StreamingResponse
+
+        name = req.path_params["name"]
+        if body is None:
+            try:
+                body = json.loads(req.body) if req.body else {}
+            except ValueError:
+                return _json({"error": "malformed JSON body"},
+                             status=400)
+        try:
+            events = await self.dataplane.generate_stream(name, body)
+        except ServingError as e:
+            return _error(e)
+        start = time.perf_counter()
+        metrics, hooks = self.metrics, self.request_hooks
+
+        async def sse():
+            status = 200
+            try:
+                async for event in events:
+                    payload = json.dumps(event, default=_np_default)
+                    yield f"data: {payload}\n\n".encode("utf-8")
+            except Exception:
+                logger.exception("generate stream for %s failed", name)
+                status = 500
+                raise
+            finally:
+                latency_ms = (time.perf_counter() - start) * 1000.0
+                metrics.observe_request(name, "generate_stream",
+                                        status, latency_ms)
+                for hook in hooks:
+                    try:
+                        hook(name, "generate_stream", req, None,
+                             latency_ms)
+                    except Exception:
+                        logger.exception("request hook failed")
+
+        return StreamingResponse(sse())
 
     async def _load(self, req: Request) -> Response:
         name = req.path_params["name"]

@@ -114,6 +114,24 @@ class DataPlane:
         response = await maybe_await(model.explain(request))
         return await model.postprocess(response)
 
+    async def generate(self, name: str, body: Any) -> Any:
+        model = await self.get_model(name)
+        generate = getattr(model, "generate", None)
+        if generate is None:
+            raise InvalidInput(
+                f"model {name} does not support :generate")
+        return await maybe_await(generate(body))
+
+    async def generate_stream(self, name: str, body: Any):
+        model = await self.get_model(name)
+        stream = getattr(model, "generate_stream", None)
+        if stream is None:
+            raise InvalidInput(
+                f"model {name} does not support streaming generation")
+        # Awaiting runs validation + submission NOW: a bad request is a
+        # 4xx before any streaming headers are committed.
+        return await maybe_await(stream(body))
+
     def validate(self, request: Any) -> Any:
         if isinstance(request, dict) and "inputs" in request and isinstance(
                 request.get("inputs"), list) and request["inputs"] and isinstance(

@@ -55,6 +55,23 @@ class Response:
         self.headers.setdefault("content-type", content_type)
 
 
+class StreamingResponse(Response):
+    """Chunked-transfer response whose body is an async iterator of
+    byte chunks — the token-streaming surface for generative models
+    (the reference's tornado server has no streaming route at all).
+    Each yielded chunk is flushed as one HTTP/1.1 chunk, so clients
+    see tokens as they are produced, not at request end."""
+
+    __slots__ = ("chunks",)
+
+    def __init__(self, chunks, status: int = 200,
+                 headers: Optional[Dict[str, str]] = None,
+                 content_type: str = "text/event-stream"):
+        super().__init__(b"", status=status, headers=headers,
+                         content_type=content_type)
+        self.chunks = chunks
+
+
 Handler = Callable[[Request], Awaitable[Response]]
 
 
@@ -101,7 +118,7 @@ class Router:
 class _HttpProtocol(asyncio.Protocol):
     __slots__ = ("server", "transport", "_buf", "_expect_body", "_headers",
                  "_method", "_target", "_keepalive", "_chunked", "_task",
-                 "_chunk_out", "_chunk_pos")
+                 "_chunk_out", "_chunk_pos", "_can_write")
 
     def __init__(self, server: "HTTPServer"):
         self.server = server
@@ -118,6 +135,16 @@ class _HttpProtocol(asyncio.Protocol):
         # large chunked body is decoded in O(n), not re-parsed per packet).
         self._chunk_out = bytearray()
         self._chunk_pos = 0
+        # Transport write-buffer backpressure (streaming responses wait
+        # on this between chunks).
+        self._can_write = asyncio.Event()
+        self._can_write.set()
+
+    def pause_writing(self):
+        self._can_write.clear()
+
+    def resume_writing(self):
+        self._can_write.set()
 
     def connection_made(self, transport):
         self.transport = transport
@@ -259,9 +286,49 @@ class _HttpProtocol(asyncio.Protocol):
             await asyncio.shield(prev)
         if self.transport is None or self.transport.is_closing():
             return
+        if isinstance(response, StreamingResponse):
+            await self._write_streaming(response, keepalive)
+            return
         self.transport.write(encode_response(response, keepalive))
         if not keepalive:
             self.transport.close()
+
+    async def _write_streaming(self, response: "StreamingResponse",
+                               keepalive: bool):
+        phrase = STATUS_PHRASES.get(response.status, "Unknown")
+        lines = [f"HTTP/1.1 {response.status} {phrase}"]
+        for k, v in response.headers.items():
+            lines.append(f"{k}: {v}")
+        lines.append("transfer-encoding: chunked")
+        lines.append("connection: " + ("keep-alive" if keepalive
+                                       else "close"))
+        self.transport.write(
+            ("\r\n".join(lines) + "\r\n\r\n").encode("latin1"))
+        try:
+            async for chunk in response.chunks:
+                if not chunk:
+                    continue
+                if self.transport is None or self.transport.is_closing():
+                    return  # client went away: stop producing
+                self.transport.write(b"%x\r\n" % len(chunk) + chunk
+                                     + b"\r\n")
+                # Real backpressure: when the transport's write buffer
+                # passes the high-water mark, asyncio calls
+                # pause_writing — wait for resume so a slow client
+                # doesn't buffer the whole generation in memory.
+                await self._can_write.wait()
+        except Exception:
+            logger.exception("streaming body failed mid-response")
+            # Mid-stream failure: the chunked framing is already
+            # committed; terminate the connection so the client sees a
+            # truncated stream, not a silent success.
+            if self.transport is not None:
+                self.transport.close()
+            return
+        if self.transport is not None and not self.transport.is_closing():
+            self.transport.write(b"0\r\n\r\n")
+            if not keepalive:
+                self.transport.close()
 
     def _fail(self, status: int, reason: str):
         # Chain behind any in-flight response so a pipelined connection never
@@ -280,6 +347,9 @@ class _HttpProtocol(asyncio.Protocol):
 
     def connection_lost(self, exc):
         self.transport = None
+        # Unblock any streaming writer waiting on backpressure; it
+        # checks transport is None and stops.
+        self._can_write.set()
 
 
 def encode_response(resp: Response, keepalive: bool) -> bytes:

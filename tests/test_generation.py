@@ -1,0 +1,354 @@
+"""Decoder model + GenerationEngine tests (VERDICT r3 item 1).
+
+Done-criteria from the verdict: CPU-mesh tests for cache correctness
+(prefix parity with full recompute) and scheduler invariants.  The
+reference has no generative serving; the contract extended here is the
+predictor plugin boundary (reference pkg/apis/serving/v1beta1/
+predictor.go:33-59) and the batcher response shape
+(pkg/batcher/handler.go:129-150).
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfserving_tpu.engine.generator import GenerationEngine
+from kfserving_tpu.models.decoder import DecoderLM, decoder_tiny
+from kfserving_tpu.protocol.errors import InvalidInput
+
+MAX_SEQ = 64
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = decoder_tiny(num_layers=2, hidden_size=64, num_heads=2,
+                       intermediate_size=128, max_seq=MAX_SEQ,
+                       vocab_size=96)
+    module = DecoderLM(cfg)
+    variables = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+    return module, variables, cfg
+
+
+def ref_greedy(module, variables, prompt, steps):
+    """Teacher-forcing baseline: recompute the FULL forward pass for
+    every generated token (no cache).  The engine's cached path must
+    reproduce this exactly."""
+    ids = [int(t) for t in prompt]
+    out = []
+    for _ in range(steps):
+        logits = module.apply(variables,
+                              jnp.asarray([ids], jnp.int32))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        ids.append(nxt)
+    return out
+
+
+def make_engine(tiny, **kw):
+    module, variables, _ = tiny
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq", MAX_SEQ)
+    kw.setdefault("prefill_buckets", [8, 16, 32, MAX_SEQ])
+    return GenerationEngine(module, variables, **kw)
+
+
+# ------------------------------------------------------ cache parity
+
+
+def test_prefill_logits_match_full_forward(tiny):
+    """Suffix-padded prefill (bucketed) must produce the same logits at
+    real positions as the unpadded full forward — bucket padding never
+    leaks into the cache or the sampled token."""
+    module, variables, _ = tiny
+    prompt = jnp.asarray([[5, 9, 2, 7, 11]], jnp.int32)
+    full = module.apply(variables, prompt)
+    padded = jnp.zeros((1, 16), jnp.int32).at[:, :5].set(prompt)
+    logits, caches = module.apply(variables, padded,
+                                  kv_lengths=jnp.asarray([5]),
+                                  return_cache=True)
+    np.testing.assert_allclose(np.asarray(full),
+                               np.asarray(logits[:, :5]),
+                               rtol=2e-4, atol=2e-4)
+    assert len(caches) == 2  # per layer
+    assert caches[0][0].shape == (1, 16, 2, 32)
+
+
+async def test_engine_greedy_matches_full_recompute(tiny):
+    """THE cache-correctness criterion: incremental decode through the
+    slot cache reproduces full-recompute greedy token-for-token."""
+    module, variables, _ = tiny
+    prompt = [5, 9, 2, 7, 11]
+    want = ref_greedy(module, variables, prompt, 12)
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        got, reason = await eng.complete(prompt, max_new_tokens=12)
+    finally:
+        await eng.close()
+    assert got == want
+    assert reason == "length"
+
+
+async def test_concurrent_requests_match_isolated(tiny):
+    """Slots sharing one decode batch must not influence each other:
+    every concurrent result equals its isolated baseline."""
+    module, variables, _ = tiny
+    prompts = [[3, 1, 4], [1, 5, 9, 2, 6, 5], [35, 8, 97, 9, 3, 2, 38,
+                                               4, 6]]
+    want = [ref_greedy(module, variables, p, 8) for p in prompts]
+    eng = make_engine(tiny, max_slots=4)
+    try:
+        got = await asyncio.gather(*[
+            eng.complete(p, max_new_tokens=8) for p in prompts])
+    finally:
+        await eng.close()
+    for (tokens, reason), expected in zip(got, want):
+        assert tokens == expected
+        assert reason == "length"
+
+
+async def test_mid_flight_admission(tiny):
+    """Continuous batching: a request arriving while another is decoding
+    joins at a step boundary; neither result changes."""
+    module, variables, _ = tiny
+    p_a, p_b = [7, 7, 3], [2, 8]
+    want_a = ref_greedy(module, variables, p_a, 16)
+    want_b = ref_greedy(module, variables, p_b, 6)
+    eng = make_engine(tiny, max_slots=2)
+    try:
+        got_a = []
+        gen_a = eng.generate(p_a, max_new_tokens=16)
+        # Consume a few of A's tokens so A is provably mid-flight...
+        async for token, fin in gen_a:
+            got_a.append(token)
+            if len(got_a) == 3:
+                break
+        # ...then admit B and drain both.
+        task_b = asyncio.ensure_future(
+            eng.complete(p_b, max_new_tokens=6))
+        async for token, fin in gen_a:
+            got_a.append(token)
+        tokens_b, _ = await task_b
+    finally:
+        await eng.close()
+    assert got_a == want_a
+    assert tokens_b == want_b
+    stats = eng.stats()
+    assert stats["prefills"] == 2
+    assert stats["requests_finished"] == 2
+    assert 0.0 < stats["slot_occupancy"] <= 1.0
+
+
+async def test_more_requests_than_slots(tiny):
+    """Queueing invariant: with 2 slots and 5 requests, everything
+    completes and matches its baseline (admission order irrelevant for
+    greedy)."""
+    module, variables, _ = tiny
+    prompts = [[i + 1, i + 2] for i in range(5)]
+    want = [ref_greedy(module, variables, p, 5) for p in prompts]
+    eng = make_engine(tiny, max_slots=2)
+    try:
+        got = await asyncio.gather(*[
+            eng.complete(p, max_new_tokens=5) for p in prompts])
+    finally:
+        await eng.close()
+    assert [t for t, _ in got] == want
+
+
+# ----------------------------------------------------- stop conditions
+
+
+async def test_eos_stops_generation(tiny):
+    module, variables, _ = tiny
+    prompt = [5, 9, 2, 7, 11]
+    ref = ref_greedy(module, variables, prompt, 12)
+    # Make the 4th generated token the EOS: generation must stop there
+    # and NOT emit it as content.
+    eos = ref[3]
+    first_eos = ref.index(eos)
+    eng = make_engine(tiny, max_slots=1, eos_id=eos)
+    try:
+        tokens, reason = await eng.complete(prompt, max_new_tokens=12)
+    finally:
+        await eng.close()
+    assert reason == "eos"
+    assert tokens == ref[:first_eos]
+    assert eos not in tokens
+
+
+async def test_budget_clamped_to_cache_capacity(tiny):
+    """max_new_tokens past max_seq is clamped, not an error — the slot
+    cache is the capacity contract."""
+    module, variables, _ = tiny
+    prompt = list(range(1, 31))  # 30 tokens, max_seq 64
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        tokens, reason = await eng.complete(prompt,
+                                            max_new_tokens=10_000)
+    finally:
+        await eng.close()
+    assert len(tokens) == MAX_SEQ - 30
+    assert reason == "length"
+
+
+async def test_temperature_sampling_varies_and_greedy_does_not(tiny):
+    module, variables, _ = tiny
+    prompt = [4, 2]
+    eng = make_engine(tiny, max_slots=2, rng_seed=0)
+    try:
+        g1, _ = await eng.complete(prompt, max_new_tokens=8,
+                                   temperature=0.0)
+        g2, _ = await eng.complete(prompt, max_new_tokens=8,
+                                   temperature=0.0)
+        hot = [await eng.complete(prompt, max_new_tokens=8,
+                                  temperature=5.0) for _ in range(4)]
+    finally:
+        await eng.close()
+    assert g1 == g2  # greedy is deterministic
+    # At high temperature some draw differs from greedy with
+    # overwhelming probability across 4 runs of 8 tokens.
+    assert any(t != g1 for t, _ in hot)
+
+
+# ------------------------------------------------------- validation
+
+
+async def test_request_validation(tiny):
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        with pytest.raises(InvalidInput, match="empty"):
+            await eng.complete([], max_new_tokens=4)
+        with pytest.raises(InvalidInput, match="exceeds"):
+            await eng.complete(list(range(MAX_SEQ + 1)),
+                               max_new_tokens=4)
+        with pytest.raises(InvalidInput, match="max_new_tokens"):
+            await eng.complete([1], max_new_tokens=0)
+    finally:
+        await eng.close()
+
+
+async def test_streaming_yields_incrementally(tiny):
+    """generate() is a live stream: tokens arrive one by one, in order,
+    and concatenate to the complete() result."""
+    module, variables, _ = tiny
+    prompt = [9, 9, 1]
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        seen = []
+        async for token, fin in eng.generate(prompt, max_new_tokens=6):
+            if token is not None:
+                seen.append(token)
+        want = ref_greedy(module, variables, prompt, 6)
+    finally:
+        await eng.close()
+    assert seen == want
+
+
+def test_cache_bytes_accounting(tiny):
+    module, variables, cfg = tiny
+    eng = GenerationEngine(module, variables, max_slots=4,
+                           max_seq=MAX_SEQ)
+    # layers * k+v * S * max_seq * H * D * itemsize
+    want = 2 * 2 * 4 * MAX_SEQ * 2 * 32 * 4  # float32 tiny config
+    assert eng.cache_bytes() == want
+    assert eng.param_bytes() > 0
+
+
+async def test_decode_failure_fails_all_inflight(tiny):
+    """A device failure mid-decode must surface as InferenceError on
+    every in-flight request — never a hung awaiter (code-review r4)."""
+    from kfserving_tpu.protocol.errors import InferenceError
+
+    eng = make_engine(tiny, max_slots=2)
+    try:
+        orig = eng._do_decode_step
+
+        def boom():
+            raise RuntimeError("synthetic XLA failure")
+
+        eng._do_decode_step = boom
+        with pytest.raises(InferenceError, match="generation failed"):
+            await asyncio.wait_for(
+                eng.complete([1, 2, 3], max_new_tokens=8), timeout=10)
+        # The engine recovers for new work once the fault clears.
+        eng._do_decode_step = orig
+        tokens, reason = await asyncio.wait_for(
+            eng.complete([1, 2, 3], max_new_tokens=4), timeout=30)
+        assert len(tokens) == 4
+    finally:
+        await eng.close()
+
+
+async def test_prefill_failure_fails_only_that_request(tiny):
+    from kfserving_tpu.protocol.errors import InferenceError
+
+    module, variables, _ = tiny
+    want = ref_greedy(module, variables, [5, 5], 4)
+    eng = make_engine(tiny, max_slots=2)
+    try:
+        orig = eng._do_prefill
+        calls = {"n": 0}
+
+        def flaky(req, slot):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("synthetic prefill OOM")
+            return orig(req, slot)
+
+        eng._do_prefill = flaky
+        with pytest.raises(InferenceError, match="prefill failed"):
+            await asyncio.wait_for(
+                eng.complete([9, 9], max_new_tokens=4), timeout=10)
+        tokens, _ = await asyncio.wait_for(
+            eng.complete([5, 5], max_new_tokens=4), timeout=30)
+        assert tokens == want
+    finally:
+        await eng.close()
+
+
+async def test_close_drains_inflight_awaiters(tiny):
+    """close() with a request mid-flight must not strand its awaiter:
+    the stream either finishes normally (close raced completion) or
+    raises InferenceError — it NEVER hangs."""
+    from kfserving_tpu.protocol.errors import InferenceError
+
+    eng = make_engine(tiny, max_slots=1)
+    gen = eng.generate([1, 2, 3], max_new_tokens=10_000)
+    token, _ = await asyncio.wait_for(gen.__anext__(), timeout=30)
+    assert token is not None
+
+    async def drain_all():
+        try:
+            async for _ in gen:
+                pass
+        except InferenceError:
+            return "error"
+        return "done"
+
+    task = asyncio.ensure_future(asyncio.wait_for(drain_all(), 15))
+    await eng.close()
+    assert await task in ("error", "done")
+
+
+async def test_engine_idle_loop_restarts(tiny):
+    """The scheduler task dies when idle and restarts on the next
+    request — no busy loop between requests."""
+    module, variables, _ = tiny
+    prompt = [3, 2, 1]
+    want = ref_greedy(module, variables, prompt, 4)
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        got1, _ = await eng.complete(prompt, max_new_tokens=4)
+        # Wait past the idle timeout so the loop task exits.
+        for _ in range(25):
+            await asyncio.sleep(0.1)
+            if eng._loop_task.done():
+                break
+        assert eng._loop_task.done()
+        got2, _ = await eng.complete(prompt, max_new_tokens=4)
+    finally:
+        await eng.close()
+    assert got1 == want and got2 == want

@@ -1,0 +1,319 @@
+"""Generative predictor serving tests (VERDICT r3 item 1, serving half).
+
+Covers the predictor plugin boundary extension (framework "generative"
+joins the one-of, reference pkg/apis/serving/v1beta1/predictor.go:33-59),
+the V1 predict shape, the v2 generate-extension routes, token
+streaming over chunked HTTP, and tensor-parallel generation on the
+virtual device mesh.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.predictors.llm import (
+    ByteTokenizer,
+    GenerativeConfig,
+    GenerativeModel,
+)
+
+pytestmark = pytest.mark.asyncio
+
+
+def _write_model_dir(tmp_path, **overrides):
+    d = tmp_path / "llm"
+    d.mkdir(exist_ok=True)
+    cfg = {
+        "architecture": "decoder_tiny",
+        "arch_kwargs": {"num_layers": 2, "hidden_size": 64,
+                        "num_heads": 2, "intermediate_size": 128,
+                        "max_seq": 64},
+        "max_slots": 2,
+        "max_seq": 64,
+        "prefill_buckets": [16, 32, 64],
+        "max_new_tokens": 8,
+        "tokenizer": "byte",
+    }
+    cfg.update(overrides)
+    (d / "config.json").write_text(json.dumps(cfg))
+    return str(d)
+
+
+# ------------------------------------------------------------ tokenizer
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = "hello, TPU ✨"
+    ids = tok.encode(text)
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids[1:]) == text
+    assert tok.decode(tok.encode(text, add_bos=False)) == text
+    assert tok.vocab_size == 258
+
+
+# ------------------------------------------------------------ predictor
+
+
+async def test_generative_model_v1_predict(tmp_path):
+    model = GenerativeModel("gen", _write_model_dir(tmp_path))
+    model.load()
+    try:
+        out = await model.predict(
+            {"instances": ["hello", {"prompt": "hi", "max_tokens": 4,
+                                     "temperature": 0.0}]})
+        preds = out["predictions"]
+        assert len(preds) == 2
+        for p in preds:
+            assert isinstance(p["text"], str)
+            assert p["finish_reason"] in ("eos", "length")
+            assert p["token_count"] >= 0
+        assert preds[1]["token_count"] <= 4
+        # Greedy determinism across calls.
+        again = await model.predict({"instances": ["hello"]})
+        assert again["predictions"][0]["text"] == preds[0]["text"]
+    finally:
+        await model.close()
+
+
+async def test_generative_model_validation(tmp_path):
+    from kfserving_tpu.protocol.errors import InvalidInput
+
+    model = GenerativeModel("gen", _write_model_dir(tmp_path))
+    model.load()
+    try:
+        with pytest.raises(InvalidInput):
+            await model.predict({"instances": [{"not_prompt": 1}]})
+        with pytest.raises(InvalidInput):
+            await model.predict({"instances": []})
+    finally:
+        await model.close()
+
+
+# --------------------------------------------------------- HTTP routes
+
+
+async def test_generate_routes_over_http(tmp_path):
+    import aiohttp
+
+    from kfserving_tpu.server.app import ModelServer
+
+    model = GenerativeModel("gen", _write_model_dir(tmp_path))
+    model.load()
+    server = ModelServer(http_port=0)
+    await server.start_async([model], host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.http_port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            # V1 :generate
+            async with s.post(f"{base}/v1/models/gen:generate",
+                              json={"prompt": "abc",
+                                    "max_tokens": 5}) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+            assert out["model_name"] == "gen"
+            assert isinstance(out["text_output"], str)
+            assert out["details"]["finish_reason"] in ("eos", "length")
+            # v2 generate extension shape
+            async with s.post(
+                    f"{base}/v2/models/gen/generate",
+                    json={"text_input": "abc",
+                          "parameters": {"max_tokens": 5}}) as r:
+                assert r.status == 200, await r.text()
+                out2 = await r.json()
+            assert out2["text_output"] == out["text_output"]  # greedy
+            # predict still works alongside
+            async with s.post(f"{base}/v1/models/gen:predict",
+                              json={"instances": ["abc"]}) as r:
+                assert r.status == 200
+            # a non-generative route check: unknown model 404s
+            async with s.post(f"{base}/v1/models/nope:generate",
+                              json={"prompt": "x"}) as r:
+                assert r.status == 404
+            # metadata reports the generative platform
+            async with s.get(f"{base}/v2/models/gen") as r:
+                meta = await r.json()
+            assert meta["platform"] == "jax-generate"
+            assert meta["max_slots"] == 2
+    finally:
+        await server.stop_async()
+
+
+async def test_generate_stream_chunks_arrive_incrementally(tmp_path):
+    """The streaming surface: SSE events ride chunked transfer, tokens
+    arrive progressively, and their concatenation equals the
+    non-streaming result."""
+    import aiohttp
+
+    from kfserving_tpu.server.app import ModelServer
+
+    model = GenerativeModel("gen", _write_model_dir(tmp_path))
+    model.load()
+    server = ModelServer(http_port=0)
+    await server.start_async([model], host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.http_port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/models/gen:generate",
+                              json={"prompt": "stream me",
+                                    "max_tokens": 6}) as r:
+                reference = (await r.json())["text_output"]
+            events = []
+            async with s.post(
+                    f"{base}/v2/models/gen/generate_stream",
+                    json={"text_input": "stream me",
+                          "max_tokens": 6}) as r:
+                assert r.status == 200
+                assert r.headers.get("Content-Type",
+                                     "").startswith("text/event-stream")
+                buffer = b""
+                async for chunk in r.content.iter_any():
+                    buffer += chunk
+                for line in buffer.decode().splitlines():
+                    if line.startswith("data: "):
+                        events.append(json.loads(line[6:]))
+        assert len(events) >= 2  # tokens arrived as separate events
+        text = "".join(e["token"]["text"] for e in events
+                       if "token" in e)
+        assert text == reference
+        final = events[-1]
+        assert final["finish_reason"] in ("eos", "length")
+        assert final["generated_text"] == reference
+    finally:
+        await server.stop_async()
+
+
+async def test_generate_stream_via_v1_stream_flag(tmp_path):
+    import aiohttp
+
+    from kfserving_tpu.server.app import ModelServer
+
+    model = GenerativeModel("gen", _write_model_dir(tmp_path))
+    model.load()
+    server = ModelServer(http_port=0)
+    await server.start_async([model], host="127.0.0.1")
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"http://127.0.0.1:{server.http_port}"
+                    "/v1/models/gen:generate",
+                    json={"prompt": "x", "max_tokens": 3,
+                          "stream": True}) as r:
+                assert r.status == 200
+                body = await r.read()
+        assert body.count(b"data: ") >= 1
+    finally:
+        await server.stop_async()
+
+
+async def test_generate_stream_bad_request_is_clean_4xx(tmp_path):
+    """Stream validation is eager: a prompt longer than the largest
+    prefill bucket gets a clean 400 BEFORE any streaming headers — not
+    a 200 followed by a dropped connection (code-review r4)."""
+    import aiohttp
+
+    from kfserving_tpu.server.app import ModelServer
+
+    model = GenerativeModel("gen", _write_model_dir(tmp_path))
+    model.load()
+    server = ModelServer(http_port=0)
+    await server.start_async([model], host="127.0.0.1")
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"http://127.0.0.1:{server.http_port}"
+                    "/v2/models/gen/generate_stream",
+                    json={"text_input": "x" * 500}) as r:
+                assert r.status == 400
+                body = await r.json()
+            assert "exceeds" in body["error"]
+            # Non-generative models reject the route cleanly too.
+            async with s.post(
+                    f"http://127.0.0.1:{server.http_port}"
+                    "/v2/models/gen/generate_stream",
+                    json={"wrong": 1}) as r:
+                assert r.status == 400
+    finally:
+        await server.stop_async()
+
+
+# ------------------------------------------------------- control plane
+
+
+async def test_generative_isvc_through_control_plane(tmp_path):
+    """framework='generative' joins the predictor one-of: deploys
+    through the controller, serves :generate via the ingress router."""
+    import aiohttp
+
+    from kfserving_tpu.control.controller import Controller
+    from kfserving_tpu.control.orchestrator import InProcessOrchestrator
+    from kfserving_tpu.control.router import IngressRouter
+    from kfserving_tpu.control.spec import InferenceService, PredictorSpec
+
+    model_dir = _write_model_dir(tmp_path)
+    orch = InProcessOrchestrator()
+    controller = Controller(orch)
+    router = IngressRouter(controller)
+    await router.start_async()
+    try:
+        isvc = InferenceService(
+            name="writer",
+            predictor=PredictorSpec(framework="generative",
+                                    storage_uri=model_dir))
+        status = await controller.apply(isvc)
+        assert status.ready
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"http://127.0.0.1:{router.http_port}"
+                    "/v1/models/writer:generate",
+                    json={"prompt": "abc", "max_tokens": 4}) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+        assert out["model_name"] == "writer"
+        assert out["details"]["token_count"] <= 4
+    finally:
+        await router.stop_async()
+        await orch.shutdown()
+
+
+# ------------------------------------------------------ tensor parallel
+
+
+async def test_generation_parity_under_tp_mesh(tmp_path):
+    """Tensor-parallel generation on the virtual mesh: tp=2 sharded
+    decode produces the same greedy tokens as unsharded — params shard
+    per Megatron rules, the KV cache shards on heads."""
+    unsharded = GenerativeModel("gen", _write_model_dir(tmp_path))
+    unsharded.load()
+    sharded = GenerativeModel(
+        "gen2", _write_model_dir(tmp_path),
+        config_overrides={"mesh": {"tp": 2}})
+    sharded.load()
+    try:
+        a = await unsharded.predict({"instances": ["parity check"]})
+        b = await sharded.predict({"instances": ["parity check"]})
+        assert a["predictions"][0]["text"] == b["predictions"][0]["text"]
+        assert (a["predictions"][0]["token_count"]
+                == b["predictions"][0]["token_count"])
+    finally:
+        await unsharded.close()
+        await sharded.close()
+
+
+def test_hbm_accounting_includes_cache(tmp_path):
+    from kfserving_tpu.engine.hbm import HBMManager
+
+    hbm = HBMManager(budget_bytes=1 << 30)
+    model = GenerativeModel("gen", _write_model_dir(tmp_path), hbm=hbm)
+    model.load()
+    try:
+        resident = hbm.used_bytes
+        # params + cache: cache alone is 2 layers * k+v * 2 slots *
+        # 64 seq * 2 heads * 32 dim * 4B = 262144
+        assert resident > model.engine.cache_bytes()
+        assert model.engine.cache_bytes() == 2 * 2 * 2 * 64 * 2 * 32 * 4
+    finally:
+        model.unload()
+    assert hbm.used_bytes == 0
