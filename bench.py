@@ -40,11 +40,113 @@ def _detect_smoke() -> bool:
         return True
 
 
+def probe_tunnel() -> dict:
+    """RTT + H2D bandwidth probe run BEFORE the matrix, classifying the
+    tunnel epoch so every bench record carries its own weather label
+    (ROOFLINE.md: healthy ~87-110ms RTT / 50-62 MB/s; degraded ~470ms /
+    26 MB/s — entire configs can land in different epochs).
+
+    Device-truth note: block_until_ready is only a dispatch ack on this
+    backend, so both measurements synchronize via a scalar fetch."""
+    import time
+
+    import numpy as np
+
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        backend = jax.default_backend()
+    except Exception as e:
+        return {"backend": "unavailable", "epoch": "unknown",
+                "error": str(e)}
+    if backend != "tpu":
+        return {"backend": backend, "epoch": "cpu"}
+    f = jax.jit(lambda a: (a * a).sum())
+    x = jnp.ones((8, 8))
+    float(f(x))  # backend init + compile outside the timing
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(f(x))  # scalar fetch = real round trip
+        rtts.append(time.perf_counter() - t0)
+    rtt_ms = sorted(rtts)[len(rtts) // 2] * 1e3
+    buf = np.zeros(19 * 1024 * 1024 // 4, np.float32)  # 19 MB
+    g = jax.jit(lambda a: a.sum())
+    bws = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        y = jax.device_put(buf)
+        float(g(y))  # sync includes one RTT; subtract the median
+        dt = max(time.perf_counter() - t0 - rtt_ms / 1e3, 1e-6)
+        bws.append(buf.nbytes / dt / 1e6)
+    bw = max(bws)
+    if rtt_ms < 250 and bw > 40:
+        epoch = "healthy"
+    elif rtt_ms > 350 or bw < 30:
+        epoch = "degraded"
+    else:
+        epoch = "mixed"
+    return {"backend": backend, "epoch": epoch,
+            "rtt_ms": round(rtt_ms, 1), "h2d_mb_s": round(bw, 1)}
+
+
+def _compact_configs(results: dict) -> dict:
+    """Per-config one-liners for the final stdout record (the full
+    blobs stay in BENCH_DETAIL.json — r2/r3 printed the whole detail
+    last and the driver's 4KB stdout tail lost the headline)."""
+    def pick(d, *keys):
+        d = d or {}
+        return {k: d.get(k) for k in keys if d.get(k) is not None}
+
+    out = {}
+    for name, r in results.items():
+        if not isinstance(r, dict):
+            continue
+        if "error" in r:
+            out[name] = {"error": str(r["error"])[:120]}
+            continue
+        cl = r.get("closed_loop") or {}
+        c = pick(cl, "req_per_s", "p50_ms", "p99_ms")
+        eng = r.get("engine") or {}
+        if "slot_pad_waste" in eng:
+            c["slot_pad_waste"] = eng["slot_pad_waste"]
+        if "mfu" in eng:
+            c["mfu"] = eng["mfu"]
+        if name == "resnet":
+            c["binary_req_per_s"] = (r.get("binary_wire_closed_loop")
+                                     or {}).get("req_per_s")
+            c["pipelined_req_per_s"] = (r.get("binary_wire_pipelined")
+                                        or {}).get("req_per_s")
+        elif name == "overload":
+            c["accepted_p99_improvement"] = r.get(
+                "accepted_p99_improvement")
+            c.update({
+                "gated_p99_ms": (r.get("admission") or {}).get(
+                    "p99_ms_median"),
+                "gateless_p99_ms": (r.get("gateless") or {}).get(
+                    "p99_ms_median"),
+            })
+        elif name == "bert_flash_ab":
+            c["xla_over_flash_sync"] = r.get("xla_over_flash_sync")
+        elif name == "generate":
+            c.update(pick(r, "tokens_per_s", "token_p50_ms",
+                          "token_p99_ms", "slot_occupancy"))
+        elif name == "multimodel":
+            c.update(pick(r, "load_all_s", "swap_cycle_ms",
+                          "round_robin_req_per_s"))
+        elif name == "longctx":
+            c["tokens_per_s"] = cl.get("tokens_per_s")
+        out[name] = c
+    return out
+
+
 def main():
     from kfserving_tpu.engine.compile_cache import enable as enable_cache
 
     enable_cache()
     smoke = _detect_smoke()
+    probe = probe_tunnel()
     only = [c for c in os.environ.get("BENCH_CONFIGS", "").split(",")
             if c]
 
@@ -81,7 +183,7 @@ def main():
 
     import jax
 
-    headline = {
+    detail = {
         "metric": "resnet50_v1_predict_http_throughput",
         "value": round(value, 2) if value else None,
         "unit": "req/s/chip",
@@ -99,12 +201,28 @@ def main():
         "cpu_baseline": cpu,
         "backend": jax.default_backend(),
         "smoke": smoke,
+        "probe": probe,
         "configs": results,
     }
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_DETAIL.json"), "w") as f:
-        json.dump(headline, f, indent=2)
-    print(json.dumps(headline))
+        json.dump(detail, f, indent=2)
+    # The driver records only the tail of stdout; the FINAL line must
+    # be a compact, self-contained record (r2/r3 printed the full
+    # detail blob here and the machine-readable headline was lost —
+    # VERDICT r3 weak #2).  Full per-config blobs live in
+    # BENCH_DETAIL.json, written above from this same run.
+    compact = {k: detail[k] for k in
+               ("metric", "value", "unit", "vs_baseline", "p50_ms",
+                "p99_ms", "binary_wire_req_per_s",
+                "pipelined_req_per_s", "mfu", "backend", "smoke",
+                "probe")}
+    compact["configs"] = _compact_configs(results)
+    line = json.dumps(compact)
+    if len(line) > 3500:  # stdout-tail budget: never let the record
+        compact["configs"] = {}  # outgrow what the driver captures
+        line = json.dumps(compact)
+    print(line)
 
 
 if __name__ == "__main__":

@@ -159,11 +159,34 @@ async def bench_resnet(smoke: bool) -> Dict[str, Any]:
                 "binary_wire_closed_loop": binary,
                 "binary_wire_pipelined": piped,
                 "grpc_closed_loop": grpc_res,
+                "tensorjson_parse": _tensorjson_parse_ab(body),
                 "compile_s": round(compile_s, 1),
                 "engine": {k: (round(v, 4) if isinstance(v, float) else v)
                            for k, v in stats.items()}}
     finally:
         await server.stop_async()
+
+
+def _tensorjson_parse_ab(body: bytes) -> Dict[str, Any]:
+    """Parse-throughput A/B for the V1 JSON intake (VERDICT r4 item 5):
+    the classic i4 path vs the uint8 hint path on the same image body.
+    Deterministic host-CPU measurement — no tunnel weather."""
+    from kfserving_tpu.protocol import native
+
+    if not native.available():
+        return {"skipped": "native codec unavailable"}
+    n = 30
+    out: Dict[str, Any] = {"body_mb": round(len(body) / 1e6, 2)}
+    for label, hint in (("i4_mb_s", None), ("u1_mb_s", "u1")):
+        native.parse_v1(body, hint=hint)  # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            native.parse_v1(body, hint=hint)
+        dt = time.perf_counter() - t0
+        out[label] = round(n * len(body) / dt / 1e6, 1)
+    if out.get("i4_mb_s"):
+        out["u1_over_i4"] = round(out["u1_mb_s"] / out["i4_mb_s"], 3)
+    return out
 
 
 async def _grpc_closed_loop(server, model_name: str, arr,

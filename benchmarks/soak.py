@@ -30,7 +30,9 @@ import numpy as np
 
 
 async def run_soak(minutes: float, qps: float, max_rss_mb: float,
-                   smoke: bool) -> dict:
+                   smoke: bool, max_requests: int = None,
+                   buffer_deadline_s: float = 15.0,
+                   overlap: bool = True) -> dict:
     import aiohttp
 
     from kfserving_tpu.control.controller import Controller
@@ -68,10 +70,13 @@ async def run_soak(minutes: float, qps: float, max_rss_mb: float,
     orch = SubprocessOrchestrator(
         env_overrides=env,
         recycle=RecyclePolicy(max_rss_mb=max_rss_mb,
+                              max_requests=max_requests,
                               check_interval_s=2.0 if smoke else 5.0,
-                              overlap=False))
+                              overlap=overlap,
+                              min_age_s=10.0 if smoke else 30.0))
     controller = Controller(orch)
-    router = IngressRouter(controller, upstream_timeout_s=180.0)
+    router = IngressRouter(controller, upstream_timeout_s=180.0,
+                           buffer_deadline_s=buffer_deadline_s)
     await router.start_async()
     results = {"ok": 0, "fail": 0, "statuses": {}}
     rss_samples = []
@@ -142,10 +147,17 @@ async def run_soak(minutes: float, qps: float, max_rss_mb: float,
 
         return {
             "minutes": minutes, "qps": qps, "max_rss_mb": max_rss_mb,
+            "max_requests": max_requests,
+            "buffer_deadline_s": buffer_deadline_s,
+            "overlap": overlap,
             "requests": results["ok"] + results["fail"],
             "ok": results["ok"], "fail": results["fail"],
             "statuses": results["statuses"],
             "recycles": orch.recycle_count,
+            # Chip-release -> successor-serving gap per swap (the
+            # standby fast-path's figure of merit; r3 was ~22-30s).
+            "swap_windows_s": list(orch.swap_windows_s),
+            "swap_breakdown": list(orch.swap_breakdown),
             "p50_ms": round(percentile(lat, 0.5), 1) if lat else None,
             "p99_ms": round(percentile(lat, 0.99), 1) if lat else None,
             "max_ms": round(lat[-1], 1) if lat else None,
@@ -159,14 +171,30 @@ async def run_soak(minutes: float, qps: float, max_rss_mb: float,
 
 
 def main():
+    import logging
+    import sys
+
+    # Recycle decisions and swap windows are INFO-level; the soak's
+    # record must show them (a silent watchdog is indistinguishable
+    # from a healthy no-trigger run otherwise).
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=6.0)
     ap.add_argument("--qps", type=float, default=60.0)
     ap.add_argument("--max-rss-mb", type=float, default=4096.0)
+    ap.add_argument("--max-requests", type=int, default=None,
+                    help="recycle every N served requests (deterministic "
+                         ">=2 swaps per soak)")
+    ap.add_argument("--buffer-deadline-s", type=float, default=15.0)
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="exclusive-device mode: standby fast-swap "
+                         "instead of the zero-gap overlapped swap")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args()
     out = asyncio.run(run_soak(args.minutes, args.qps, args.max_rss_mb,
-                               args.smoke))
+                               args.smoke, args.max_requests,
+                               args.buffer_deadline_s,
+                               overlap=not args.no_overlap))
     with open("SOAK.json", "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))

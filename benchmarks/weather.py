@@ -1,0 +1,36 @@
+"""Tunnel weather sampler: append one probe record to WEATHER.jsonl.
+
+PARITY.md's honest-ranges story rests on the tunnel epoch distribution
+(healthy ~87-110ms RTT / 50-62 MB/s vs degraded ~470ms / 26 MB/s);
+round 3 approximated it from ad-hoc repeats.  This samples it
+explicitly: run `python -m benchmarks.weather` at intervals (cron,
+loops between bench phases) and the record accumulates
+(timestamp, rtt_ms, h2d_mb_s, epoch).
+
+The probe is bench.probe_tunnel(): scalar-fetch RTT (block_until_ready
+is only a dispatch ack on this transport) + a 19MB device_put.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import probe_tunnel
+
+    rec = {"t": round(time.time(), 1),
+           "iso": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    rec.update(probe_tunnel())
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "WEATHER.jsonl")
+    with open(out, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

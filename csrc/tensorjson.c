@@ -10,12 +10,16 @@
  *
  * Exposed functions (see kfserving_tpu/protocol/native.py for the
  * integration and the pure-Python fallback):
- *   parse_v1(body: bytes) -> (data: bytes, shape: tuple, key: str,
- *                             dtype: str, extra: int)
+ *   parse_v1(body: bytes, hint: str = None)
+ *       -> (data: bytes, shape: tuple, key: str, dtype: str, extra: int)
  *       Parses {"instances": <dense array>} or {"inputs": ...}.
  *       `extra` is 1 when the body carried other top-level keys
  *       (parameters, signature_name, ...) — the caller must fall back
  *       to a full decode so those keys reach the model unchanged.
+ *       hint="u1" (from the served model's declared input_dtype) emits
+ *       a uint8 buffer directly when every value is integral in
+ *       [0, 255] — the image-intake fast path skips the int32
+ *       intermediate and the per-batch astype copy.
  *       Raises ValueError on ragged/non-numeric arrays or other JSON
  *       (caller falls back to json.loads for those).
  *   dump_f32(data: bytes, shape: tuple) -> bytes
@@ -42,6 +46,7 @@ typedef struct {
     Py_ssize_t dims[MAX_DEPTH];
     int ndim;            /* set when the first leaf array completes */
     int all_int;         /* every value integral and within int32 */
+    int all_u8;          /* every value integral and within [0, 255] */
 } Parser;
 
 static int
@@ -192,6 +197,8 @@ parse_dense(Parser *ps, int d)
                 if (ps->all_int &&
                     (v < -2147483648.0 || v > 2147483647.0))
                     ps->all_int = 0;
+                if (ps->all_u8 && (neg || iv > 255))
+                    ps->all_u8 = 0;
             }
             else {
                 char *endptr;
@@ -201,6 +208,7 @@ parse_dense(Parser *ps, int d)
                 ps->p = endptr;
                 /* slow-path tokens are float-looking or huge: demote */
                 ps->all_int = 0;
+                ps->all_u8 = 0;
             }
             if (ps->ndim == 0)
                 ps->ndim = d + 1;   /* leaves live at this depth */
@@ -232,8 +240,12 @@ parse_dense(Parser *ps, int d)
 }
 
 static PyObject *
-py_parse_v1(PyObject *self, PyObject *arg)
+py_parse_v1(PyObject *self, PyObject *args)
 {
+    PyObject *arg;
+    const char *hint = NULL;   /* "u1": emit uint8 when values fit */
+    if (!PyArg_ParseTuple(args, "O|z", &arg, &hint))
+        return NULL;
     Py_buffer view;
     if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
         return NULL;
@@ -242,6 +254,7 @@ py_parse_v1(PyObject *self, PyObject *arg)
     ps.p = (const char *)view.buf;
     ps.end = ps.p + view.len;
     ps.all_int = 1;
+    ps.all_u8 = 1;
     for (int i = 0; i < MAX_DEPTH; i++)
         ps.dims[i] = -1;
 
@@ -306,14 +319,27 @@ py_parse_v1(PyObject *self, PyObject *arg)
             PyTuple_SET_ITEM(shape, i,
                              PyLong_FromSsize_t(ps.dims[i] < 0 ? 0
                                                                : ps.dims[i]));
-        /* Emit int32 when every token was integral (class labels / token
-         * ids round-trip as ints), float32 otherwise. */
-        const char *dtype = ps.all_int ? "i4" : "f4";
+        /* Emit uint8 when the caller asked for it AND every token fits
+         * (the image-intake fast path: the batch reaches the engine in
+         * wire dtype, no int32 intermediate or astype copy).  The hint
+         * comes from the served model's declared input_dtype — never
+         * from value range alone, which would flip dtypes per request
+         * and churn the engine's compiled signatures.  Otherwise:
+         * int32 when integral (class labels / token ids round-trip as
+         * ints), float32 else. */
+        int emit_u8 = (hint != NULL && strcmp(hint, "u1") == 0 &&
+                       ps.all_u8);
+        const char *dtype = emit_u8 ? "u1" : (ps.all_int ? "i4" : "f4");
         PyObject *bytes = PyBytes_FromStringAndSize(
-            NULL, (Py_ssize_t)(ps.len * 4));
+            NULL, (Py_ssize_t)(ps.len * (emit_u8 ? 1 : 4)));
         if (bytes != NULL) {
             char *dst = PyBytes_AS_STRING(bytes);
-            if (ps.all_int) {
+            if (emit_u8) {
+                uint8_t *out8 = (uint8_t *)dst;
+                for (size_t i = 0; i < ps.len; i++)
+                    out8[i] = (uint8_t)ps.data[i];
+            }
+            else if (ps.all_int) {
                 int32_t *out32 = (int32_t *)dst;
                 for (size_t i = 0; i < ps.len; i++)
                     out32[i] = (int32_t)ps.data[i];
@@ -458,8 +484,10 @@ py_dump_f32(PyObject *self, PyObject *args)
 }
 
 static PyMethodDef methods[] = {
-    {"parse_v1", py_parse_v1, METH_O,
-     "Parse a dense V1 predict body into (float32 bytes, shape, key)."},
+    {"parse_v1", py_parse_v1, METH_VARARGS,
+     "parse_v1(body, hint=None): parse a dense V1 predict body into "
+     "(bytes, shape, key, dtype, extra); hint='u1' emits uint8 when "
+     "every value is integral in [0, 255]."},
     {"dump_f32", py_dump_f32, METH_VARARGS,
      "Serialize a float32 tensor as a nested JSON array (bytes)."},
     {NULL, NULL, 0, NULL},

@@ -59,6 +59,29 @@ DEFAULT_PREDICTOR_RUNTIMES = {
         "multiModel": False,
         "defaultTimeout": 60,
     },
+    # External server runtimes (reference TFServing/Triton/ONNX images;
+    # SURVEY §2.1 "keep all 9").  `command` is the server binary (a
+    # deployment concern — point it at the installed binary or a
+    # wrapper); `argStyle` picks the runtime's own CLI convention in
+    # subprocess_orchestrator._external_command.
+    "tensorflow": {
+        "command": ["tensorflow_model_server"],
+        "argStyle": "tfserving",
+        "defaultImageVersion": "1.14.0",
+        "defaultTimeout": 60,
+    },
+    "triton": {
+        "command": ["tritonserver"],
+        "argStyle": "triton",
+        "defaultImageVersion": "20.03-py3",
+        "defaultTimeout": 60,
+    },
+    "onnx": {
+        "command": ["onnx_server"],
+        "argStyle": "onnx",
+        "defaultImageVersion": "v1.0.0",
+        "defaultTimeout": 60,
+    },
 }
 
 

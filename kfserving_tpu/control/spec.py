@@ -23,7 +23,16 @@ from typing import Any, Dict, List, Optional
 # reference's pytorchserver contract on the host CPU for migration).
 PREDICTOR_FRAMEWORKS = (
     "jax", "generative", "sklearn", "xgboost", "lightgbm", "pmml",
-    "pytorch", "custom")
+    "pytorch", "tensorflow", "triton", "onnx", "custom")
+
+# Frameworks served by EXTERNAL server binaries (the reference's
+# TFServing/Triton/ONNXRuntime container images, predictor.go:33-59):
+# the subprocess orchestrator builds their argv per the runtime's own
+# CLI convention from the cluster config's command entry
+# (predictor_tfserving.go:84-90, predictor_triton.go:59-67,
+# predictor_onnxruntime.go:67-72).  The binaries are deployment
+# config — not bundled here.
+EXTERNAL_RUNTIME_FRAMEWORKS = ("tensorflow", "triton", "onnx")
 
 NAME_REGEX = re.compile(r"^[a-z]([-a-z0-9]*[a-z0-9])?$")  # k8s DNS-1035
 STORAGE_URI_PREFIXES = (

@@ -38,6 +38,20 @@ def validate(isvc: InferenceService) -> None:
     elif not pred.storage_uri and not pred.multi_model:
         errors.append("predictor.storage_uri is required "
                       "(non-multi-model)")
+    from kfserving_tpu.control.spec import EXTERNAL_RUNTIME_FRAMEWORKS
+
+    if pred.framework in EXTERNAL_RUNTIME_FRAMEWORKS:
+        if not pred.storage_uri:
+            errors.append(
+                f"{pred.framework} predictor requires storage_uri")
+        if pred.framework == "onnx" and pred.storage_uri:
+            # Reference rule: .onnx file or a directory
+            # (predictor_onnxruntime.go:47-53).
+            base = pred.storage_uri.rsplit("/", 1)[-1]
+            if "." in base and not base.endswith(".onnx"):
+                errors.append(
+                    f"onnx storage_uri must point at a .onnx file or "
+                    f"a directory, got {pred.storage_uri!r}")
     if pred.storage_uri and not pred.storage_uri.startswith(
             tuple(STORAGE_URI_PREFIXES)):
         errors.append(

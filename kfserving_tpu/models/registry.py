@@ -64,14 +64,22 @@ def create_model(name: str, **kwargs) -> ModelSpec:
 
 def init_params(spec: ModelSpec, seed: int = 0):
     """Initialize variables for a ModelSpec (random weights — serving tests
-    and benchmarks measure compute, not accuracy)."""
+    and benchmarks measure compute, not accuracy).
+
+    The init runs under jit: eager flax init dispatches one device op
+    per parameter, which on a tunneled chip is hundreds of ~100ms round
+    trips (measured 13s for ResNet-50 — the dominant term of the r3
+    recycle brownout).  Jitted, it is one compiled program (persistent-
+    cache-hot on respawn) and one execution."""
     import jax
 
     rng = jax.random.PRNGKey(seed)
     example = spec.example
     if isinstance(example, dict):
-        return spec.module.init(rng, **example)
-    return spec.module.init(rng, example)
+        init = jax.jit(lambda r: spec.module.init(r, **example))
+    else:
+        init = jax.jit(lambda r: spec.module.init(r, example))
+    return init(rng)
 
 
 def apply_fn_for(spec: ModelSpec) -> Callable:

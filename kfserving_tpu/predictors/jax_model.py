@@ -323,6 +323,16 @@ class JaxModel(Model):
         self.batcher = None
         self.ready = False
 
+    @property
+    def wire_dtype(self):
+        """Dtype hint for the server's native V1 JSON parser: uint8
+        models take integer image bodies straight to uint8 on the wire
+        (tensorjson fast path; ROOFLINE.md: V1 JSON intake is the
+        ~400 req/s wall)."""
+        if self.config is not None and self.config.input_dtype == "uint8":
+            return "u1"
+        return None
+
     # -- inference ---------------------------------------------------------
     def _bucket_key(self, instance: Any):
         """Seq-bucket key: instances whose (padded) seq length lands in
@@ -515,6 +525,12 @@ class JaxModel(Model):
                 "batches_flushed": self.batcher.batches_flushed,
                 "instances_batched": self.batcher.instances_batched,
             })
+            if self.batcher.queue_age_ms:
+                # Per-bucket flush-time queue age — exported as labeled
+                # series on /metrics (starvation diagnostic).
+                stats["bucket_queue_age_max_ms"] = {
+                    str(k): v["max"]
+                    for k, v in self.batcher.queue_age_ms.items()}
         return stats
 
 

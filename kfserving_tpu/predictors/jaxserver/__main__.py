@@ -77,10 +77,20 @@ def build_server(args) -> ModelServer:
 
 
 if __name__ == "__main__":
+    import os
+
     args, _ = parser.parse_known_args()
     enable_compile_cache()
     server = build_server(args)
     if args.multi_model or args.config_dir:
+        server.start([])
+    elif os.environ.get("KFS_STANDBY"):
+        # Recycle fast-swap: imports and server setup are done, but the
+        # model load (device init + compile) waits for the orchestrator
+        # to POST /standby/activate once the predecessor releases the
+        # chip (subprocess_orchestrator recycle path).
+        model = JaxModel(args.model_name, args.model_dir)
+        server.standby_model(lambda: (model.load(), model)[1])
         server.start([])
     else:
         model = JaxModel(args.model_name, args.model_dir)

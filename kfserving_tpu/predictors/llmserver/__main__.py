@@ -46,9 +46,17 @@ def build_server(args) -> ModelServer:
 
 
 if __name__ == "__main__":
+    import os
+
     args, _ = parser.parse_known_args()
     enable_compile_cache()
     server = build_server(args)
     model = GenerativeModel(args.model_name, args.model_dir)
-    model.load()
-    server.start([model])
+    if os.environ.get("KFS_STANDBY"):
+        # Recycle fast-swap: load (device init + compile) deferred to
+        # POST /standby/activate — see jaxserver/__main__.py.
+        server.standby_model(lambda: (model.load(), model)[1])
+        server.start([])
+    else:
+        model.load()
+        server.start([model])

@@ -38,10 +38,12 @@ def _load():
     try:
         import _tensorjson  # type: ignore
 
-        # API probe: parse_v1 must report extra top-level keys (5-tuple).
-        # A stale prebuilt .so with the 4-tuple API would silently drop
-        # keys like parameters/signature_name, so refuse it.
-        probe = _tensorjson.parse_v1(b'{"instances": [1], "x": 1}')
+        # API probe: parse_v1 must report extra top-level keys (5-tuple)
+        # AND accept the dtype hint (2-arg form).  A stale prebuilt .so
+        # with either older API would drop keys or raise TypeError on
+        # every hinted call, so refuse it.
+        probe = _tensorjson.parse_v1(b'{"instances": [1], "x": 1}',
+                                     "u1")
         if len(probe) != 5:
             logger.warning(
                 "stale _tensorjson extension (no extra-keys flag); "
@@ -50,6 +52,11 @@ def _load():
         else:
             _native = _tensorjson
             logger.info("native tensorjson codec loaded")
+    except TypeError:
+        logger.warning(
+            "stale _tensorjson extension (no dtype-hint arg); using "
+            "pure-Python codec — rebuild with native.build(force=True)")
+        _native = False
     except (ImportError, ValueError):
         _native = False
     return _native
@@ -78,8 +85,17 @@ def available() -> bool:
     return bool(_load())
 
 
-def parse_v1(body: bytes) -> Optional[Tuple[np.ndarray, str]]:
+_DTYPES = {"u1": np.uint8, "i4": np.int32, "f4": np.float32}
+
+
+def parse_v1(body: bytes, hint: Optional[str] = None
+             ) -> Optional[Tuple[np.ndarray, str]]:
     """Parse a dense V1 body -> (array, key) or None if ineligible.
+
+    hint="u1" (the served model's declared uint8 wire dtype) parses
+    integer image bodies straight into uint8 — no int32 intermediate,
+    no astype copy downstream.  The hint is advisory: values outside
+    [0, 255] emit the normal i4/f4 and the model's own cast handles it.
 
     Never raises for non-dense bodies: the caller falls back to
     json.loads.
@@ -87,7 +103,7 @@ def parse_v1(body: bytes) -> Optional[Tuple[np.ndarray, str]]:
     mod = _load()
     if mod:
         try:
-            out = mod.parse_v1(body)
+            out = mod.parse_v1(body, hint)
         except ValueError:
             return None
         data, shape, key, dtype, extra = out
@@ -97,14 +113,13 @@ def parse_v1(body: bytes) -> Optional[Tuple[np.ndarray, str]]:
             # silently drop them before model.preprocess, so fall back
             # to the full json.loads decode.
             return None
-        arr = np.frombuffer(
-            data, dtype=np.int32 if dtype == "i4" else np.float32
-        ).reshape(shape)
+        arr = np.frombuffer(data, dtype=_DTYPES[dtype]).reshape(shape)
         return arr, key
-    return _parse_v1_py(body)
+    return _parse_v1_py(body, hint)
 
 
-def _parse_v1_py(body: bytes) -> Optional[Tuple[np.ndarray, str]]:
+def _parse_v1_py(body: bytes, hint: Optional[str] = None
+                 ) -> Optional[Tuple[np.ndarray, str]]:
     """Pure-Python fallback with identical eligibility rules."""
     try:
         obj = json.loads(body)
@@ -129,6 +144,9 @@ def _parse_v1_py(body: bytes) -> Optional[Tuple[np.ndarray, str]]:
     if np.issubdtype(arr.dtype, np.integer):
         if arr.size and (np.abs(arr) > np.iinfo(np.int32).max).any():
             arr = arr.astype(np.float32)
+        elif hint == "u1" and (not arr.size or
+                               (arr.min() >= 0 and arr.max() <= 255)):
+            arr = arr.astype(np.uint8)
         else:
             arr = arr.astype(np.int32)
     elif np.issubdtype(arr.dtype, np.floating):

@@ -168,6 +168,23 @@ class ModelServer:
         self._admission = (
             _AdmissionGate(container_concurrency, self.max_queue_depth)
             if container_concurrency > 0 else None)
+        # Standby: a callable that performs the deferred (device-
+        # touching) model load; set via standby_model().
+        self._standby_fn = None
+        self._standby_state = "none"  # none | armed | activating | done
+
+    def standby_model(self, activate_fn) -> None:
+        """Arm standby mode: the server starts with NO model and
+        `activate_fn` (blocking; returns the loaded Model) runs on the
+        first POST /standby/activate.
+
+        This is the chip-owner recycle fast-path: everything that does
+        NOT need the TPU — interpreter start, jax/flax imports, artifact
+        download, config parse — happens while the predecessor still
+        owns the chip, so the swap gap shrinks to device init + cache-
+        hot compile + warmup."""
+        self._standby_fn = activate_fn
+        self._standby_state = "armed"
 
     # -- routes ------------------------------------------------------------
     def _register_routes(self):
@@ -207,6 +224,10 @@ class ModelServer:
         r.add("POST", "/v2/repository/models/{name}/unload", self._unload)
         r.add("GET", "/v2/repository/index", self._repository_index)
         r.add("GET", "/metrics", self._metrics)
+        # Standby activation (recycle fast-swap): a successor process
+        # boots with imports/download done but the device untouched;
+        # the orchestrator POSTs here once the old chip owner exits.
+        r.add("POST", "/standby/activate", self._standby_activate)
         # Tracing/profiling surface (SURVEY §5.1).
         r.add("GET", "/debug/traces", self._traces)
         r.add("POST", "/debug/profiler/start", self._profiler_start)
@@ -297,7 +318,9 @@ class ModelServer:
         status = 200
         try:
             with tracer.span("server.decode", model=name, verb=verb):
-                body = self.dataplane.decode_body(req.headers, req.body)
+                body = self.dataplane.decode_body(
+                    req.headers, req.body,
+                    dtype_hint=self.dataplane.wire_dtype_hint(name))
             with tracer.span("server.infer", model=name, verb=verb):
                 response = await op(name, body)
             with tracer.span("server.encode", model=name, verb=verb):
@@ -364,20 +387,50 @@ class ModelServer:
     async def _generate_stream(self, req: Request,
                                body: Any = None) -> Response:
         from kfserving_tpu.server.http import StreamingResponse
+        from kfserving_tpu.tracing import (
+            REQUEST_ID_HEADER,
+            ensure_request_id,
+        )
 
         name = req.path_params["name"]
+        rid = ensure_request_id(req.headers)
         if body is None:
             try:
                 body = json.loads(req.body) if req.body else {}
             except ValueError:
                 return _json({"error": "malformed JSON body"},
                              status=400)
+        # Streams go through the SAME admission gate as every other
+        # inference verb — they are the longest-lived, slot-holding
+        # requests in the system, exactly what containerConcurrency
+        # exists to bound.  The slot is held until the stream ends
+        # (released in sse()'s finally, since the body outlives this
+        # handler).
+        gated = False
+        if self._admission is not None:
+            if not await self._admission.enter():
+                resp = _json({"error": "concurrency limit exceeded"},
+                             status=503)
+                self.metrics.observe_request(name, "generate_stream",
+                                             503, 0.0)
+                resp.headers[REQUEST_ID_HEADER] = rid
+                return resp
+            gated = True
         try:
             events = await self.dataplane.generate_stream(name, body)
         except ServingError as e:
-            return _error(e)
+            if gated:
+                self._admission.exit()
+            resp = _error(e)
+            resp.headers[REQUEST_ID_HEADER] = rid
+            return resp
+        except Exception:
+            if gated:
+                self._admission.exit()
+            raise
         start = time.perf_counter()
         metrics, hooks = self.metrics, self.request_hooks
+        admission = self._admission if gated else None
 
         async def sse():
             status = 200
@@ -390,6 +443,8 @@ class ModelServer:
                 status = 500
                 raise
             finally:
+                if admission is not None:
+                    admission.exit()
                 latency_ms = (time.perf_counter() - start) * 1000.0
                 metrics.observe_request(name, "generate_stream",
                                         status, latency_ms)
@@ -400,7 +455,30 @@ class ModelServer:
                     except Exception:
                         logger.exception("request hook failed")
 
-        return StreamingResponse(sse())
+        return StreamingResponse(sse(),
+                                 headers={REQUEST_ID_HEADER: rid})
+
+    async def _standby_activate(self, req: Request) -> Response:
+        if self._standby_fn is None:
+            return _json({"error": "server is not in standby mode"},
+                         status=409)
+        if self._standby_state == "done":
+            return _json({"activated": True, "already": True})
+        if self._standby_state == "activating":
+            return _json({"error": "activation already in progress"},
+                         status=409)
+        self._standby_state = "activating"
+        try:
+            model = await asyncio.get_running_loop().run_in_executor(
+                None, self._standby_fn)
+            self.register_model(model)
+            self._standby_state = "done"
+        except Exception as e:
+            self._standby_state = "armed"  # retryable
+            logger.exception("standby activation failed")
+            return _json({"error": f"activation failed: {e}"},
+                         status=500)
+        return _json({"activated": True, "model": model.name})
 
     async def _load(self, req: Request) -> Response:
         name = req.path_params["name"]

@@ -70,13 +70,23 @@ class DataPlane:
             await maybe_await(model.load())
         return model
 
-    def decode_body(self, headers: Dict[str, str], body: bytes) -> Any:
+    def wire_dtype_hint(self, name: str) -> Any:
+        """The served model's preferred wire dtype (e.g. "u1" for uint8
+        image models), handed to the native parser so integer bodies
+        land in the model's dtype directly."""
+        model = self.repository.get_model(name)
+        return getattr(model, "wire_dtype", None)
+
+    def decode_body(self, headers: Dict[str, str], body: bytes,
+                    dtype_hint: Any = None) -> Any:
         """Decode a request body: CloudEvent (binary or structured) or JSON.
 
         Dense numeric V1 bodies take the native tensorjson fast path
-        (protocol/native.py): one C pass straight into a float32 array,
-        no per-element PyObjects.  Everything else (CloudEvents, V2
-        tensor objects, dict instances, strings) decodes as before.
+        (protocol/native.py): one C pass straight into a contiguous
+        array — uint8 when `dtype_hint` says the model takes uint8 and
+        the values fit, else int32/float32.  Everything else
+        (CloudEvents, V2 tensor objects, dict instances, strings)
+        decodes as before.
         """
         if cloudevents.has_ce_headers(headers) or cloudevents.is_structured(headers):
             try:
@@ -91,7 +101,7 @@ class DataPlane:
             except ValueError as e:
                 raise InvalidInput(str(e))
         if body[:1] == b"{" and b'"datatype"' not in body:
-            fast = native.parse_v1(body)
+            fast = native.parse_v1(body, hint=dtype_hint)
             if fast is not None:
                 arr, key = fast
                 return {key: arr}

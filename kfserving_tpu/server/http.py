@@ -305,30 +305,43 @@ class _HttpProtocol(asyncio.Protocol):
         self.transport.write(
             ("\r\n".join(lines) + "\r\n\r\n").encode("latin1"))
         try:
-            async for chunk in response.chunks:
-                if not chunk:
-                    continue
-                if self.transport is None or self.transport.is_closing():
-                    return  # client went away: stop producing
-                self.transport.write(b"%x\r\n" % len(chunk) + chunk
-                                     + b"\r\n")
-                # Real backpressure: when the transport's write buffer
-                # passes the high-water mark, asyncio calls
-                # pause_writing — wait for resume so a slow client
-                # doesn't buffer the whole generation in memory.
-                await self._can_write.wait()
-        except Exception:
-            logger.exception("streaming body failed mid-response")
-            # Mid-stream failure: the chunked framing is already
-            # committed; terminate the connection so the client sees a
-            # truncated stream, not a silent success.
-            if self.transport is not None:
-                self.transport.close()
-            return
-        if self.transport is not None and not self.transport.is_closing():
-            self.transport.write(b"0\r\n\r\n")
-            if not keepalive:
-                self.transport.close()
+            try:
+                async for chunk in response.chunks:
+                    if not chunk:
+                        continue
+                    if self.transport is None or \
+                            self.transport.is_closing():
+                        return  # client went away: stop producing
+                    self.transport.write(b"%x\r\n" % len(chunk) + chunk
+                                         + b"\r\n")
+                    # Real backpressure: when the transport's write
+                    # buffer passes the high-water mark, asyncio calls
+                    # pause_writing — wait for resume so a slow client
+                    # doesn't buffer the whole generation in memory.
+                    await self._can_write.wait()
+            except Exception:
+                logger.exception("streaming body failed mid-response")
+                # Mid-stream failure: the chunked framing is already
+                # committed; terminate the connection so the client
+                # sees a truncated stream, not a silent success.
+                if self.transport is not None:
+                    self.transport.close()
+                return
+            if self.transport is not None and \
+                    not self.transport.is_closing():
+                self.transport.write(b"0\r\n\r\n")
+                if not keepalive:
+                    self.transport.close()
+        finally:
+            # Close the producer NOW on any exit path (client gone,
+            # mid-stream failure): its finally blocks release admission
+            # slots and engine work — waiting for GC would leak them.
+            aclose = getattr(response.chunks, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:
+                    logger.exception("closing stream producer failed")
 
     def _fail(self, status: int, reason: str):
         # Chain behind any in-flight response so a pipelined connection never

@@ -14,6 +14,12 @@ from typing import Dict, List, Tuple
 LATENCY_BUCKETS_MS = [0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
                       5000, 10000]
 
+# The request counter's series name, shared with every consumer that
+# scrapes it (the recycling watchdog's max_requests trigger keys on this
+# literal — a rename here without the constant would silently disable
+# request-count recycling).
+REQUEST_TOTAL_SERIES = "kfserving_tpu_request_total"
+
 
 class Histogram:
     __slots__ = ("buckets", "counts", "total", "sum")
@@ -57,12 +63,13 @@ class Metrics:
 
     def render(self) -> str:
         lines = [
-            "# HELP kfserving_tpu_request_total Total requests by model/verb/status",
-            "# TYPE kfserving_tpu_request_total counter",
+            f"# HELP {REQUEST_TOTAL_SERIES} Total requests by "
+            f"model/verb/status",
+            f"# TYPE {REQUEST_TOTAL_SERIES} counter",
         ]
         for (model, verb, status), count in sorted(self.request_count.items()):
             lines.append(
-                f'kfserving_tpu_request_total{{model="{model}",verb="{verb}",'
+                f'{REQUEST_TOTAL_SERIES}{{model="{model}",verb="{verb}",'
                 f'status="{status}"}} {count}')
         lines += [
             "# HELP kfserving_tpu_request_latency_ms Request latency histogram",

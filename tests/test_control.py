@@ -67,7 +67,9 @@ def test_defaults():
 # ------------------------------------------------------------ validation --
 @pytest.mark.parametrize("mutate,match", [
     (lambda i: setattr(i, "name", "Bad_Name"), "must match"),
-    (lambda i: setattr(i.predictor, "framework", "tensorflow"),
+    # tensorflow/triton/onnx are valid external runtimes since r4;
+    # only a genuinely unknown framework is rejected.
+    (lambda i: setattr(i.predictor, "framework", "caffe2"),
      "must be one of"),
     (lambda i: setattr(i.predictor, "storage_uri", "ftp://x"),
      "must start with"),

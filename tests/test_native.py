@@ -54,6 +54,81 @@ def test_extra_keys_reach_model_via_decode_body():
     assert decoded["instances"] == [[1.0, 2.0]]
 
 
+def test_uint8_hint_fast_path():
+    """hint='u1' parses integer image bodies straight to uint8; values
+    outside [0, 255] or floats fall back to i4/f4 so the model's own
+    cast stays correct (VERDICT r4 item 5)."""
+    body = json.dumps({"instances": [[0, 128, 255], [1, 2, 3]]}).encode()
+    arr, key = native.parse_v1(body, hint="u1")
+    assert arr.dtype == np.uint8
+    np.testing.assert_array_equal(arr, [[0, 128, 255], [1, 2, 3]])
+    # without the hint: int32, unchanged behavior
+    arr2, _ = native.parse_v1(body)
+    assert arr2.dtype == np.int32
+    np.testing.assert_array_equal(arr, arr2)
+    # overflow demotes to i4 (the cast downstream handles it)
+    a256, _ = native.parse_v1(b'{"instances": [[1, 256]]}', hint="u1")
+    assert a256.dtype == np.int32
+    np.testing.assert_array_equal(a256, [[1, 256]])
+    # negatives demote to i4 — a (uint8)(-1) wraparound would be
+    # silently wrong
+    aneg, _ = native.parse_v1(b'{"instances": [[-1, 5]]}', hint="u1")
+    assert aneg.dtype == np.int32
+    np.testing.assert_array_equal(aneg, [[-1, 5]])
+    # floats ignore the hint entirely
+    af, _ = native.parse_v1(b'{"instances": [[1.5, 2]]}', hint="u1")
+    assert af.dtype == np.float32
+
+
+def test_uint8_hint_python_fallback_parity():
+    cases = [b'{"instances": [[0, 255]]}', b'{"instances": [[1, 256]]}',
+             b'{"instances": [[-1, 1]]}', b'{"instances": [[1.5, 1]]}']
+    for body in cases:
+        a = native.parse_v1(body, hint="u1")
+        b = native._parse_v1_py(body, hint="u1")
+        if a is None:
+            assert b is None
+            continue
+        assert a[0].dtype == b[0].dtype, body
+        np.testing.assert_array_equal(a[0], b[0])
+
+
+def test_decode_body_uses_model_wire_dtype(tmp_path):
+    """The server passes the served model's wire dtype into the parser:
+    a uint8 jax model's V1 integer body arrives as uint8."""
+    import os
+
+    from kfserving_tpu.model.repository import ModelRepository
+    from kfserving_tpu.predictors.jax_model import JaxModel
+    from kfserving_tpu.server.dataplane import DataPlane
+
+    model_dir = str(tmp_path / "u8m")
+    os.makedirs(model_dir)
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump({"architecture": "mlp",
+                   "arch_kwargs": {"input_dim": 4, "features": [8],
+                                   "num_classes": 3},
+                   "input_dtype": "uint8", "scale": 1.0 / 255,
+                   "warmup": False, "output": "argmax"}, f)
+    model = JaxModel("u8m", model_dir)
+    model.load()
+    try:
+        assert model.wire_dtype == "u1"
+        repo = ModelRepository()
+        repo.update(model)
+        dp = DataPlane(repo)
+        body = b'{"instances": [[0, 10, 200, 255]]}'
+        decoded = dp.decode_body({}, body,
+                                 dtype_hint=dp.wire_dtype_hint("u8m"))
+        assert decoded["instances"].dtype == np.uint8
+        # unknown model -> no hint -> classic int32
+        decoded2 = dp.decode_body({}, body,
+                                  dtype_hint=dp.wire_dtype_hint("nope"))
+        assert decoded2["instances"].dtype == np.int32
+    finally:
+        model.unload()
+
+
 def test_dump_non_finite_json_dumps_parity():
     arr = np.array([1.0, np.nan, np.inf, -np.inf], np.float32)
     out = native.dump_f32(arr)
