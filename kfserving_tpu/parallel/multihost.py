@@ -51,6 +51,24 @@ def initialize(coordinator_address: Optional[str] = None,
 
     if _initialized:
         return jax.process_count() > 1
+    try:
+        # Already brought up externally (an entrypoint called
+        # jax.distributed.initialize directly): adopt it instead of a
+        # second initialize, which raises once the backend exists.
+        from jax._src.distributed import global_state
+
+        if getattr(global_state, "coordinator_address", None):
+            _initialized = True
+            if num_processes is not None and \
+                    num_processes != jax.process_count():
+                logger.warning(
+                    "adopting an externally-initialized distributed "
+                    "runtime with %d processes, but the caller asked "
+                    "for %d — topology mismatch",
+                    jax.process_count(), num_processes)
+            return jax.process_count() > 1
+    except ImportError:  # pragma: no cover - private API moved
+        pass
     coordinator_address = coordinator_address or os.getenv(
         "COORDINATOR_ADDRESS")
     if num_processes is None and os.getenv("NUM_PROCESSES"):
@@ -105,9 +123,16 @@ def hybrid_mesh(config: Optional[MeshConfig] = None,
     if dcn_replicas > 1 and jax.process_count() > 1:
         from jax.experimental import mesh_utils
 
+        # The DCN granule: TPU multi-slice devices carry distinct
+        # slice_index values and group by slice; hosts whose devices
+        # don't (CPU fleets, single-slice-per-host jobs) group by
+        # process — the process boundary IS the DCN boundary there.
+        slice_ids = {getattr(d, "slice_index", None)
+                     for d in devices[:need]}
         dev_array = mesh_utils.create_hybrid_device_mesh(
             ici_shape, (dcn_replicas,) + (1,) * len(ici_shape),
-            devices=devices[:need])
+            devices=devices[:need],
+            process_is_granule=len(slice_ids) <= 1)
         # create_hybrid_device_mesh returns shape dcn*ici flattened per
         # axis; reshape to (dcn, *ici).
         dev_array = dev_array.reshape((dcn_replicas,) + ici_shape)
