@@ -735,80 +735,131 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
             "max_slots": 8, "max_seq": 512,
             "prefill_buckets": [64, 512],
         }
-        arch, n_req, conc, max_tokens = "decoder", 32, 8, 64
-    model_dir = _write_jax_model_dir(arch, cfg.pop("arch_kwargs"),
-                                     **cfg)
-    model = GenerativeModel("gen", model_dir)
-    t0 = time.perf_counter()
-    model.load()
-    load_s = time.perf_counter() - t0
-    server = await _serve([model])
+        # 8 per wave x 4 rounds x 2 variants keeps all slots occupied
+        # during each wave (occupancy is a headline stat).
+        arch, n_req, conc, max_tokens = "decoder", 64, 8, 64
+    arch_kwargs = cfg.pop("arch_kwargs")
+    # K A/B: steps_per_call=1 (token-granular streaming) vs K>1 (K
+    # decode steps per device dispatch — on this tunnel each dispatch
+    # costs ~an RTT, so K multiplies per-slot tokens/s).  Both models
+    # live in one process and alternate rounds (weather-robust
+    # interleaving, ROOFLINE methodology).
+    k_hi = 2 if smoke else 8
+    models = {}
+    load_s = {}
+    for label, k in (("k1", 1), (f"k{k_hi}", k_hi)):
+        model_dir = _write_jax_model_dir(arch, arch_kwargs,
+                                         steps_per_call=k, **cfg)
+        m = GenerativeModel(f"gen-{label}", model_dir)
+        t0 = time.perf_counter()
+        m.load()
+        load_s[label] = round(time.perf_counter() - t0, 1)
+        models[label] = m
+    server = await _serve(list(models.values()))
     base = f"http://127.0.0.1:{server.http_port}"
     prompt = ("the quick brown fox jumps over the lazy dog "
               * (1 if smoke else 3))
     body = json.dumps({"prompt": prompt,
                        "max_tokens": max_tokens}).encode()
+    variants = list(models)
     try:
         async with aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=600)) as s:
-            # Warmup: compiles the prompt's prefill bucket + the decode
-            # step (and the insert scatter) before timing starts.
-            t0 = time.perf_counter()
-            async with s.post(f"{base}/v1/models/gen:generate",
-                              data=body) as r:
-                assert r.status == 200, await r.text()
-            compile_s = time.perf_counter() - t0
+            # Warmup: compiles each variant's prefill bucket + decode
+            # scan (and the insert scatter) before timing starts.
+            compile_s = {}
+            for label in variants:
+                t0 = time.perf_counter()
+                async with s.post(
+                        f"{base}/v1/models/gen-{label}:generate",
+                        data=body) as r:
+                    assert r.status == 200, await r.text()
+                compile_s[label] = round(time.perf_counter() - t0, 1)
 
-            # Aggregate throughput: n_req requests over conc clients;
-            # the engine shares decode steps across in-flight slots.
-            sem = asyncio.Semaphore(conc)
-            counts: List[int] = []
+            async def wave(label, n):
+                sem = asyncio.Semaphore(conc)
+                counts: List[int] = []
 
-            async def one():
-                async with sem:
-                    async with s.post(f"{base}/v1/models/gen:generate",
-                                      data=body) as r:
-                        out = await r.json()
-                        counts.append(out["details"]["token_count"])
+                async def one():
+                    async with sem:
+                        async with s.post(
+                                f"{base}/v1/models/gen-{label}:generate",
+                                data=body) as r:
+                            out = await r.json()
+                            counts.append(
+                                out["details"]["token_count"])
 
-            t0 = time.perf_counter()
-            await asyncio.gather(*[one() for _ in range(n_req)])
-            wall = time.perf_counter() - t0
-            tokens_total = sum(counts)
+                t0 = time.perf_counter()
+                await asyncio.gather(*[one() for _ in range(n)])
+                return sum(counts), time.perf_counter() - t0
 
-            # Per-token latency: inter-event gaps on a live SSE stream
-            # (the tail of each gap is one decode step + delivery).
-            gaps: List[float] = []
-            async with s.post(f"{base}/v2/models/gen/generate_stream",
-                              data=body) as r:
-                last = time.perf_counter()
-                async for chunk in r.content.iter_any():
-                    if b"data: " not in chunk:
-                        continue
-                    now = time.perf_counter()
-                    gaps.append((now - last) * 1000.0)
-                    last = now
-        stats = model.engine_stats()
-        gaps_arr = np.asarray(gaps[1:] or [0.0])  # drop prefill gap
-        return {
-            "tokens_per_s": round(tokens_total / wall, 2),
-            "tokens_total": tokens_total,
-            "requests": n_req,
-            "concurrency": conc,
-            "wall_s": round(wall, 2),
-            "req_per_s": round(n_req / wall, 2),
-            "token_p50_ms": round(float(np.percentile(gaps_arr, 50)), 2),
-            "token_p99_ms": round(float(np.percentile(gaps_arr, 99)), 2),
-            "slot_occupancy": stats.get("slot_occupancy"),
-            "decode_steps": stats.get("decode_steps"),
-            "prefills": stats.get("prefills"),
-            "decode_device_s": stats.get("decode_device_s"),
-            "prefill_device_s": stats.get("prefill_device_s"),
-            "cache_bytes": stats.get("cache_bytes"),
-            "compile_s": round(compile_s, 1),
-            "load_s": round(load_s, 1),
+            # Alternating rounds: each variant serves half of n_req in
+            # interleaved waves so tunnel weather hits both equally.
+            totals = {v: [0, 0.0] for v in variants}
+            rounds = 4
+            per_wave = max(1, n_req // (rounds * len(variants)))
+            # Report what actually runs: integer division can shrink
+            # the request count (smoke: 12 -> 8).
+            n_req = rounds * len(variants) * per_wave
+            for rnd in range(rounds):
+                order = (variants if rnd % 2 == 0
+                         else list(reversed(variants)))
+                for label in order:
+                    tok, wall = await wave(label, per_wave)
+                    totals[label][0] += tok
+                    totals[label][1] += wall
+
+            # Per-event latency: inter-event gaps on live SSE streams
+            # (K=1: one token per gap; K=8: one K-chunk per gap).
+            async def gaps_for(label):
+                gaps: List[float] = []
+                async with s.post(
+                        f"{base}/v2/models/gen-{label}/generate_stream",
+                        data=body) as r:
+                    last = time.perf_counter()
+                    async for chunk in r.content.iter_any():
+                        if b"data: " not in chunk:
+                            continue
+                        now = time.perf_counter()
+                        gaps.append((now - last) * 1000.0)
+                        last = now
+                return np.asarray(gaps[1:] or [0.0])
+
+            g1 = await gaps_for("k1")
+            gk = await gaps_for(variants[1])
+        out: Dict[str, Any] = {
+            "requests": n_req, "concurrency": conc,
             "max_tokens": max_tokens,
+            "steps_per_call_ab": {}, "load_s": load_s,
+            "compile_s": compile_s,
         }
+        for label in variants:
+            tok, wall = totals[label]
+            stats = models[label].engine_stats()
+            out["steps_per_call_ab"][label] = {
+                "tokens_per_s": round(tok / wall, 2) if wall else None,
+                "tokens_total": tok,
+                "wall_s": round(wall, 2),
+                "slot_occupancy": stats.get("slot_occupancy"),
+                "decode_dispatches": stats.get("decode_steps"),
+                "token_steps": stats.get("token_steps"),
+                "decode_device_s": stats.get("decode_device_s"),
+            }
+        k1 = out["steps_per_call_ab"]["k1"]["tokens_per_s"]
+        khi = out["steps_per_call_ab"][variants[1]]["tokens_per_s"]
+        if k1 and khi:
+            out["k_speedup"] = round(khi / k1, 2)
+        # Headline numbers come from the K variant (the shipped
+        # default for this transport).
+        out["tokens_per_s"] = khi
+        out["token_p50_ms"] = round(float(np.percentile(g1, 50)), 2)
+        out["token_p99_ms"] = round(float(np.percentile(g1, 99)), 2)
+        out["chunk_p50_ms"] = round(float(np.percentile(gk, 50)), 2)
+        out["slot_occupancy"] = out["steps_per_call_ab"][
+            variants[1]]["slot_occupancy"]
+        out["cache_bytes"] = models["k1"].engine_stats().get(
+            "cache_bytes")
+        return out
     finally:
         await server.stop_async()
 

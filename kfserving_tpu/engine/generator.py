@@ -83,6 +83,7 @@ class GenerationEngine:
                  max_seq: int = 512,
                  prefill_buckets: Optional[List[int]] = None,
                  eos_id: Optional[int] = None,
+                 steps_per_call: int = 1,
                  rng_seed: int = 0,
                  mesh=None,
                  name: str = "decoder"):
@@ -95,6 +96,9 @@ class GenerationEngine:
         self.variables = variables
         self.max_slots = int(max_slots)
         self.max_seq = int(max_seq)
+        if steps_per_call < 1:
+            raise InvalidInput("steps_per_call must be >= 1")
+        self.steps_per_call = int(steps_per_call)
         cfg = module.config
         if self.max_seq > cfg.max_seq:
             raise InvalidInput(
@@ -151,12 +155,28 @@ class GenerationEngine:
             return jnp.where(temps <= 0.0, greedy,
                              sampled).astype(jnp.int32)
 
+        k_steps = self.steps_per_call
+
         def decode_fn(variables, caches, tokens, positions, rng, temps):
-            logits, new_caches = module.apply(
-                variables, tokens[:, None], positions=positions,
-                kv_cache=caches)
-            next_tokens = sample(logits[:, 0], rng, temps)
-            return next_tokens, new_caches
+            """K decode steps in ONE device dispatch (lax.scan): on a
+            high-RTT link each host round trip costs ~an RTT, so
+            single-token stepping caps tokens/s at 1/RTT per wave;
+            scanning K steps on device multiplies that by K.  Tokens
+            feed forward on device; the host sees [S, K] at once (stop
+            conditions checked per chunk — at most K-1 wasted steps
+            after an EOS/budget stop)."""
+            def step(carry, step_rng):
+                caches, tokens, positions = carry
+                logits, new_caches = module.apply(
+                    variables, tokens[:, None], positions=positions,
+                    kv_cache=caches)
+                nxt = sample(logits[:, 0], step_rng, temps)
+                return (new_caches, nxt, positions + 1), nxt
+
+            rngs = jax.random.split(rng, k_steps)
+            (caches, _, _), toks = jax.lax.scan(
+                step, (caches, tokens, positions), rngs)
+            return toks.T, caches  # [S, K]
 
         # Donate the caches: in-place HBM update, one resident pool.
         self._decode = jax.jit(decode_fn, donate_argnums=(1,))
@@ -201,7 +221,8 @@ class GenerationEngine:
 
         # stats
         self.tokens_generated = 0
-        self.decode_steps = 0
+        self.decode_steps = 0       # device dispatches
+        self._token_steps = 0       # dispatches x steps_per_call
         self.prefills = 0
         self.requests_finished = 0
         self._occupied_slot_steps = 0
@@ -315,10 +336,12 @@ class GenerationEngine:
         self._executor.shutdown(wait=False)
 
     def stats(self) -> Dict[str, Any]:
-        steps = max(1, self.decode_steps)
+        steps = max(1, self._token_steps)
         return {
             "tokens_generated": self.tokens_generated,
             "decode_steps": self.decode_steps,
+            "token_steps": self._token_steps,
+            "steps_per_call": self.steps_per_call,
             "prefills": self.prefills,
             "requests_finished": self.requests_finished,
             "slot_occupancy": round(
@@ -430,6 +453,8 @@ class GenerationEngine:
         return first
 
     def _do_decode_step(self) -> np.ndarray:
+        """One device dispatch = steps_per_call decode steps; returns
+        [S, K] tokens."""
         jnp = self._jnp
         tokens = np.zeros(self.max_slots, np.int32)
         positions = np.zeros(self.max_slots, np.int32)
@@ -478,15 +503,23 @@ class GenerationEngine:
             s.last_token = token
 
     def _distribute(self, tokens: np.ndarray):
+        """tokens [S, K]: per active slot, consume the chunk in order;
+        a slot finishing mid-chunk (EOS or budget) discards its
+        remaining positions — at most K-1 device steps of waste."""
         self.decode_steps += 1
+        k = tokens.shape[1]
+        self._token_steps += k
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
-            self._occupied_slot_steps += 1
-            # The step just executed wrote the fed token's k/v at
-            # position s.length: the cache grew by one.
-            s.length += 1
-            self._emit(i, int(tokens[i]))
+            self._occupied_slot_steps += k
+            for j in range(k):
+                if self._slots[i] is None:
+                    break  # finished mid-chunk
+                # Each scanned step wrote the fed token's k/v at the
+                # slot's position: the cache grew by one per step.
+                s.length += 1
+                self._emit(i, int(tokens[i, j]))
 
 
 def _pow2_buckets(max_seq: int) -> List[int]:

@@ -57,7 +57,8 @@ def initialize(coordinator_address: Optional[str] = None,
         # second initialize, which raises once the backend exists.
         from jax._src.distributed import global_state
 
-        if getattr(global_state, "coordinator_address", None):
+        adopted = getattr(global_state, "coordinator_address", None)
+        if adopted:
             _initialized = True
             if num_processes is not None and \
                     num_processes != jax.process_count():
@@ -66,6 +67,13 @@ def initialize(coordinator_address: Optional[str] = None,
                     "runtime with %d processes, but the caller asked "
                     "for %d — topology mismatch",
                     jax.process_count(), num_processes)
+            if coordinator_address is not None and \
+                    coordinator_address != adopted:
+                logger.warning(
+                    "adopting an externally-initialized distributed "
+                    "runtime at %s, but the caller asked for "
+                    "coordinator %s — possible wrong-cluster adoption",
+                    adopted, coordinator_address)
             return jax.process_count() > 1
     except ImportError:  # pragma: no cover - private API moved
         pass

@@ -106,6 +106,7 @@ class GenerativeConfig:
                  prefill_buckets: Optional[List[int]] = None,
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  tokenizer: str = "byte",
+                 steps_per_call: int = 1,
                  mesh: Optional[Dict[str, int]] = None,
                  **_ignored):
         self.architecture = architecture
@@ -116,6 +117,11 @@ class GenerativeConfig:
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.tokenizer = tokenizer
+        # Decode steps per device dispatch: on high-RTT transports each
+        # dispatch costs ~an RTT, so K steps per call multiplies
+        # per-slot tokens/s by up to K (streaming granularity becomes
+        # K tokens; at most K-1 wasted steps past an EOS).
+        self.steps_per_call = int(steps_per_call)
         self.mesh = mesh or {}
 
     @classmethod
@@ -192,6 +198,7 @@ class GenerativeModel(Model):
             max_slots=cfg.max_slots, max_seq=cfg.max_seq,
             prefill_buckets=cfg.prefill_buckets,
             eos_id=getattr(self.tokenizer, "eos_id", None),
+            steps_per_call=cfg.steps_per_call,
             mesh=mesh, name=self.name)
         if self.hbm is not None:
             # Generation residency = params + the slot cache pool.

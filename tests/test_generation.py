@@ -158,6 +158,61 @@ async def test_more_requests_than_slots(tiny):
     assert [t for t, _ in got] == want
 
 
+async def test_multistep_decode_matches_single_step(tiny):
+    """steps_per_call=4 (K decode steps per device dispatch, lax.scan)
+    reproduces K=1 greedy token-for-token — the RTT-amortization knob
+    changes dispatch granularity, never results."""
+    module, variables, _ = tiny
+    prompts = [[5, 9, 2], [7, 1, 4, 4, 2]]
+    want = [ref_greedy(module, variables, p, 11) for p in prompts]
+    eng = make_engine(tiny, max_slots=2, steps_per_call=4)
+    try:
+        got = await asyncio.gather(*[
+            eng.complete(p, max_new_tokens=11) for p in prompts])
+        stats = eng.stats()
+    finally:
+        await eng.close()
+    for (tokens, reason), expected in zip(got, want):
+        assert tokens == expected  # 11 tokens though 11 % 4 != 0
+        assert reason == "length"
+    # Far fewer dispatches than token steps.
+    assert stats["decode_steps"] < stats["token_steps"]
+    assert stats["steps_per_call"] == 4
+
+
+async def test_multistep_eos_truncates_chunk(tiny):
+    """An EOS mid-chunk stops that stream at the EOS — the chunk's
+    remaining tokens are never delivered."""
+    module, variables, _ = tiny
+    prompt = [5, 9, 2, 7, 11]
+    ref = ref_greedy(module, variables, prompt, 12)
+    eos = ref[5]  # lands mid-chunk for K=4
+    first_eos = ref.index(eos)
+    eng = make_engine(tiny, max_slots=1, eos_id=eos, steps_per_call=4)
+    try:
+        tokens, reason = await eng.complete(prompt, max_new_tokens=12)
+    finally:
+        await eng.close()
+    assert reason == "eos"
+    assert tokens == ref[:first_eos]
+
+
+async def test_multistep_budget_capacity_clamp(tiny):
+    """A budget ending mid-chunk delivers exactly the budget, and the
+    cache-capacity clamp holds under K>1 (device steps may overrun a
+    freed slot's tail; delivered tokens never do)."""
+    module, variables, _ = tiny
+    prompt = list(range(1, 31))  # 30 tokens; capacity 64-30=34
+    eng = make_engine(tiny, max_slots=1, steps_per_call=8)
+    try:
+        tokens, reason = await eng.complete(prompt,
+                                            max_new_tokens=10_000)
+    finally:
+        await eng.close()
+    assert len(tokens) == MAX_SEQ - 30
+    assert reason == "length"
+
+
 # ----------------------------------------------------- stop conditions
 
 
