@@ -744,7 +744,24 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
     # costs ~an RTT, so K multiplies per-slot tokens/s).  Both models
     # live in one process and alternate rounds (weather-robust
     # interleaving, ROOFLINE methodology).
-    k_hi = 2 if smoke else 8
+    # K=16 measured best on this transport: 222.8 tokens/s vs 162 at
+    # K=8 vs 20.9-38.8 at K=1 (BENCH_DETAIL steps_per_call_ab); at
+    # K=16 a dispatch is ~383 ms = RTT + 16 device steps, so compute
+    # is already ~half the wave — returns diminish past here.
+    if smoke:
+        k_hi = 2
+    else:
+        try:
+            k_hi = int(os.environ.get("BENCH_GEN_K", "16"))
+        except ValueError:
+            raise ValueError(
+                f"BENCH_GEN_K must be an integer >= 2, got "
+                f"{os.environ['BENCH_GEN_K']!r}")
+        if k_hi < 2:
+            # The A/B needs a distinct second variant (K=1 is the
+            # baseline side).
+            raise ValueError(
+                f"BENCH_GEN_K must be >= 2, got {k_hi}")
     models = {}
     load_s = {}
     for label, k in (("k1", 1), (f"k{k_hi}", k_hi)):
