@@ -193,16 +193,20 @@ class GenerationEngine:
         # One executable per prompt bucket (jit caches by shape).
         self._prefill = jax.jit(prefill_fn)
 
-        def insert_fn(caches, new_caches, slot):
+        def insert_fn(caches, new_caches, slots):
+            """Scatter a prefill batch's k/v into its slots.  slots is
+            [B] int32; padding rows carry the out-of-bounds sentinel
+            max_slots and mode='drop' discards them (a prefill batch is
+            padded to a pow2 B bucket to bound compile count)."""
             out = []
             for (k_cache, v_cache), (k_new, v_new) in zip(caches,
                                                           new_caches):
                 lb = k_new.shape[1]
                 out.append((
-                    k_cache.at[slot, :lb].set(
-                        k_new[0].astype(k_cache.dtype)),
-                    v_cache.at[slot, :lb].set(
-                        v_new[0].astype(v_cache.dtype)),
+                    k_cache.at[slots, :lb].set(
+                        k_new.astype(k_cache.dtype), mode="drop"),
+                    v_cache.at[slots, :lb].set(
+                        v_new.astype(v_cache.dtype), mode="drop"),
                 ))
             return out
 
@@ -223,7 +227,8 @@ class GenerationEngine:
         self.tokens_generated = 0
         self.decode_steps = 0       # device dispatches
         self._token_steps = 0       # dispatches x steps_per_call
-        self.prefills = 0
+        self.prefills = 0           # prefill dispatches
+        self.prefill_requests = 0   # requests admitted through them
         self.requests_finished = 0
         self._occupied_slot_steps = 0
         self._decode_device_s = 0.0
@@ -343,6 +348,7 @@ class GenerationEngine:
             "token_steps": self._token_steps,
             "steps_per_call": self.steps_per_call,
             "prefills": self.prefills,
+            "prefill_requests": self.prefill_requests,
             "requests_finished": self.requests_finished,
             "slot_occupancy": round(
                 self._occupied_slot_steps / (steps * self.max_slots), 4),
@@ -386,30 +392,53 @@ class GenerationEngine:
         while self._pending:
             self._pending.popleft().out.put_nowait((None, reason))
 
+    def _bucket_for(self, n: int) -> int:
+        return next(b for b in self.prefill_buckets if b >= n)
+
+    def _take_prefill_group(self
+                            ) -> Tuple[List[_Request], List[int], int]:
+        """Pop the front run of pending requests that share a prefill
+        bucket, up to the free slot count — they ride ONE prefill
+        dispatch.  Strict FIFO: a different-bucket request at the front
+        is never jumped.  Returns (group, slots, bucket)."""
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        group: List[_Request] = []
+        bucket = 0
+        while self._pending and len(group) < len(free):
+            b = self._bucket_for(self._pending[0].prompt_ids.size)
+            if not group:
+                bucket = b
+            elif b != bucket:
+                break
+            group.append(self._pending.popleft())
+        return group, free[:len(group)], bucket
+
     async def _run_inner(self):
         loop = asyncio.get_event_loop()
         while not self._closed:
             admitted = False
             while self._pending and self._free_slot() is not None:
-                req = self._pending.popleft()
-                slot = self._free_slot()
+                group, slots, bucket = self._take_prefill_group()
                 try:
-                    first = await loop.run_in_executor(
-                        self._executor, self._do_prefill, req, slot)
+                    firsts = await loop.run_in_executor(
+                        self._executor, self._do_prefill_group,
+                        group, slots, bucket)
                 except Exception as e:
                     # A prefill failure (e.g. OOM compiling a new
-                    # bucket) fails THAT request; in-flight slots
-                    # keep decoding.
+                    # bucket) fails THAT group; in-flight slots keep
+                    # decoding.
                     logger.exception("prefill failed")
-                    req.out.put_nowait(
-                        (None, f"error: prefill failed: {e}"))
+                    for req in group:
+                        req.out.put_nowait(
+                            (None, f"error: prefill failed: {e}"))
                     continue
                 # Slot bookkeeping and token delivery happen here on
                 # the loop thread: asyncio.Queue is not thread-safe.
-                self._slots[slot] = _Active(
-                    req=req, length=req.prompt_ids.size,
-                    last_token=first, generated=0)
-                self._emit(slot, first)
+                for req, slot, first in zip(group, slots, firsts):
+                    self._slots[slot] = _Active(
+                        req=req, length=req.prompt_ids.size,
+                        last_token=first, generated=0)
+                    self._emit(slot, first)
                 admitted = True
             active = [i for i, s in enumerate(self._slots)
                       if s is not None]
@@ -430,27 +459,43 @@ class GenerationEngine:
                 self._executor, self._do_decode_step)
             self._distribute(tokens)
 
-    def _do_prefill(self, req: _Request, slot: int) -> int:
-        """Runs on the executor thread: bucket-pad, prefill, insert.
-        Returns the first generated token; slot state is installed by
-        the scheduler on the loop thread."""
+    def _do_prefill_group(self, group: List[_Request],
+                          slots: List[int],
+                          bucket: int) -> List[int]:
+        """Runs on the executor thread: one bucket-padded prefill
+        dispatch for the WHOLE group (a burst of arrivals used to pay
+        one ~RTT dispatch each — half the device time under load).
+        The batch pads to a pow2 row bucket so compile count stays
+        bounded; padding rows carry an out-of-bounds slot sentinel the
+        insert scatter drops.  Returns the first generated token per
+        request; slot state is installed by the scheduler on the loop
+        thread."""
         jnp = self._jnp
-        n = req.prompt_ids.size
-        bucket = next(b for b in self.prefill_buckets if b >= n)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = req.prompt_ids
-        lengths = np.asarray([n], np.int32)
-        temps = np.asarray([req.temperature], np.float32)
+        b = len(group)
+        b_bucket = 1
+        while b_bucket < b:
+            b_bucket *= 2
+        ids = np.zeros((b_bucket, bucket), np.int32)
+        lengths = np.ones(b_bucket, np.int32)  # dummy rows: length 1
+        temps = np.zeros(b_bucket, np.float32)
+        slot_arr = np.full(b_bucket, self.max_slots, np.int32)  # OOB
+        for i, (req, slot) in enumerate(zip(group, slots)):
+            n = req.prompt_ids.size
+            ids[i, :n] = req.prompt_ids
+            lengths[i] = n
+            temps[i] = req.temperature
+            slot_arr[i] = slot
         t0 = time.perf_counter()
-        first, new_caches = self._prefill(
+        firsts, new_caches = self._prefill(
             self.variables, jnp.asarray(ids), jnp.asarray(lengths),
             self._next_rng(), jnp.asarray(temps))
         self._caches = self._insert(self._caches, new_caches,
-                                    np.int32(slot))
-        first = int(self._jax.block_until_ready(first)[0])
+                                    jnp.asarray(slot_arr))
+        firsts = np.asarray(self._jax.block_until_ready(firsts))
         self._prefill_device_s += time.perf_counter() - t0
         self.prefills += 1
-        return first
+        self.prefill_requests += b
+        return [int(firsts[i]) for i in range(b)]
 
     def _do_decode_step(self) -> np.ndarray:
         """One device dispatch = steps_per_call decode steps; returns

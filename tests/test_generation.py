@@ -337,23 +337,23 @@ async def test_decode_failure_fails_all_inflight(tiny):
         await eng.close()
 
 
-async def test_prefill_failure_fails_only_that_request(tiny):
+async def test_prefill_failure_fails_only_that_group(tiny):
     from kfserving_tpu.protocol.errors import InferenceError
 
     module, variables, _ = tiny
     want = ref_greedy(module, variables, [5, 5], 4)
     eng = make_engine(tiny, max_slots=2)
     try:
-        orig = eng._do_prefill
+        orig = eng._do_prefill_group
         calls = {"n": 0}
 
-        def flaky(req, slot):
+        def flaky(group, slots, bucket):
             calls["n"] += 1
             if calls["n"] == 1:
                 raise RuntimeError("synthetic prefill OOM")
-            return orig(req, slot)
+            return orig(group, slots, bucket)
 
-        eng._do_prefill = flaky
+        eng._do_prefill_group = flaky
         with pytest.raises(InferenceError, match="prefill failed"):
             await asyncio.wait_for(
                 eng.complete([9, 9], max_new_tokens=4), timeout=10)
@@ -362,6 +362,50 @@ async def test_prefill_failure_fails_only_that_request(tiny):
         assert tokens == want
     finally:
         await eng.close()
+
+
+async def test_burst_prefills_share_one_dispatch(tiny):
+    """A burst of same-bucket arrivals rides ONE prefill dispatch (the
+    padded batch scatters into all their slots at once); results still
+    match isolated baselines.  Mixed buckets split, FIFO preserved."""
+    module, variables, _ = tiny
+    prompts = [[3, 1], [4, 1], [5, 9]]  # all in the 8-bucket
+    want = [ref_greedy(module, variables, p, 6) for p in prompts]
+    eng = make_engine(tiny, max_slots=4)
+    try:
+        # Submit the burst before the scheduler wakes: one group.
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        results = await asyncio.gather(*[
+            _drain(eng, r) for r in reqs])
+        stats = eng.stats()
+    finally:
+        await eng.close()
+    assert results == want
+    assert stats["prefills"] == 1  # one dispatch for the whole burst
+    assert stats["prefill_requests"] == 3
+
+    # Mixed buckets: front-run grouping splits at the bucket change.
+    eng2 = make_engine(tiny, max_slots=4)
+    try:
+        mixed = [[3, 1], list(range(1, 13)), [5, 9]]  # 8, 16, 8
+        want2 = [ref_greedy(module, variables, p, 4) for p in mixed]
+        reqs2 = [eng2.submit(p, max_new_tokens=4) for p in mixed]
+        results2 = await asyncio.gather(*[
+            _drain(eng2, r) for r in reqs2])
+        stats2 = eng2.stats()
+    finally:
+        await eng2.close()
+    assert results2 == want2
+    assert stats2["prefills"] == 3  # 8 | 16 | 8 — FIFO, no jumping
+    assert stats2["prefill_requests"] == 3
+
+
+async def _drain(eng, req):
+    tokens = []
+    async for token, fin in eng.stream(req):
+        if token is not None:
+            tokens.append(token)
+    return tokens
 
 
 async def test_close_drains_inflight_awaiters(tiny):
