@@ -59,6 +59,7 @@ class _Request:
     max_new_tokens: int
     temperature: float
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
+    cancelled: bool = False
 
 
 @dataclass
@@ -275,6 +276,32 @@ class GenerationEngine:
             if reason is not None:
                 return
 
+    def cancel(self, req: _Request) -> None:
+        """Abandon a request: a consumer that stops caring (client
+        disconnect, stop-sequence match) must free the decode slot —
+        otherwise the engine decodes to the full token budget for
+        nobody.  Runs on the event loop thread (the same thread as all
+        slot bookkeeping).  Idempotent; a finished request is a no-op.
+        The slot stops being fed at the next wave boundary."""
+        if req.cancelled:
+            return
+        req.cancelled = True
+        try:
+            self._pending.remove(req)
+            req.out.put_nowait((None, "cancelled"))
+            return
+        except ValueError:
+            pass
+        for i, s in enumerate(self._slots):
+            if s is not None and s.req is req:
+                self._slots[i] = None
+                self.requests_finished += 1
+                req.out.put_nowait((None, "cancelled"))
+                return
+        # Neither pending nor active: either already finished (no-op)
+        # or mid-prefill on the executor — the install step checks
+        # `cancelled` and drops it.
+
     async def complete(self, prompt_ids, max_new_tokens: int = 32,
                        temperature: float = 0.0
                        ) -> Tuple[List[int], str]:
@@ -435,6 +462,16 @@ class GenerationEngine:
                 # Slot bookkeeping and token delivery happen here on
                 # the loop thread: asyncio.Queue is not thread-safe.
                 for req, slot, first in zip(group, slots, firsts):
+                    if req.cancelled:
+                        # Cancelled while its prefill was on the
+                        # executor: drop it before it occupies a slot.
+                        # cancel() could not emit the terminal event
+                        # for this request (it was neither pending nor
+                        # active at that moment) — deliver it here or
+                        # a consumer draining stream(req) hangs.
+                        req.out.put_nowait((None, "cancelled"))
+                        self.requests_finished += 1
+                        continue
                     self._slots[slot] = _Active(
                         req=req, length=req.prompt_ids.size,
                         last_token=first, generated=0)

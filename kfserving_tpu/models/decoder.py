@@ -112,6 +112,11 @@ class DecoderBlock(nn.Module):
             else:
                 attn_mask = causal
             out = cfg.attn_fn(q, k, v, attn_mask)
+            # The k/v projections are already materialized; without
+            # this a prefill with return_cache=True under a pluggable
+            # attn_fn returned caches=[None, ...] and crashed deep in
+            # the engine's insert scatter instead of working.
+            new_cache = (k, v)
         else:
             out = dot_product_attention(q, k, v, causal=True,
                                         kv_lengths=kv_lengths)

@@ -83,6 +83,10 @@ def build_tokenizer(spec: str):
         from transformers import AutoTokenizer  # baked-in dependency
 
         tok = AutoTokenizer.from_pretrained(spec[3:])
+        if tok.eos_token_id is None:
+            logger.warning(
+                "tokenizer %s has no eos_token_id: generation will only "
+                "stop at the token budget or a stop sequence", spec)
 
         class _HF:
             vocab_size = tok.vocab_size
@@ -90,10 +94,17 @@ def build_tokenizer(spec: str):
             eos_id = tok.eos_token_id
 
             def encode(self, text, add_bos=True):
-                return tok.encode(text)
+                # add_special_tokens=False: some tokenizers append EOS
+                # (or wrap with template tokens) in plain encode(),
+                # which would poison the prompt; BOS is added
+                # explicitly and only when the tokenizer has one.
+                ids = tok.encode(text, add_special_tokens=False)
+                if add_bos and tok.bos_token_id is not None:
+                    ids = [tok.bos_token_id] + ids
+                return ids
 
             def decode(self, ids):
-                return tok.decode(ids)
+                return tok.decode(ids, skip_special_tokens=True)
 
         return _HF()
     raise InvalidInput(f"unknown tokenizer spec {spec!r}")
@@ -270,9 +281,16 @@ class GenerativeModel(Model):
         parsed = [self._parse_instance(i) for i in instances]
         # Submit all instances at once: the engine's continuous batcher
         # shares decode steps across them (the request-level analogue of
-        # the dynamic batcher).
+        # the dynamic batcher).  return_exceptions: let every sibling
+        # settle before surfacing a failure — an immediate propagate
+        # would leave the others decoding unawaited to their full
+        # budgets ("Task exception was never retrieved").
         results = await asyncio.gather(*[self._run_one(p)
-                                         for p in parsed])
+                                         for p in parsed],
+                                       return_exceptions=True)
+        for r in results:
+            if isinstance(r, BaseException):
+                raise r
         return v1.make_response(list(results))
 
     async def generate(self, request: Any) -> Any:
@@ -314,7 +332,10 @@ class GenerativeModel(Model):
             ids, max_new_tokens=parsed["max_tokens"],
             temperature=parsed["temperature"])
 
+        finished = False
+
         async def events():
+            nonlocal finished
             collected: List[int] = []
             async for token, reason in self.engine.stream(req):
                 if token is not None:
@@ -325,13 +346,24 @@ class GenerativeModel(Model):
                 else:
                     event = {}
                 if reason is not None:
+                    finished = True
                     event["finish_reason"] = reason
                     event["generated_text"] = self.tokenizer.decode(
                         collected)
                     event["details"] = {"token_count": len(collected)}
                 yield event
 
-        return events()
+        def on_close():
+            # Consumer abandoned the stream (client disconnect —
+            # including before the first event was ever pulled): free
+            # the decode slot instead of generating to the budget for
+            # nobody.  No-op when the generation finished normally.
+            if not finished:
+                self.engine.cancel(req)
+
+        from kfserving_tpu.streams import GuardedStream
+
+        return GuardedStream(events(), on_close)
 
     def engine_stats(self) -> Dict[str, Any]:
         return dict(self.engine.stats()) if self.engine else {}

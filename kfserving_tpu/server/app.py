@@ -431,31 +431,44 @@ class ModelServer:
         start = time.perf_counter()
         metrics, hooks = self.metrics, self.request_hooks
         admission = self._admission if gated else None
+        state = {"status": 200}
 
         async def sse():
-            status = 200
             try:
                 async for event in events:
                     payload = json.dumps(event, default=_np_default)
                     yield f"data: {payload}\n\n".encode("utf-8")
             except Exception:
                 logger.exception("generate stream for %s failed", name)
-                status = 500
+                state["status"] = 500
                 raise
-            finally:
-                if admission is not None:
-                    admission.exit()
-                latency_ms = (time.perf_counter() - start) * 1000.0
-                metrics.observe_request(name, "generate_stream",
-                                        status, latency_ms)
-                for hook in hooks:
-                    try:
-                        hook(name, "generate_stream", req, None,
-                             latency_ms)
-                    except Exception:
-                        logger.exception("request hook failed")
 
-        return StreamingResponse(sse(),
+        async def on_close():
+            # Runs exactly once on every exit path — including a
+            # client that disconnected before the body was ever
+            # iterated (a plain generator's finally never runs there,
+            # which used to leak the containerConcurrency slot per
+            # disconnect until the server wedged at all-503).
+            if admission is not None:
+                admission.exit()
+            # Propagate the close to the model's event stream so the
+            # engine frees the decode slot on abandonment.
+            from kfserving_tpu.streams import aclose_quietly
+
+            await aclose_quietly(events, "model event stream")
+            latency_ms = (time.perf_counter() - start) * 1000.0
+            metrics.observe_request(name, "generate_stream",
+                                    state["status"], latency_ms)
+            for hook in hooks:
+                try:
+                    hook(name, "generate_stream", req, None,
+                         latency_ms)
+                except Exception:
+                    logger.exception("request hook failed")
+
+        from kfserving_tpu.streams import GuardedStream
+
+        return StreamingResponse(GuardedStream(sse(), on_close),
                                  headers={REQUEST_ID_HEADER: rid})
 
     async def _standby_activate(self, req: Request) -> Response:

@@ -285,6 +285,17 @@ class _HttpProtocol(asyncio.Protocol):
         if prev is not None and not prev.done():
             await asyncio.shield(prev)
         if self.transport is None or self.transport.is_closing():
+            # Client gone before the response started.  A streaming
+            # body still holds resources (admission slot, engine
+            # work) released by its close path — aclose() it here or
+            # they leak until GC (and the admission slot leaks
+            # forever if the producer wrapper only cleans up on
+            # close/exhaustion).
+            if isinstance(response, StreamingResponse):
+                from kfserving_tpu.streams import aclose_quietly
+
+                await aclose_quietly(response.chunks,
+                                     "unstarted stream producer")
             return
         if isinstance(response, StreamingResponse):
             await self._write_streaming(response, keepalive)
@@ -334,14 +345,11 @@ class _HttpProtocol(asyncio.Protocol):
                     self.transport.close()
         finally:
             # Close the producer NOW on any exit path (client gone,
-            # mid-stream failure): its finally blocks release admission
+            # mid-stream failure): its close path releases admission
             # slots and engine work — waiting for GC would leak them.
-            aclose = getattr(response.chunks, "aclose", None)
-            if aclose is not None:
-                try:
-                    await aclose()
-                except Exception:
-                    logger.exception("closing stream producer failed")
+            from kfserving_tpu.streams import aclose_quietly
+
+            await aclose_quietly(response.chunks, "stream producer")
 
     def _fail(self, status: int, reason: str):
         # Chain behind any in-flight response so a pipelined connection never

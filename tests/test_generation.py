@@ -451,3 +451,113 @@ async def test_engine_idle_loop_restarts(tiny):
     finally:
         await eng.close()
     assert got1 == want and got2 == want
+
+
+# ------------------------------------------------------ cancellation
+
+
+async def test_cancel_active_request_frees_slot(tiny):
+    """cancel() on an in-flight request frees its slot so a waiting
+    request gets admitted — the client-disconnect path must not decode
+    to the budget for nobody."""
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        req = eng.submit([1, 2, 3], max_new_tokens=10_000)
+        stream = eng.stream(req)
+        token, _ = await asyncio.wait_for(stream.__anext__(), timeout=30)
+        assert token is not None
+        eng.cancel(req)
+        # The slot is free: a second request completes.
+        got, reason = await asyncio.wait_for(
+            eng.complete([4, 5], max_new_tokens=3), timeout=30)
+        assert len(got) == 3 and reason == "length"
+        # The cancelled stream sees a terminal event.
+        async for _, fin in stream:
+            if fin is not None:
+                assert fin == "cancelled"
+                break
+    finally:
+        await eng.close()
+
+
+async def test_cancel_pending_request(tiny):
+    """cancel() removes a queued (not yet prefilled) request."""
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        # Fill the one slot so the second submit stays pending.
+        hog = eng.submit([9, 8, 7], max_new_tokens=10_000)
+        hog_stream = eng.stream(hog)
+        await asyncio.wait_for(hog_stream.__anext__(), timeout=30)
+        victim = eng.submit([1, 2], max_new_tokens=8)
+        assert victim in eng._pending
+        eng.cancel(victim)
+        assert victim not in eng._pending
+        eng.cancel(hog)
+    finally:
+        await eng.close()
+
+
+async def test_cancel_finished_request_is_noop(tiny):
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        req = eng.submit([1, 2, 3], max_new_tokens=2)
+        tokens = []
+        async for t, fin in eng.stream(req):
+            if t is not None:
+                tokens.append(t)
+        eng.cancel(req)  # must not raise or corrupt slots
+        got, _ = await eng.complete([1, 2, 3], max_new_tokens=2)
+        assert got == tokens
+    finally:
+        await eng.close()
+
+
+def test_attn_fn_prefill_returns_cache(tiny):
+    """A pluggable attn_fn (sequence-parallel serving) must still
+    produce per-layer k/v for return_cache=True — the generation
+    engine's insert scatter needs real tensors, not Nones."""
+    from kfserving_tpu.models.decoder import decoder_tiny
+    from kfserving_tpu.ops import dot_product_attention
+
+    cfg = decoder_tiny(num_layers=2, hidden_size=64, num_heads=2,
+                       intermediate_size=128, max_seq=MAX_SEQ,
+                       vocab_size=96,
+                       attn_fn=lambda q, k, v, m:
+                           dot_product_attention(q, k, v, mask=m))
+    module = DecoderLM(cfg)
+    variables = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+    _, caches = module.apply(variables,
+                             jnp.zeros((1, 8), jnp.int32),
+                             kv_lengths=jnp.asarray([5]),
+                             return_cache=True)
+    assert len(caches) == 2
+    for k, v in caches:
+        assert k.shape == (1, 8, 2, 32) and v.shape == (1, 8, 2, 32)
+
+
+async def test_cancel_during_prefill_delivers_terminal_event(tiny):
+    """cancel() landing while the request's prefill dispatch is on the
+    executor (neither pending nor active) must still end the stream
+    with a terminal event — a draining consumer must never hang
+    (code-review r5)."""
+    eng = make_engine(tiny, max_slots=1)
+    orig = eng._do_prefill_group
+
+    def cancel_mid_prefill(group, slots, bucket):
+        for r in group:
+            eng.cancel(r)
+        return orig(group, slots, bucket)
+
+    eng._do_prefill_group = cancel_mid_prefill
+    try:
+        req = eng.submit([1, 2, 3], max_new_tokens=5)
+        token, fin = await asyncio.wait_for(
+            eng.stream(req).__anext__(), timeout=30)
+        assert token is None and fin == "cancelled"
+        # The slot never got occupied; a follow-up request works.
+        eng._do_prefill_group = orig
+        got, reason = await eng.complete([4, 5], max_new_tokens=2)
+        assert len(got) == 2 and reason == "length"
+    finally:
+        await eng.close()

@@ -364,3 +364,62 @@ def test_hbm_accounting_includes_cache(tmp_path):
     finally:
         model.unload()
     assert hbm.used_bytes == 0
+
+
+async def test_generate_stream_disconnect_releases_slot(tmp_path):
+    """A client that disconnects before (or right after) the stream
+    starts must release BOTH the admission slot and the engine decode
+    slot.  Before the round-5 fix, _respond returned early on a closed
+    transport without ever aclose()ing the body, leaking one
+    containerConcurrency slot per disconnect until the server wedged
+    at all-503 (code-review r4 medium)."""
+    import aiohttp
+
+    from kfserving_tpu.server.app import ModelServer
+
+    model = GenerativeModel("gen", _write_model_dir(
+        tmp_path, max_new_tokens=60))
+    model.load()
+    server = ModelServer(http_port=0, container_concurrency=1,
+                         max_queue_depth=0)
+    await server.start_async([model], host="127.0.0.1")
+    base = f"http://127.0.0.1:{server.http_port}"
+    try:
+        body = json.dumps({"text_input": "going away",
+                           "max_tokens": 60}).encode()
+        head = ("POST /v2/models/gen/generate_stream HTTP/1.1\r\n"
+                "host: t\r\ncontent-type: application/json\r\n"
+                f"content-length: {len(body)}\r\n\r\n").encode()
+        # With container_concurrency=1, TWO leaks would wedge the
+        # server; three disconnects prove release.
+        for _ in range(3):
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", server.http_port)
+            writer.write(head + body)
+            await writer.drain()
+            writer.close()  # vanish without reading a byte
+            await writer.wait_closed()
+            await asyncio.sleep(0.05)
+        # Admission slot free again: a predict eventually succeeds.
+        async with aiohttp.ClientSession() as s:
+            r_ok = False
+            for _ in range(100):
+                async with s.post(
+                        f"{base}/v1/models/gen:predict",
+                        json={"instances": [
+                            {"prompt": "x", "max_tokens": 2}]}) as r:
+                    if r.status == 200:
+                        r_ok = True
+                        break
+                await asyncio.sleep(0.1)
+            assert r_ok, "admission slot leaked: predict never admitted"
+        # Engine slots drained: cancel() fired for abandoned streams
+        # instead of decoding 60 tokens for nobody.
+        for _ in range(100):
+            if (all(s is None for s in model.engine._slots)
+                    and not model.engine._pending):
+                break
+            await asyncio.sleep(0.05)
+        assert all(s is None for s in model.engine._slots)
+    finally:
+        await server.stop_async()

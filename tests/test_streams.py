@@ -1,0 +1,76 @@
+"""GuardedStream: cleanup runs exactly once on EVERY exit path —
+including aclose() before the first __anext__, where a plain async
+generator's finally never executes (the admission-slot leak class,
+code-review r4 medium)."""
+
+import pytest
+
+from kfserving_tpu.streams import GuardedStream
+
+
+def make(events=(1, 2, 3), fail_at=None):
+    async def gen():
+        for i, e in enumerate(events):
+            if fail_at is not None and i == fail_at:
+                raise RuntimeError("boom")
+            yield e
+
+    calls = []
+    return GuardedStream(gen(), lambda: calls.append(1)), calls
+
+
+async def test_close_before_any_iteration_runs_cleanup():
+    s, calls = make()
+    await s.aclose()
+    assert calls == [1]
+
+
+async def test_exhaustion_runs_cleanup_once():
+    s, calls = make()
+    got = [e async for e in s]
+    assert got == [1, 2, 3]
+    assert calls == [1]
+    await s.aclose()  # idempotent
+    assert calls == [1]
+
+
+async def test_partial_iteration_then_close():
+    s, calls = make()
+    assert await s.__anext__() == 1
+    await s.aclose()
+    assert calls == [1]
+
+
+async def test_inner_error_propagates_and_cleans_up():
+    s, calls = make(fail_at=1)
+    assert await s.__anext__() == 1
+    with pytest.raises(RuntimeError):
+        await s.__anext__()
+    assert calls == [1]
+    await s.aclose()
+    assert calls == [1]
+
+
+async def test_async_on_close_supported():
+    async def gen():
+        yield 1
+
+    calls = []
+
+    async def on_close():
+        calls.append(1)
+
+    s = GuardedStream(gen(), on_close)
+    await s.aclose()
+    assert calls == [1]
+
+
+async def test_cleanup_failure_is_swallowed():
+    async def gen():
+        yield 1
+
+    def bad():
+        raise ValueError("cleanup bug")
+
+    s = GuardedStream(gen(), bad)
+    await s.aclose()  # must not raise
