@@ -762,11 +762,23 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
             # baseline side).
             raise ValueError(
                 f"BENCH_GEN_K must be >= 2, got {k_hi}")
+    # Three-way interleaved A/B (ROOFLINE methodology):
+    #   k1    — steps_per_call=1, the token-granular baseline
+    #   kKd1  — K steps/dispatch, pipeline_depth=1 (blocking fetch:
+    #           wave wall = RTT + K device steps — the r4 shipped mode)
+    #   kK    — K steps/dispatch, pipeline_depth=2 (device-resident
+    #           feed chain: the fetch of wave N overlaps wave N+1, so
+    #           wave wall -> max(RTT, K device steps)) — shipped mode
+    variant_specs = [
+        ("k1", {"steps_per_call": 1}),
+        (f"k{k_hi}d1", {"steps_per_call": k_hi, "pipeline_depth": 1}),
+        (f"k{k_hi}", {"steps_per_call": k_hi}),
+    ]
     models = {}
     load_s = {}
-    for label, k in (("k1", 1), (f"k{k_hi}", k_hi)):
+    for label, extra in variant_specs:
         model_dir = _write_jax_model_dir(arch, arch_kwargs,
-                                         steps_per_call=k, **cfg)
+                                         **extra, **cfg)
         m = GenerativeModel(f"gen-{label}", model_dir)
         t0 = time.perf_counter()
         m.load()
@@ -843,7 +855,7 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
                 return np.asarray(gaps[1:] or [0.0])
 
             g1 = await gaps_for("k1")
-            gk = await gaps_for(variants[1])
+            gk = await gaps_for(variants[2])
         out: Dict[str, Any] = {
             "requests": n_req, "concurrency": conc,
             "max_tokens": max_tokens,
@@ -861,19 +873,27 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
                 "decode_dispatches": stats.get("decode_steps"),
                 "token_steps": stats.get("token_steps"),
                 "decode_device_s": stats.get("decode_device_s"),
+                "decode_wait_s": stats.get("decode_wait_s"),
+                "wasted_token_steps": stats.get("wasted_token_steps"),
+                "pipeline_depth": stats.get("pipeline_depth"),
             }
         k1 = out["steps_per_call_ab"]["k1"]["tokens_per_s"]
-        khi = out["steps_per_call_ab"][variants[1]]["tokens_per_s"]
+        kd1 = out["steps_per_call_ab"][variants[1]]["tokens_per_s"]
+        khi = out["steps_per_call_ab"][variants[2]]["tokens_per_s"]
         if k1 and khi:
             out["k_speedup"] = round(khi / k1, 2)
-        # Headline numbers come from the K variant (the shipped
-        # default for this transport).
+        if kd1 and khi:
+            # The pipelining dividend at equal K: >1 means the fetch
+            # RTT is being hidden behind device compute.
+            out["depth_speedup"] = round(khi / kd1, 2)
+        # Headline numbers come from the pipelined K variant (the
+        # shipped default for this transport).
         out["tokens_per_s"] = khi
         out["token_p50_ms"] = round(float(np.percentile(g1, 50)), 2)
         out["token_p99_ms"] = round(float(np.percentile(g1, 99)), 2)
         out["chunk_p50_ms"] = round(float(np.percentile(gk, 50)), 2)
         out["slot_occupancy"] = out["steps_per_call_ab"][
-            variants[1]]["slot_occupancy"]
+            variants[2]]["slot_occupancy"]
         out["cache_bytes"] = models["k1"].engine_stats().get(
             "cache_bytes")
         return out

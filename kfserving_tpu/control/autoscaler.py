@@ -25,6 +25,10 @@ logger = logging.getLogger("kfserving_tpu.control.autoscaler")
 DEFAULT_TARGET_CONCURRENCY = 4.0
 WINDOW_TICKS = 6
 IDLE_TICKS_TO_ZERO = 30
+# Generative scaling target: keep engine slot pools at or below this
+# utilization (occupancy + queued prefills vs capacity) — the KPA
+# "target concurrency" analogue for slot-structured load.
+TARGET_SLOT_UTIL = 0.8
 
 
 class Autoscaler:
@@ -65,9 +69,46 @@ class Autoscaler:
             for cname, comp in isvc.components().items():
                 await self._scale_component(name, isvc, cname, comp)
 
+    def _occupancy_desired(self, cid: str) -> int:
+        """Generative saturation: replicas needed so engine slot
+        occupancy (busy slots + queued prefills) sits at or below
+        TARGET_SLOT_UTIL of pool capacity.  Returns 0 for components
+        without a generation engine (the request-count signal rules
+        alone there).  Reads in-process replica handles; subprocess
+        replicas without a handle contribute nothing (their load still
+        shows in the router's request gauge)."""
+        replicas = self.controller.reconciler.orchestrator.replicas(cid)
+        busy = 0
+        per_replica_cap = 0
+        for r in replicas:
+            repo = getattr(getattr(r, "handle", None),
+                           "repository", None)
+            if repo is None:
+                continue
+            replica_cap = 0
+            for m in repo.get_models():
+                eng = getattr(m, "engine", None)
+                gauges = getattr(eng, "load_gauges", None)
+                if gauges is None:
+                    continue
+                g = gauges()
+                busy += g["active_slots"] + g["pending"]
+                replica_cap += g["max_slots"]
+            # A replica's capacity is the SUM of its engines' pools (a
+            # repository may host several generative models).
+            per_replica_cap = max(per_replica_cap, replica_cap)
+        if per_replica_cap == 0:
+            return 0
+        return math.ceil(busy / (TARGET_SLOT_UTIL * per_replica_cap))
+
     async def _scale_component(self, name, isvc, cname, comp):
         gauge_key = f"router/{isvc.name}/{cname}"
         inflight = self.router.inflight.get(gauge_key, 0)
+        cid = self.controller.reconciler.component_id(isvc, cname)
+        # A generative replica's true load signal: slot occupancy +
+        # pending prefill depth.  Request count alone cannot see a
+        # replica saturated by a handful of long-lived streams.
+        occupancy_load = self._occupancy_desired(cid)
         window = self._windows.setdefault(
             f"{name}/{cname}", deque(maxlen=WINDOW_TICKS))
         window.append(inflight)
@@ -75,6 +116,7 @@ class Autoscaler:
         target = (comp.container_concurrency
                   or self.target_concurrency)
         desired = math.ceil(avg / target) if avg > 0 else 0
+        desired = max(desired, occupancy_load)
         key = f"{name}/{cname}"
         if desired == 0:
             self._idle[key] = self._idle.get(key, 0) + 1
@@ -87,8 +129,8 @@ class Autoscaler:
                 return  # stay as-is until idle threshold
         else:
             self._idle[key] = 0
-        current = len(self.controller.reconciler.orchestrator.replicas(
-            self.controller.reconciler.component_id(isvc, cname)))
+        current = len(
+            self.controller.reconciler.orchestrator.replicas(cid))
         clamped = max(comp.min_replicas, min(comp.max_replicas, desired))
         if clamped != current and clamped > 0:
             logger.info("scaling %s/%s %d -> %d (avg conc %.1f)",

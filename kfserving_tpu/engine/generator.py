@@ -26,10 +26,14 @@ is the TPU-first design for that:
   immediately (EOS or token budget).  The admission policy is
   prefill-priority: arrivals never wait for the current generation
   wave to drain (the "continuous" in continuous batching).
-- **on-device sampling**: greedy and temperature (Gumbel trick) per
-  slot; only the [S] int32 token vector crosses the host boundary per
+- **on-device sampling**: greedy, temperature (Gumbel trick), top-k
+  and top-p (nucleus) per slot — the mask-then-sample runs on device,
+  so only the [S] int32 token vector crosses the host boundary per
   step — never the [S, V] logits (1.6 MB/step for a GPT-2 vocab; the
-  host link is the serving bottleneck, ROOFLINE.md).
+  host link is the serving bottleneck, ROOFLINE.md).  Noise is keyed
+  per request from (seed, absolute position): a seeded request
+  reproduces exactly no matter how it was scheduled.  Top-N logprobs
+  are computed every step and fetched only when a request asks.
 - **donated caches**: the decode step donates the cache buffers, so
   XLA updates them in place — HBM holds ONE cache pool, not
   step-transient copies.
@@ -58,8 +62,17 @@ class _Request:
     prompt_ids: np.ndarray
     max_new_tokens: int
     temperature: float
+    top_k: int = 0            # 0 = off
+    top_p: float = 1.0        # 1.0 = off
+    seed: int = 0             # folded into the sampling noise key
+    logprobs: int = 0         # top-N logprobs per token; 0 = off
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
     cancelled: bool = False
+    # Per-token logprob records appended by the scheduler in emit
+    # order (chosen logprob, [(token_id, logprob)] top-N); consumers
+    # read them aligned with the token stream.
+    lp_chosen: List[float] = field(default_factory=list)
+    lp_top: List[List[Tuple[int, float]]] = field(default_factory=list)
 
 
 @dataclass
@@ -85,7 +98,9 @@ class GenerationEngine:
                  prefill_buckets: Optional[List[int]] = None,
                  eos_id: Optional[int] = None,
                  steps_per_call: int = 1,
+                 pipeline_depth: int = 2,
                  rng_seed: int = 0,
+                 logprob_topk: int = 5,
                  mesh=None,
                  name: str = "decoder"):
         import jax
@@ -100,6 +115,16 @@ class GenerationEngine:
         if steps_per_call < 1:
             raise InvalidInput("steps_per_call must be >= 1")
         self.steps_per_call = int(steps_per_call)
+        if pipeline_depth < 1:
+            raise InvalidInput("pipeline_depth must be >= 1")
+        # Decode waves in flight on the device: at depth >= 2 the host
+        # fetch of wave N overlaps wave N+1's device execution, so the
+        # wave period is max(RTT, K device steps) instead of their sum
+        # (jax_engine.py's pipeline_depth, brought to decoding).  The
+        # price: EOS/budget/cancel decisions lag the device by up to
+        # depth-1 waves — a finishing slot wastes at most
+        # (depth-1)*K extra device steps (tracked in stats).
+        self.pipeline_depth = int(pipeline_depth)
         cfg = module.config
         if self.max_seq > cfg.max_seq:
             raise InvalidInput(
@@ -116,7 +141,13 @@ class GenerationEngine:
                 f"{self.max_seq}")
         self.prefill_buckets = buckets
         self._rng = jax.random.PRNGKey(rng_seed)
-        self._step_counter = 0
+        # Top-N width of the always-computed logprob outputs (fetched
+        # from device only when a request asked for them).
+        self.logprob_topk = max(1, int(logprob_topk))
+        # Default per-request sampling seeds: a deterministic counter —
+        # concurrent temperature requests differ from each other, and
+        # an explicit seed reproduces exactly.
+        self._seed_counter = 0
 
         n_layers = cfg.num_layers
         cache_shape = (self.max_slots, self.max_seq, cfg.num_heads,
@@ -147,49 +178,142 @@ class GenerationEngine:
                 for k, v in self._caches
             ]
 
-        def sample(logits, rng, temps):
-            # logits [B, V] float32; temps [B]; 0 = greedy.
+        base_key = self._rng
+        lp_n = self.logprob_topk
+
+        def mask_to_support(logits, top_ks, top_ps):
+            """Restrict logits to the top-k / nucleus support.  Both
+            knobs are per-row; 0 / 1.0 disable them.  One sort serves
+            both masks."""
+            v = logits.shape[-1]
+            sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+            k_eff = jnp.where((top_ks <= 0) | (top_ks >= v), v,
+                              top_ks)
+            kth = jnp.take_along_axis(sorted_desc,
+                                      (k_eff - 1)[:, None], axis=-1)
+            keep = logits >= kth
+            # Nucleus: keep the smallest prefix of the sorted
+            # distribution whose mass reaches top_p (the first token
+            # is always kept — cumsum-before-it is 0).
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = (cum - probs) < top_ps[:, None]
+            n_keep = jnp.maximum(jnp.sum(keep_sorted, axis=-1), 1)
+            p_thresh = jnp.take_along_axis(
+                sorted_desc, (n_keep - 1)[:, None], axis=-1)
+            keep &= logits >= p_thresh
+            return jnp.where(keep, logits,
+                             jnp.finfo(logits.dtype).min)
+
+        def sample(logits, temps, top_ks, top_ps, seeds, noise_pos):
+            """logits [B, V] float32.  Noise is keyed per ROW from
+            (request seed, absolute position), never from wave or slot
+            identity — a request's sampled tokens reproduce exactly
+            for a given seed no matter how it was scheduled."""
             greedy = jnp.argmax(logits, axis=-1)
-            gumbel = jax.random.gumbel(rng, logits.shape)
-            scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+            need_mask = jnp.any((top_ks > 0) | (top_ps < 1.0))
+            masked = jax.lax.cond(
+                need_mask,
+                lambda l: mask_to_support(l, top_ks, top_ps),
+                lambda l: l, logits)
+
+            def row_key(seed, pos):
+                return jax.random.fold_in(
+                    jax.random.fold_in(base_key, seed), pos)
+
+            keys = jax.vmap(row_key)(seeds, noise_pos)
+            gumbel = jax.vmap(
+                lambda k: jax.random.gumbel(k, (logits.shape[-1],))
+            )(keys)
+            scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
             sampled = jnp.argmax(scaled + gumbel, axis=-1)
             return jnp.where(temps <= 0.0, greedy,
                              sampled).astype(jnp.int32)
 
+        def logprob_of(logits, chosen):
+            """Chosen-token logprob + top-N (ids, logprobs) over the
+            UNMASKED distribution — diagnostics follow the model, not
+            the sampler's support restriction."""
+            lps = jax.nn.log_softmax(logits, axis=-1)
+            chosen_lp = jnp.take_along_axis(
+                lps, chosen[:, None].astype(jnp.int32), axis=-1)[:, 0]
+            top_lps, top_ids = jax.lax.top_k(lps, lp_n)
+            return chosen_lp, top_ids.astype(jnp.int32), top_lps
+
         k_steps = self.steps_per_call
 
-        def decode_fn(variables, caches, tokens, positions, rng, temps):
+        def decode_fn(variables, caches, tokens, positions, temps,
+                      top_ks, top_ps, seeds):
             """K decode steps in ONE device dispatch (lax.scan): on a
             high-RTT link each host round trip costs ~an RTT, so
             single-token stepping caps tokens/s at 1/RTT per wave;
             scanning K steps on device multiplies that by K.  Tokens
             feed forward on device; the host sees [S, K] at once (stop
             conditions checked per chunk — at most K-1 wasted steps
-            after an EOS/budget stop)."""
-            def step(carry, step_rng):
+            after an EOS/budget stop).  Also returns the final carry's
+            feed tokens/positions as device arrays: the pipelined
+            scheduler chains dispatch N+1 off them without a host
+            round trip."""
+            def step(carry, _):
                 caches, tokens, positions = carry
                 logits, new_caches = module.apply(
                     variables, tokens[:, None], positions=positions,
                     kv_cache=caches)
-                nxt = sample(logits[:, 0], step_rng, temps)
-                return (new_caches, nxt, positions + 1), nxt
+                lg = logits[:, 0]
+                # The token being sampled extends a prefix of length
+                # positions+1 — the noise index is that length, so
+                # prefill (length L) and decode agree on the sequence
+                # L, L+1, ... per request.
+                nxt = sample(lg, temps, top_ks, top_ps, seeds,
+                             positions + 1)
+                lp = logprob_of(lg, nxt)
+                return (new_caches, nxt, positions + 1), (nxt, lp)
 
-            rngs = jax.random.split(rng, k_steps)
-            (caches, _, _), toks = jax.lax.scan(
-                step, (caches, tokens, positions), rngs)
-            return toks.T, caches  # [S, K]
+            (caches, next_tokens, next_positions), (toks, lps) = \
+                jax.lax.scan(step, (caches, tokens, positions),
+                             None, length=k_steps)
+            chosen_lp, top_ids, top_lps = lps
+            # scan stacks on axis 0: [K, S, ...] -> [S, K, ...]
+            return (toks.T, caches, next_tokens, next_positions,
+                    chosen_lp.T, jnp.swapaxes(top_ids, 0, 1),
+                    jnp.swapaxes(top_lps, 0, 1))
 
-        # Donate the caches: in-place HBM update, one resident pool.
-        self._decode = jax.jit(decode_fn, donate_argnums=(1,))
+        # Donate caches AND the feed arrays: in-place HBM update, one
+        # resident pool; the feed tokens/positions chain wave-to-wave
+        # entirely on device.
+        self._decode = jax.jit(decode_fn, donate_argnums=(1, 2, 3))
 
-        def prefill_fn(variables, ids, lengths, rng, temps):
+        def feed_update_fn(tokens, positions, slot_arr, new_tokens,
+                           new_positions):
+            """Scatter newly admitted requests' first feed token and
+            position into the device-resident feed arrays (OOB
+            sentinel rows drop, like the cache insert)."""
+            return (tokens.at[slot_arr].set(new_tokens, mode="drop"),
+                    positions.at[slot_arr].set(new_positions,
+                                               mode="drop"))
+
+        self._feed_update = jax.jit(feed_update_fn,
+                                    donate_argnums=(0, 1))
+        # Device-resident feed state: the token each slot feeds next
+        # and its position.  Rows of freed slots go stale — that is
+        # deliberate; a garbage decode on a free slot is harmless
+        # (its tokens are dropped at distribute, OOB cache writes
+        # drop, gathers clamp) and admission overwrites the row.
+        self._feed_tokens = jnp.zeros(self.max_slots, jnp.int32)
+        self._feed_positions = jnp.zeros(self.max_slots, jnp.int32)
+
+        def prefill_fn(variables, ids, lengths, temps, top_ks, top_ps,
+                       seeds):
             logits, caches = module.apply(variables, ids,
                                           kv_lengths=lengths,
                                           return_cache=True)
             idx = (lengths - 1)[:, None, None]
             last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
-            first_tokens = sample(last, rng, temps)
-            return first_tokens, caches
+            first_tokens = sample(last, temps, top_ks, top_ps, seeds,
+                                  lengths)
+            chosen_lp, top_ids, top_lps = logprob_of(last,
+                                                     first_tokens)
+            return first_tokens, caches, chosen_lp, top_ids, top_lps
 
         # One executable per prompt bucket (jit caches by shape).
         self._prefill = jax.jit(prefill_fn)
@@ -232,7 +356,12 @@ class GenerationEngine:
         self.prefill_requests = 0   # requests admitted through them
         self.requests_finished = 0
         self._occupied_slot_steps = 0
+        self._wasted_token_steps = 0  # garbage steps past a finish
+        # Union of enqueue->fetch intervals (overlap-corrected at
+        # depth >= 2, so the stat stays <= wall clock).
         self._decode_device_s = 0.0
+        self._last_fetch_done = 0.0
+        self._decode_wait_s = 0.0     # host blocked in the D2H fetch
         self._prefill_device_s = 0.0
 
     # -- public API --------------------------------------------------------
@@ -247,23 +376,28 @@ class GenerationEngine:
                    for x in jax.tree.leaves(self.variables))
 
     async def generate(self, prompt_ids, max_new_tokens: int = 32,
-                       temperature: float = 0.0
+                       temperature: float = 0.0, **sampling
                        ) -> AsyncIterator[Tuple[int, Optional[str]]]:
         """Yields (token_id, finish_reason) events.  Intermediate
         tokens arrive as (id, None); the stream ends with either
         (id, 'length') — the budget-final token — or (None, 'eos'),
         since EOS is a stop signal, not content.  Engine failures
         surface as InferenceError mid-stream."""
-        req = self.submit(prompt_ids, max_new_tokens, temperature)
+        req = self.submit(prompt_ids, max_new_tokens, temperature,
+                          **sampling)
         async for event in self.stream(req):
             yield event
 
     def submit(self, prompt_ids, max_new_tokens: int = 32,
-               temperature: float = 0.0) -> _Request:
+               temperature: float = 0.0, *, top_k: int = 0,
+               top_p: float = 1.0, seed: Optional[int] = None,
+               logprobs: int = 0) -> _Request:
         """Validate and enqueue a request NOW (InvalidInput surfaces to
         the caller before any response bytes are committed — the
         streaming route depends on this).  Pair with `stream()`."""
-        return self._submit(prompt_ids, max_new_tokens, temperature)
+        return self._submit(prompt_ids, max_new_tokens, temperature,
+                            top_k=top_k, top_p=top_p, seed=seed,
+                            logprobs=logprobs)
 
     async def stream(self, req: _Request
                      ) -> AsyncIterator[Tuple[Optional[int],
@@ -289,6 +423,7 @@ class GenerationEngine:
         try:
             self._pending.remove(req)
             req.out.put_nowait((None, "cancelled"))
+            self.requests_finished += 1
             return
         except ValueError:
             pass
@@ -303,20 +438,24 @@ class GenerationEngine:
         # `cancelled` and drops it.
 
     async def complete(self, prompt_ids, max_new_tokens: int = 32,
-                       temperature: float = 0.0
+                       temperature: float = 0.0, **sampling
                        ) -> Tuple[List[int], str]:
         tokens: List[int] = []
         reason = "length"
         async for token, fin in self.generate(prompt_ids,
                                               max_new_tokens,
-                                              temperature):
+                                              temperature,
+                                              **sampling):
             if token is not None:
                 tokens.append(token)
             if fin is not None:
                 reason = fin
         return tokens, reason
 
-    def _submit(self, prompt_ids, max_new_tokens, temperature) -> _Request:
+    def _submit(self, prompt_ids, max_new_tokens, temperature, *,
+                top_k: int = 0, top_p: float = 1.0,
+                seed: Optional[int] = None,
+                logprobs: int = 0) -> _Request:
         if self._closed:
             raise InvalidInput(f"generator {self.name} is closed")
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
@@ -328,6 +467,13 @@ class GenerationEngine:
                 f"bucket {self.prefill_buckets[-1]}")
         if max_new_tokens < 1:
             raise InvalidInput("max_new_tokens must be >= 1")
+        if not 0.0 < float(top_p) <= 1.0:
+            raise InvalidInput("top_p must be in (0, 1]")
+        if top_k < 0:
+            raise InvalidInput("top_k must be >= 0")
+        if logprobs < 0 or logprobs > self.logprob_topk:
+            raise InvalidInput(
+                f"logprobs must be in [0, {self.logprob_topk}]")
         # Clamp the budget to cache capacity: prompt + generated tokens
         # must fit max_seq.
         budget = min(int(max_new_tokens), self.max_seq - int(ids.size))
@@ -335,7 +481,13 @@ class GenerationEngine:
             raise InvalidInput(
                 f"prompt length {ids.size} leaves no room to generate "
                 f"within max_seq {self.max_seq}")
-        req = _Request(ids, budget, float(temperature))
+        if seed is None:
+            seed = self._seed_counter
+            self._seed_counter += 1
+        req = _Request(ids, budget, float(temperature),
+                       top_k=int(top_k), top_p=float(top_p),
+                       seed=int(seed) & 0x7FFFFFFF,
+                       logprobs=int(logprobs))
         self._pending.append(req)
         self._ensure_loop()
         return req
@@ -367,6 +519,18 @@ class GenerationEngine:
             self._wakeup.set()
         self._executor.shutdown(wait=False)
 
+    def load_gauges(self) -> Dict[str, int]:
+        """Instantaneous saturation signal for the autoscaler: a
+        generative replica saturates by slot occupancy and pending
+        prefill depth, NOT by request count (8 slow streams = '8
+        inflight' at the router = invisible saturation)."""
+        return {
+            "active_slots": sum(1 for s in self._slots
+                                if s is not None),
+            "pending": len(self._pending),
+            "max_slots": self.max_slots,
+        }
+
     def stats(self) -> Dict[str, Any]:
         steps = max(1, self._token_steps)
         return {
@@ -381,8 +545,11 @@ class GenerationEngine:
                 self._occupied_slot_steps / (steps * self.max_slots), 4),
             "max_slots": self.max_slots,
             "max_seq": self.max_seq,
+            "pipeline_depth": self.pipeline_depth,
+            "wasted_token_steps": self._wasted_token_steps,
             "cache_bytes": self.cache_bytes(),
             "decode_device_s": round(self._decode_device_s, 4),
+            "decode_wait_s": round(self._decode_wait_s, 4),
             "prefill_device_s": round(self._prefill_device_s, 4),
         }
 
@@ -392,11 +559,6 @@ class GenerationEngine:
             if s is None:
                 return i
         return None
-
-    def _next_rng(self):
-        jax = self._jax
-        self._step_counter += 1
-        return jax.random.fold_in(self._rng, self._step_counter)
 
     async def _run(self):
         try:
@@ -442,6 +604,9 @@ class GenerationEngine:
 
     async def _run_inner(self):
         loop = asyncio.get_event_loop()
+        # Waves in flight on the device: (token_handle, lp_handles,
+        # snapshot of _Active refs at enqueue, enqueue wall time).
+        inflight: deque = deque()
         while not self._closed:
             admitted = False
             while self._pending and self._free_slot() is not None:
@@ -461,7 +626,8 @@ class GenerationEngine:
                     continue
                 # Slot bookkeeping and token delivery happen here on
                 # the loop thread: asyncio.Queue is not thread-safe.
-                for req, slot, first in zip(group, slots, firsts):
+                for req, slot, (first, lp_rec) in zip(group, slots,
+                                                      firsts):
                     if req.cancelled:
                         # Cancelled while its prefill was on the
                         # executor: drop it before it occupies a slot.
@@ -475,11 +641,10 @@ class GenerationEngine:
                     self._slots[slot] = _Active(
                         req=req, length=req.prompt_ids.size,
                         last_token=first, generated=0)
-                    self._emit(slot, first)
+                    self._emit(slot, first, lp_rec)
                 admitted = True
-            active = [i for i, s in enumerate(self._slots)
-                      if s is not None]
-            if not active:
+            active = any(s is not None for s in self._slots)
+            if not active and not inflight:
                 if not self._pending:
                     self._wakeup.clear()
                     if admitted:
@@ -492,21 +657,63 @@ class GenerationEngine:
                                 s is not None for s in self._slots):
                             return  # idle: let the loop die; resubmit restarts
                 continue
-            tokens = await loop.run_in_executor(
-                self._executor, self._do_decode_step)
-            self._distribute(tokens)
+            # Keep the device pipeline_depth waves deep: wave N+1's
+            # feed tokens are wave N's device outputs — no host round
+            # trip sits between waves, so the fetch of wave N below
+            # overlaps wave N+1's execution.
+            while active and len(inflight) < self.pipeline_depth:
+                inflight.append(self._enqueue_wave())
+            toks_h, lp_h, snapshot, t0 = inflight.popleft()
+            tokens, lp = await loop.run_in_executor(
+                self._executor, self._fetch_wave, toks_h, lp_h)
+            # Union of busy intervals, NOT per-wave spans: at depth>=2
+            # the spans of consecutive waves overlap, and summing them
+            # would exceed wall clock (making depth A/Bs lie).
+            now = time.perf_counter()
+            self._decode_device_s += now - max(t0,
+                                               self._last_fetch_done)
+            self._last_fetch_done = now
+            self._distribute(tokens, lp, snapshot)
+
+    def _enqueue_wave(self):
+        """Dispatch one K-step decode wave (non-blocking: JAX async
+        dispatch).  Consumes the device-resident caches + feed arrays
+        and replaces them with the wave's output handles."""
+        jnp = self._jnp
+        temps, top_ks, top_ps, seeds, want_lp = self._sampling_arrays()
+        (toks, self._caches, self._feed_tokens, self._feed_positions,
+         chosen_lp, top_ids, top_lps) = self._decode(
+            self.variables, self._caches, self._feed_tokens,
+            self._feed_positions, jnp.asarray(temps),
+            jnp.asarray(top_ks), jnp.asarray(top_ps),
+            jnp.asarray(seeds))
+        lp_h = (chosen_lp, top_ids, top_lps) if want_lp else None
+        self.decode_steps += 1
+        return toks, lp_h, list(self._slots), time.perf_counter()
+
+    def _fetch_wave(self, toks_h, lp_h):
+        """Runs on the executor thread: the D2H fetch that joins the
+        device timeline (block_until_ready on this transport acks the
+        dispatch without joining — only the fetch truly waits)."""
+        t0 = time.perf_counter()
+        tokens = np.asarray(toks_h)
+        lp = None
+        if lp_h is not None:
+            lp = tuple(np.asarray(h) for h in lp_h)
+        self._decode_wait_s += time.perf_counter() - t0
+        return tokens, lp
 
     def _do_prefill_group(self, group: List[_Request],
                           slots: List[int],
-                          bucket: int) -> List[int]:
+                          bucket: int):
         """Runs on the executor thread: one bucket-padded prefill
         dispatch for the WHOLE group (a burst of arrivals used to pay
         one ~RTT dispatch each — half the device time under load).
         The batch pads to a pow2 row bucket so compile count stays
         bounded; padding rows carry an out-of-bounds slot sentinel the
-        insert scatter drops.  Returns the first generated token per
-        request; slot state is installed by the scheduler on the loop
-        thread."""
+        insert scatter drops.  Returns (first_token, lp_record|None)
+        per request; slot state is installed by the scheduler on the
+        loop thread."""
         jnp = self._jnp
         b = len(group)
         b_bucket = 1
@@ -515,48 +722,78 @@ class GenerationEngine:
         ids = np.zeros((b_bucket, bucket), np.int32)
         lengths = np.ones(b_bucket, np.int32)  # dummy rows: length 1
         temps = np.zeros(b_bucket, np.float32)
+        top_ks = np.zeros(b_bucket, np.int32)
+        top_ps = np.ones(b_bucket, np.float32)
+        seeds = np.zeros(b_bucket, np.int32)
         slot_arr = np.full(b_bucket, self.max_slots, np.int32)  # OOB
+        want_lp = False
         for i, (req, slot) in enumerate(zip(group, slots)):
             n = req.prompt_ids.size
             ids[i, :n] = req.prompt_ids
             lengths[i] = n
             temps[i] = req.temperature
+            top_ks[i] = req.top_k
+            top_ps[i] = req.top_p
+            seeds[i] = req.seed
             slot_arr[i] = slot
+            want_lp = want_lp or req.logprobs > 0
         t0 = time.perf_counter()
-        firsts, new_caches = self._prefill(
-            self.variables, jnp.asarray(ids), jnp.asarray(lengths),
-            self._next_rng(), jnp.asarray(temps))
+        firsts, new_caches, chosen_lp, top_ids, top_lps = \
+            self._prefill(
+                self.variables, jnp.asarray(ids), jnp.asarray(lengths),
+                jnp.asarray(temps), jnp.asarray(top_ks),
+                jnp.asarray(top_ps), jnp.asarray(seeds))
         self._caches = self._insert(self._caches, new_caches,
                                     jnp.asarray(slot_arr))
+        # The admitted slots' first feed token/position land in the
+        # device-resident feed arrays; rows of slots NOT in this group
+        # keep their device values (the last enqueued wave's outputs,
+        # which the host may not have seen yet).
+        self._feed_tokens, self._feed_positions = self._feed_update(
+            self._feed_tokens, self._feed_positions,
+            jnp.asarray(slot_arr), firsts,
+            jnp.asarray(lengths))
         firsts = np.asarray(self._jax.block_until_ready(firsts))
+        lp = None
+        if want_lp:
+            # Logprob outputs cross the host link only when asked for.
+            lp = (np.asarray(chosen_lp), np.asarray(top_ids),
+                  np.asarray(top_lps))
         self._prefill_device_s += time.perf_counter() - t0
         self.prefills += 1
         self.prefill_requests += b
-        return [int(firsts[i]) for i in range(b)]
+        out = []
+        for i, req in enumerate(group):
+            rec = None
+            if lp is not None and req.logprobs > 0:
+                rec = (float(lp[0][i]),
+                       [(int(t), float(p)) for t, p in
+                        zip(lp[1][i][:req.logprobs],
+                            lp[2][i][:req.logprobs])])
+            out.append((int(firsts[i]), rec))
+        return out
 
-    def _do_decode_step(self) -> np.ndarray:
-        """One device dispatch = steps_per_call decode steps; returns
-        [S, K] tokens."""
-        jnp = self._jnp
-        tokens = np.zeros(self.max_slots, np.int32)
-        positions = np.zeros(self.max_slots, np.int32)
-        temps = np.zeros(self.max_slots, np.float32)
+    def _sampling_arrays(self):
+        """Per-slot sampling parameter arrays for a decode dispatch.
+        Feed tokens/positions live on device (the previous wave's
+        outputs); only the sampling knobs come from host state."""
+        S = self.max_slots
+        temps = np.zeros(S, np.float32)
+        top_ks = np.zeros(S, np.int32)
+        top_ps = np.ones(S, np.float32)
+        seeds = np.zeros(S, np.int32)
+        want_lp = False
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
-            tokens[i] = s.last_token
-            positions[i] = s.length
             temps[i] = s.req.temperature
-        t0 = time.perf_counter()
-        next_tokens, self._caches = self._decode(
-            self.variables, self._caches, jnp.asarray(tokens),
-            jnp.asarray(positions), self._next_rng(),
-            jnp.asarray(temps))
-        out = np.asarray(self._jax.block_until_ready(next_tokens))
-        self._decode_device_s += time.perf_counter() - t0
-        return out
+            top_ks[i] = s.req.top_k
+            top_ps[i] = s.req.top_p
+            seeds[i] = s.req.seed
+            want_lp = want_lp or s.req.logprobs > 0
+        return temps, top_ks, top_ps, seeds, want_lp
 
-    def _emit(self, slot: int, token: int):
+    def _emit(self, slot: int, token: int, lp_rec=None):
         """Account a newly produced token for `slot` and deliver it (or
         the finish marker) to the request's stream.
 
@@ -577,6 +814,11 @@ class GenerationEngine:
             # EOS is a stop signal, not content.
             s.req.out.put_nowait((None, "eos"))
         else:
+            if lp_rec is not None:
+                # Records align 1:1 with CONTENT tokens (an EOS stop
+                # delivers no token, so it records no logprob).
+                s.req.lp_chosen.append(lp_rec[0])
+                s.req.lp_top.append(lp_rec[1])
             s.req.out.put_nowait((token, finished))
         if finished is not None:
             self._slots[slot] = None
@@ -584,24 +826,42 @@ class GenerationEngine:
         else:
             s.last_token = token
 
-    def _distribute(self, tokens: np.ndarray):
-        """tokens [S, K]: per active slot, consume the chunk in order;
-        a slot finishing mid-chunk (EOS or budget) discards its
-        remaining positions — at most K-1 device steps of waste."""
-        self.decode_steps += 1
+    def _distribute(self, tokens: np.ndarray, lp, snapshot):
+        """tokens [S, K]: deliver each slot's chunk in order.  A slot
+        only consumes its row if the SAME _Active object that was
+        in the slot at enqueue time is still there — a slot freed (or
+        freed-and-readmitted) between enqueue and fetch was decoding
+        garbage for this wave, and its row is discarded (that waste is
+        the pipelining trade; counted in wasted_token_steps).  A slot
+        finishing mid-chunk discards its remaining positions — at most
+        K-1 steps of waste."""
         k = tokens.shape[1]
         self._token_steps += k
-        for i, s in enumerate(self._slots):
+        for i, s in enumerate(snapshot):
             if s is None:
                 continue
+            if self._slots[i] is not s:
+                # Freed (EOS/budget/cancel) after this wave was
+                # enqueued: the device decoded K garbage steps for it.
+                self._wasted_token_steps += k
+                continue
             self._occupied_slot_steps += k
+            n_lp = s.req.logprobs
             for j in range(k):
-                if self._slots[i] is None:
-                    break  # finished mid-chunk
+                if self._slots[i] is not s:
+                    # Finished mid-chunk: remaining positions wasted.
+                    self._wasted_token_steps += k - j
+                    break
                 # Each scanned step wrote the fed token's k/v at the
                 # slot's position: the cache grew by one per step.
                 s.length += 1
-                self._emit(i, int(tokens[i, j]))
+                rec = None
+                if lp is not None and n_lp > 0:
+                    rec = (float(lp[0][i, j]),
+                           [(int(t), float(p)) for t, p in
+                            zip(lp[1][i, j][:n_lp],
+                                lp[2][i, j][:n_lp])])
+                self._emit(i, int(tokens[i, j]), rec)
 
 
 def _pow2_buckets(max_seq: int) -> List[int]:

@@ -27,11 +27,20 @@ config.json schema:
 
 Request shapes (both V1 predict and the generate routes):
     {"instances": ["a prompt", {"prompt": "...", "max_tokens": 32,
-                                "temperature": 0.7}]}
+                                "temperature": 0.7, "top_k": 40,
+                                "top_p": 0.95, "seed": 7,
+                                "stop": ["\n\n"], "logprobs": 3}]}
     {"text_input": "...", "parameters": {...}}   # v2 generate ext.
 Response:
     {"predictions": [{"text": ..., "token_count": n,
-                      "finish_reason": "eos"|"length"}]}
+                      "finish_reason": "eos"|"length"|"stop",
+                      "logprobs": [...]}]}       # logprobs on request
+
+Sampling runs on device (top-k/top-p mask-then-sample; seeded noise
+keyed on (seed, position) so runs reproduce); stop sequences match
+host-side in TEXT space on the decoded tail — the streaming path
+holds back any suffix that could begin a stop sequence so clients
+never see stop text, even split across K>1 token chunks.
 
 The byte tokenizer (ids = UTF-8 bytes, BOS=256, EOS=257) keeps the
 stack dependency-free and lossless for any input; "hf:<name>" resolves
@@ -56,6 +65,138 @@ logger = logging.getLogger("kfserving_tpu.llm")
 
 BOS_ID = 256
 EOS_ID = 257
+
+
+def _find_stop(text: str, stops: List[str]) -> int:
+    """Earliest index of any stop sequence in `text`, or -1."""
+    idx = -1
+    for s in stops:
+        i = text.find(s)
+        if i >= 0 and (idx < 0 or i < idx):
+            idx = i
+    return idx
+
+
+def _holdback_len(text: str, stops: List[str]) -> int:
+    """Length of the longest suffix of `text` that is a proper prefix
+    of some stop sequence — the streaming path must not emit those
+    characters yet, or a stop split across chunks would leak to the
+    client before the match completes."""
+    hold = 0
+    for s in stops:
+        for length in range(min(len(s) - 1, len(text)), hold, -1):
+            if text.endswith(s[:length]):
+                hold = length
+                break
+    return hold
+
+
+class IncrementalDecoder:
+    """Streaming detokenizer: O(pending-window) work per token and
+    emission-stable deltas.
+
+    Slicing re-decoded full text by character index is wrong twice
+    over: decode is not append-stable (a UTF-8 sequence split across
+    tokens decodes to U+FFFD until its last byte arrives, then the
+    SAME index holds a different character — the delta silently drops
+    it), and re-decoding everything per token is O(n^2) on the event
+    loop.  This decoder keeps a small window of not-yet-emitted
+    tokens, re-decodes only that window, and releases text only when
+    it can no longer change:
+
+    - a trailing U+FFFD is held (it may be a partial multibyte
+      sequence that completes next token; genuine garbage flushes at
+      finish),
+    - a suffix that is a proper prefix of a stop sequence is held
+      (the holdback invariant: emitted text NEVER ends with a stop
+      prefix, which also means stop matches only ever appear in the
+      unemitted window),
+    - the window compacts whenever everything in it has been emitted,
+      so per-token work stays O(window), not O(generated-so-far).
+    """
+
+    def __init__(self, tokenizer, stops: List[str]):
+        self.tok = tokenizer
+        self.stops = stops
+        self.max_stop = max((len(s) for s in stops), default=0)
+        self._sent: List[str] = []
+        self._pending: List[int] = []
+        self._p_emitted = ""   # prefix of decode(_pending) already out
+        self.degraded = False  # decode rewrote emitted text (exotic
+        #                        tokenizer): deltas go best-effort and
+        #                        the terminal text must come from a
+        #                        full decode
+
+    def push(self, token: int):
+        """Feed one token; returns (delta, stopped).  `delta` is the
+        newly releasable text (possibly empty); `stopped` means a stop
+        sequence matched — delta then ends exactly before the match
+        and the caller must stop the stream."""
+        self._pending.append(token)
+        ptext = self.tok.decode(self._pending)
+        if not ptext.startswith(self._p_emitted):
+            self.degraded = True
+            return "", False
+        rest = ptext[len(self._p_emitted):]
+        if self.stops:
+            idx = _find_stop(rest, self.stops)
+            if idx >= 0:
+                delta = rest[:idx]
+                self._emit(delta, ptext)
+                return delta, True
+            hold = _holdback_len(rest, self.stops)
+        else:
+            hold = 0
+        candidate = rest[:len(rest) - hold] if hold else rest
+        while candidate.endswith("�"):
+            candidate = candidate[:-1]
+        self._emit(candidate, ptext)
+        return candidate, False
+
+    def finish(self) -> str:
+        """Flush everything still held (no stop matched); returns the
+        final delta."""
+        ptext = self.tok.decode(self._pending)
+        if not ptext.startswith(self._p_emitted):
+            self.degraded = True
+            return ""
+        delta = ptext[len(self._p_emitted):]
+        self._emit(delta, ptext)
+        return delta
+
+    def text(self) -> str:
+        """Text emitted so far (== the full truncated output after a
+        stop, or the full output after finish())."""
+        return "".join(self._sent)
+
+    # Tokens of context kept across window compaction: a window that
+    # restarted at zero would re-decode its first token without its
+    # neighbors, and piece-joining tokenizers (sentencepiece leading-
+    # space, BPE cleanup) decode a boundary token differently alone.
+    # Keeping a small suffix makes the boundary artifact identical in
+    # p_emitted and in every later decode of the same window, so the
+    # deltas cancel it out (the vLLM prefix-offset trick).
+    _KEEP = 4
+
+    def _emit(self, s: str, ptext: str):
+        if s:
+            self._sent.append(s)
+            self._p_emitted += s
+        # Compact: once the whole window is out, shrink it — this is
+        # what keeps per-token cost O(window).
+        if self._p_emitted == ptext and \
+                len(self._pending) > self._KEEP:
+            self._pending = self._pending[-self._KEEP:]
+            self._p_emitted = self.tok.decode(self._pending)
+
+
+def _lp_payload(req, tokens: List[int]) -> List[Dict[str, Any]]:
+    """Per-token logprob records (aligned with content tokens)."""
+    return [
+        {"id": int(t), "logprob": c,
+         "top": [{"id": i, "logprob": p} for i, p in top]}
+        for t, c, top in zip(tokens, req.lp_chosen, req.lp_top)
+    ]
 
 
 class ByteTokenizer:
@@ -118,6 +259,8 @@ class GenerativeConfig:
                  max_new_tokens: int = 64, temperature: float = 0.0,
                  tokenizer: str = "byte",
                  steps_per_call: int = 1,
+                 pipeline_depth: int = 2,
+                 logprob_topk: int = 5,
                  mesh: Optional[Dict[str, int]] = None,
                  **_ignored):
         self.architecture = architecture
@@ -133,6 +276,10 @@ class GenerativeConfig:
         # per-slot tokens/s by up to K (streaming granularity becomes
         # K tokens; at most K-1 wasted steps past an EOS).
         self.steps_per_call = int(steps_per_call)
+        # Decode waves in flight (>=2 hides the dispatch RTT behind
+        # device compute; 1 = strictly blocking, the A/B baseline).
+        self.pipeline_depth = int(pipeline_depth)
+        self.logprob_topk = int(logprob_topk)
         self.mesh = mesh or {}
 
     @classmethod
@@ -210,6 +357,8 @@ class GenerativeModel(Model):
             prefill_buckets=cfg.prefill_buckets,
             eos_id=getattr(self.tokenizer, "eos_id", None),
             steps_per_call=cfg.steps_per_call,
+            pipeline_depth=cfg.pipeline_depth,
+            logprob_topk=cfg.logprob_topk,
             mesh=mesh, name=self.name)
         if self.hbm is not None:
             # Generation residency = params + the slot cache pool.
@@ -237,35 +386,78 @@ class GenerativeModel(Model):
     def _parse_instance(self, inst: Any) -> Dict[str, Any]:
         cfg = self.config
         if isinstance(inst, str):
-            return {"prompt": inst, "max_tokens": cfg.max_new_tokens,
-                    "temperature": cfg.temperature}
-        if isinstance(inst, dict):
-            if "prompt" not in inst and "text_input" not in inst:
-                raise InvalidInput(
-                    "generate instance needs 'prompt' (or 'text_input')")
-            return {
-                "prompt": str(inst.get("prompt",
-                                       inst.get("text_input"))),
-                "max_tokens": int(inst.get("max_tokens",
-                                           inst.get("max_new_tokens",
-                                                    cfg.max_new_tokens))),
-                "temperature": float(inst.get("temperature",
-                                              cfg.temperature)),
-            }
-        raise InvalidInput(
-            f"generate instance must be a string or object, got "
-            f"{type(inst).__name__}")
+            inst = {"prompt": inst}
+        if not isinstance(inst, dict):
+            raise InvalidInput(
+                f"generate instance must be a string or object, got "
+                f"{type(inst).__name__}")
+        if "prompt" not in inst and "text_input" not in inst:
+            raise InvalidInput(
+                "generate instance needs 'prompt' (or 'text_input')")
+        stop = inst.get("stop", [])
+        if isinstance(stop, str):
+            stop = [stop]
+        if not (isinstance(stop, list)
+                and all(isinstance(s, str) and s for s in stop)):
+            raise InvalidInput(
+                "stop must be a non-empty string or a list of them")
+        seed = inst.get("seed")
+        logprobs = inst.get("logprobs", 0)
+        if logprobs is True:
+            logprobs = 1
+        return {
+            "prompt": str(inst.get("prompt", inst.get("text_input"))),
+            "max_tokens": int(inst.get("max_tokens",
+                                       inst.get("max_new_tokens",
+                                                cfg.max_new_tokens))),
+            "temperature": float(inst.get("temperature",
+                                          cfg.temperature)),
+            "top_k": int(inst.get("top_k", 0)),
+            "top_p": float(inst.get("top_p", 1.0)),
+            "seed": None if seed is None else int(seed),
+            "stop": stop,
+            "logprobs": int(logprobs),
+        }
+
+    def _submit(self, parsed: Dict[str, Any]):
+        ids = self.tokenizer.encode(parsed["prompt"])
+        return self.engine.submit(
+            ids, max_new_tokens=parsed["max_tokens"],
+            temperature=parsed["temperature"],
+            top_k=parsed["top_k"], top_p=parsed["top_p"],
+            seed=parsed["seed"], logprobs=parsed["logprobs"])
 
     async def _run_one(self, parsed: Dict[str, Any]) -> Dict[str, Any]:
-        ids = self.tokenizer.encode(parsed["prompt"])
-        tokens, reason = await self.engine.complete(
-            ids, max_new_tokens=parsed["max_tokens"],
-            temperature=parsed["temperature"])
-        return {
-            "text": self.tokenizer.decode(tokens),
-            "token_count": len(tokens),
-            "finish_reason": reason,
-        }
+        req = self._submit(parsed)
+        decoder = IncrementalDecoder(self.tokenizer, parsed["stop"])
+        tokens: List[int] = []
+        reason = "length"
+        async for token, fin in self.engine.stream(req):
+            if token is not None:
+                tokens.append(token)
+                _, stopped = decoder.push(token)
+                if stopped:
+                    # Stop sequences live in TEXT space (the tokenizer
+                    # may split one across tokens); the match runs
+                    # host-side on the decoded window and the engine
+                    # slot is cancelled the moment it lands.
+                    self.engine.cancel(req)
+                    return self._result(req, decoder.text(), tokens,
+                                        "stop", parsed)
+            if fin is not None:
+                reason = fin
+        decoder.finish()
+        text = (self.tokenizer.decode(tokens) if decoder.degraded
+                else decoder.text())
+        return self._result(req, text, tokens, reason, parsed)
+
+    def _result(self, req, text: str, tokens: List[int], reason: str,
+                parsed: Dict[str, Any]) -> Dict[str, Any]:
+        out = {"text": text, "token_count": len(tokens),
+               "finish_reason": reason}
+        if parsed["logprobs"] > 0:
+            out["logprobs"] = _lp_payload(req, tokens)
+        return out
 
     # -- serving entry points ----------------------------------------------
     async def predict(self, request: Any) -> Any:
@@ -300,9 +492,12 @@ class GenerativeModel(Model):
             raise InferenceError(f"model {self.name} not loaded")
         parsed = self._parse_generate_body(request)
         result = await self._run_one(parsed)
+        details = {"token_count": result["token_count"],
+                   "finish_reason": result["finish_reason"]}
+        if "logprobs" in result:
+            details["logprobs"] = result["logprobs"]
         return {"model_name": self.name, "text_output": result["text"],
-                "details": {"token_count": result["token_count"],
-                            "finish_reason": result["finish_reason"]}}
+                "details": details}
 
     def _parse_generate_body(self, request: Any) -> Dict[str, Any]:
         if isinstance(request, dict) and (
@@ -327,29 +522,59 @@ class GenerativeModel(Model):
         if self.engine is None:
             raise InferenceError(f"model {self.name} not loaded")
         parsed = self._parse_generate_body(request)
-        ids = self.tokenizer.encode(parsed["prompt"])
-        req = self.engine.submit(
-            ids, max_new_tokens=parsed["max_tokens"],
-            temperature=parsed["temperature"])
+        req = self._submit(parsed)
+        stops = parsed["stop"]
+        want_lp = parsed["logprobs"] > 0
 
         finished = False
 
         async def events():
             nonlocal finished
             collected: List[int] = []
+            decoder = IncrementalDecoder(self.tokenizer, stops)
+
+            def token_event(token, text_delta):
+                event = {"token": {"id": int(token),
+                                   "text": text_delta}}
+                if want_lp and len(collected) <= len(req.lp_chosen):
+                    i = len(collected) - 1
+                    event["token"]["logprob"] = req.lp_chosen[i]
+                    event["token"]["top_logprobs"] = [
+                        {"id": t, "logprob": p}
+                        for t, p in req.lp_top[i]]
+                return event
+
             async for token, reason in self.engine.stream(req):
                 if token is not None:
                     collected.append(token)
-                    event = {"token": {"id": int(token),
-                                       "text": self.tokenizer.decode(
-                                           [token])}}
+                    delta, stopped = decoder.push(token)
+                    if stopped:
+                        # Truncate at the match; never emit the stop
+                        # text itself.
+                        self.engine.cancel(req)
+                        finished = True
+                        event = token_event(token, delta)
+                        event["finish_reason"] = "stop"
+                        event["generated_text"] = decoder.text()
+                        event["details"] = {
+                            "token_count": len(collected)}
+                        yield event
+                        return
+                    event = token_event(token, delta)
                 else:
                     event = {}
                 if reason is not None:
                     finished = True
+                    # Flush anything held back: no stop matched.
+                    tail = decoder.finish()
+                    if tail:
+                        tok = event.setdefault(
+                            "token", {"id": None, "text": ""})
+                        tok["text"] += tail
+                    full = (self.tokenizer.decode(collected)
+                            if decoder.degraded else decoder.text())
                     event["finish_reason"] = reason
-                    event["generated_text"] = self.tokenizer.decode(
-                        collected)
+                    event["generated_text"] = full
                     event["details"] = {"token_count": len(collected)}
                 yield event
 

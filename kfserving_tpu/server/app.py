@@ -31,6 +31,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import signal
 import time
 from typing import Any, Dict, List, Optional
@@ -592,6 +593,32 @@ class ModelServer:
             await self.grpc_server.start()
             self.grpc_port = self.grpc_server.port
 
+    async def drain(self, budget_s: float) -> bool:
+        """Wait for in-flight work — including live token streams,
+        the longest-lived requests in the system — to finish, up to
+        `budget_s`.  Returns True when fully drained.  Past the
+        budget, stop_async() closes the engines, which delivers a
+        terminal error event to every still-open stream (clients see
+        a clean end-of-stream, not a dead socket) — the recycle
+        contract for generative replicas."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + budget_s
+        while loop.time() < deadline:
+            busy = (self._admission is not None
+                    and self._admission.active > 0)
+            if not busy:
+                for m in self.repository.get_models():
+                    eng = getattr(m, "engine", None)
+                    if eng is not None and (
+                            eng._pending
+                            or any(s is not None for s in eng._slots)):
+                        busy = True
+                        break
+            if not busy:
+                return True
+            await asyncio.sleep(0.1)
+        return False
+
     async def stop_async(self) -> None:
         if self.grpc_server is not None:
             await self.grpc_server.stop()
@@ -616,6 +643,15 @@ class ModelServer:
                 except NotImplementedError:
                     pass
             await stop.wait()
+            # SIGTERM drain: let in-flight work (streams included)
+            # finish inside the orchestrator's kill grace before the
+            # engines close.  Default stays UNDER the orchestrator's
+            # TERM_GRACE_S (10 s SIGKILL escalation): past this budget
+            # streams get the engines' terminal error event, not the
+            # SIGKILL dead socket.
+            grace = float(os.environ.get("KFS_DRAIN_GRACE_S", "8"))
+            if grace > 0:
+                await self.drain(grace)
             await self.stop_async()
 
         logging.basicConfig(level=logging.INFO)

@@ -216,6 +216,82 @@ class GRPCServer:
             _output_to_tensor(out, response, use_raw)
         return response
 
+    # -- generation service (kfs_generate.proto — framework extension,
+    # kept separate from the faithful V2 surface) ------------------------
+    @staticmethod
+    def _generate_body(request) -> Dict[str, Any]:
+        """Proto -> the HTTP generate body shape; `optional` fields
+        only override the model's config defaults when present."""
+        params: Dict[str, Any] = {}
+        for field in ("max_tokens", "temperature", "top_k", "top_p",
+                      "seed", "logprobs"):
+            if request.HasField(field):
+                params[field] = getattr(request, field)
+        if request.stop:
+            params["stop"] = list(request.stop)
+        return {"text_input": request.text_input,
+                "parameters": params}
+
+    async def Generate(self, request, context):
+        from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
+
+        try:
+            result = await self.dataplane.generate(
+                request.model_name, self._generate_body(request))
+        except Exception as e:
+            await self._abort(context, e)
+        details = result.get("details", {})
+        resp = gpb.GenerateResponse(
+            model_name=result.get("model_name", request.model_name),
+            text_output=result.get("text_output", ""),
+            finish_reason=details.get("finish_reason", ""),
+            token_count=details.get("token_count", 0))
+        for rec in details.get("logprobs", []) or []:
+            resp.chosen_logprobs.add(id=rec["id"],
+                                     logprob=rec["logprob"])
+        return resp
+
+    async def GenerateStream(self, request, context):
+        """Server-streaming tokens over HTTP/2 framing: each yielded
+        message is one SSE-event equivalent.  The request validates
+        before the first message (gRPC has no committed-headers
+        problem, but a clean INVALID_ARGUMENT beats an error mid-
+        stream); consumer cancellation propagates to the engine via
+        the event stream's close hook."""
+        from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
+        from kfserving_tpu.streams import aclose_quietly
+
+        try:
+            events = await self.dataplane.generate_stream(
+                request.model_name, self._generate_body(request))
+        except Exception as e:
+            await self._abort(context, e)
+        try:
+            async for event in events:
+                msg = gpb.GenerateStreamResponse()
+                tok = event.get("token")
+                if tok is not None:
+                    msg.token.id = (-1 if tok.get("id") is None
+                                    else int(tok["id"]))
+                    msg.token.text = tok.get("text", "")
+                    if "logprob" in tok:
+                        msg.token.logprob = float(tok["logprob"])
+                    for rec in tok.get("top_logprobs", []):
+                        msg.token.top_logprobs.add(
+                            id=rec["id"], logprob=rec["logprob"])
+                if event.get("finish_reason"):
+                    msg.finish_reason = event["finish_reason"]
+                    msg.generated_text = event.get(
+                        "generated_text", "")
+                    msg.token_count = event.get(
+                        "details", {}).get("token_count", 0)
+                yield msg
+        finally:
+            # gRPC cancellation (client went away) lands here as a
+            # GeneratorExit — close the event stream so the engine
+            # frees the decode slot.
+            await aclose_quietly(events, "grpc generate stream")
+
     async def RepositoryIndex(self, request, context):
         resp = pb2.RepositoryIndexResponse()
         for entry in self.dataplane.repository_index():
@@ -271,6 +347,22 @@ class GRPCServer:
                                     pb2.ModelInferRequest,
                                     pb2.ModelInferResponse),
             })
+        from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
+
+        generation = grpc.method_handlers_generic_handler(
+            "kfserving.generate.GenerationService", {
+                "Generate": unary(self.Generate,
+                                  gpb.GenerateRequest,
+                                  gpb.GenerateResponse),
+                "GenerateStream":
+                    grpc.unary_stream_rpc_method_handler(
+                        self.GenerateStream,
+                        request_deserializer=(
+                            gpb.GenerateRequest.FromString),
+                        response_serializer=(
+                            gpb.GenerateStreamResponse
+                            .SerializeToString)),
+            })
         repository = grpc.method_handlers_generic_handler(
             "inference.ModelRepositoryService", {
                 "RepositoryIndex": unary(
@@ -286,7 +378,7 @@ class GRPCServer:
                     pb2.RepositoryModelUnloadRequest,
                     pb2.RepositoryModelUnloadResponse),
             })
-        return [inference, repository]
+        return [inference, generation, repository]
 
     async def start(self) -> None:
         import grpc.aio

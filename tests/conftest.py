@@ -34,6 +34,11 @@ def pytest_configure(config):
         "markers", "asyncio: run the test inside a fresh asyncio event loop")
     config.addinivalue_line(
         "markers", "tpu: requires real TPU hardware (skipped on CPU backend)")
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / subprocess / long-parity tests.  CI "
+        "default: `pytest -m 'not slow'` (~3 min hermetic core); "
+        "nightly/full: `pytest tests/` (everything)")
 
 
 @pytest.hookimpl(tryfirst=True)

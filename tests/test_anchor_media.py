@@ -210,6 +210,7 @@ def test_media_anchor_config_artifact(tmp_path):
 # ------------------------------------------------------------- serving
 
 
+@pytest.mark.slow
 async def test_served_anchor_text_through_control_plane(tmp_path):
     """ExplainerSpec(explainer_type=anchor_text) deploys through the
     controller next to an sklearn text-pipeline predictor and serves

@@ -161,6 +161,7 @@ async def test_served_anchor_explainer_proxies_predictor(tmp_path, iris):
         await pred_server.stop_async()
 
 
+@pytest.mark.slow
 async def test_anchor_explainer_through_control_plane(tmp_path, iris):
     """ExplainerSpec(explainer_type=anchor_tabular) deploys through the
     controller and serves :explain via the router's verb split."""

@@ -230,6 +230,7 @@ async def test_cli_against_manager(tmp_path, capsys):
 
 
 # -- subprocess orchestrator -------------------------------------------------
+@pytest.mark.slow
 async def test_subprocess_replica_serves_and_dies(tmp_path):
     """A replica is a real OS process: spawn, serve parity predictions,
     terminate (VERDICT weak #8: replica parallelism must be real)."""
@@ -258,6 +259,7 @@ async def test_subprocess_replica_serves_and_dies(tmp_path):
     assert replica.handle.process.returncode is not None
 
 
+@pytest.mark.slow
 async def test_manager_with_subprocess_backend(tmp_path):
     """Two-terminal demo as a test: serve fabric (subprocess replicas),
     apply spec, predict through ingress (VERDICT next-round #6)."""
@@ -324,6 +326,7 @@ async def test_client_binary_predict(tmp_path):
         await manager.stop_async()
 
 
+@pytest.mark.slow
 async def test_subprocess_recycle_on_request_count(tmp_path):
     """A replica crossing max_requests is drain-replaced: new process,
     new port, old process dead, traffic keeps succeeding (VERDICT r2
@@ -375,6 +378,7 @@ async def test_subprocess_recycle_on_request_count(tmp_path):
         await orch.shutdown()
 
 
+@pytest.mark.slow
 async def test_subprocess_recycle_standby_fast_swap(tmp_path):
     """Chip-owner recycle (overlap=False, jax framework) takes the
     STANDBY path: the successor boots with imports/artifact done while
@@ -478,6 +482,7 @@ async def test_router_buffer_deadline_sheds_503(tmp_path):
         await orch.shutdown()
 
 
+@pytest.mark.slow
 async def test_subprocess_recycle_rss_threshold_counts(tmp_path):
     """RSS watchdog path: an absurdly low threshold recycles on the
     first check; the successor is exempt until it crosses too (no
@@ -509,6 +514,7 @@ async def test_subprocess_recycle_rss_threshold_counts(tmp_path):
         await orch.shutdown()
 
 
+@pytest.mark.slow
 async def test_subprocess_recycle_min_age_prevents_thrash(tmp_path):
     """A threshold below baseline RSS must NOT spin a kill/spawn loop:
     successors younger than min_age_s are exempt (review r3)."""
@@ -573,6 +579,7 @@ async def test_recycle_drain_window_counts_as_pending_create():
     assert orch.recycle_count == 1
 
 
+@pytest.mark.slow
 async def test_replica_crash_failover_and_respawn(tmp_path):
     """Chaos: SIGKILL a live subprocess replica under concurrent load.
     The router must evict it and fail over (no client sees the crash as

@@ -23,6 +23,7 @@ def _small_hf_bert_config():
         hidden_act="gelu", layer_norm_eps=1e-12)
 
 
+@pytest.mark.slow
 def test_bert_conversion_logit_parity():
     import jax.numpy as jnp
     from transformers import BertForMaskedLM
@@ -75,6 +76,7 @@ def test_bert_conversion_respects_attention_mask():
                                rtol=1e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_resnet50_conversion_logit_parity():
     import jax.numpy as jnp
     from transformers import ResNetConfig, ResNetForImageClassification
@@ -98,6 +100,7 @@ def test_resnet50_conversion_logit_parity():
     np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_converted_dir_serves(tmp_path):
     """End to end: convert -> model dir -> JaxModel.load -> predict."""
     from transformers import BertForMaskedLM

@@ -327,6 +327,7 @@ def test_download_azure_with_mock(tmp_path, fake_azure):
 
 
 # -- wiring into orchestration ----------------------------------------------
+@pytest.mark.slow
 async def test_subprocess_orchestrator_injects_credential_env(tmp_path):
     """The spawned replica's environment carries the service account's
     credential env (reference agent/storage-initializer env injection)."""

@@ -77,6 +77,7 @@ def test_prefill_logits_match_full_forward(tiny):
     assert caches[0][0].shape == (1, 16, 2, 32)
 
 
+@pytest.mark.slow
 async def test_engine_greedy_matches_full_recompute(tiny):
     """THE cache-correctness criterion: incremental decode through the
     slot cache reproduces full-recompute greedy token-for-token."""
@@ -319,17 +320,17 @@ async def test_decode_failure_fails_all_inflight(tiny):
 
     eng = make_engine(tiny, max_slots=2)
     try:
-        orig = eng._do_decode_step
+        orig = eng._fetch_wave
 
-        def boom():
+        def boom(toks_h, lp_h):
             raise RuntimeError("synthetic XLA failure")
 
-        eng._do_decode_step = boom
+        eng._fetch_wave = boom
         with pytest.raises(InferenceError, match="generation failed"):
             await asyncio.wait_for(
                 eng.complete([1, 2, 3], max_new_tokens=8), timeout=10)
         # The engine recovers for new work once the fault clears.
-        eng._do_decode_step = orig
+        eng._fetch_wave = orig
         tokens, reason = await asyncio.wait_for(
             eng.complete([1, 2, 3], max_new_tokens=4), timeout=30)
         assert len(tokens) == 4
@@ -559,5 +560,218 @@ async def test_cancel_during_prefill_delivers_terminal_event(tiny):
         eng._do_prefill_group = orig
         got, reason = await eng.complete([4, 5], max_new_tokens=2)
         assert len(got) == 2 and reason == "length"
+    finally:
+        await eng.close()
+
+
+# ------------------------------------------------------ sampling surface
+
+
+async def test_top_k_1_equals_greedy(tiny):
+    """top_k=1 collapses sampling to argmax regardless of temperature."""
+    module, variables, _ = tiny
+    prompt = [5, 9, 2, 7]
+    want = ref_greedy(module, variables, prompt, 8)
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        got, _ = await eng.complete(prompt, max_new_tokens=8,
+                                    temperature=1.0, top_k=1)
+    finally:
+        await eng.close()
+    assert got == want
+
+
+async def test_top_p_tiny_equals_greedy(tiny):
+    """top_p -> 0 keeps only the most-likely token (n_keep clamps to
+    1), so sampling equals greedy."""
+    module, variables, _ = tiny
+    prompt = [3, 1, 4, 1, 5]
+    want = ref_greedy(module, variables, prompt, 6)
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        got, _ = await eng.complete(prompt, max_new_tokens=6,
+                                    temperature=1.5, top_p=1e-6)
+    finally:
+        await eng.close()
+    assert got == want
+
+
+async def test_top_k_and_top_p_restrict_support(tiny):
+    """Every sampled token lies inside the declared support: top-k's
+    k best ids, and top-p's nucleus (smallest prefix of the sorted
+    distribution reaching mass p) — membership implies the
+    monotonicity of nested supports."""
+    import jax.nn
+
+    module, variables, _ = tiny
+    prompt = [7, 2, 9]
+    logits = np.asarray(module.apply(
+        variables, jnp.asarray([prompt], jnp.int32))[0, -1],
+        np.float32)
+    order = np.argsort(-logits)
+    top2 = set(int(t) for t in order[:2])
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits)))
+    cum = np.cumsum(probs[order])
+    n_keep = int(np.searchsorted(cum, 0.6) + 1)
+    nucleus = set(int(t) for t in order[:n_keep])
+
+    eng = make_engine(tiny, max_slots=4)
+    try:
+        for seed in range(16):
+            got_k, _ = await eng.complete(prompt, max_new_tokens=1,
+                                          temperature=2.0, top_k=2,
+                                          seed=seed)
+            assert got_k[0] in top2, (got_k, top2)
+            got_p, _ = await eng.complete(prompt, max_new_tokens=1,
+                                          temperature=2.0, top_p=0.6,
+                                          seed=seed)
+            assert got_p[0] in nucleus, (got_p, nucleus)
+    finally:
+        await eng.close()
+
+
+async def test_seed_reproduces_regardless_of_scheduling(tiny):
+    """A seeded temperature request reproduces exactly — solo or
+    sharing decode waves with other requests (noise is keyed on
+    (seed, absolute position), never on slot or wave identity)."""
+    module, variables, _ = tiny
+    prompt = [5, 9, 2, 7, 1]
+    eng = make_engine(tiny, max_slots=4)
+    try:
+        solo, _ = await eng.complete(prompt, max_new_tokens=10,
+                                     temperature=1.0, seed=42)
+        # Same seed, this time racing two other requests.
+        results = await asyncio.gather(
+            eng.complete(prompt, max_new_tokens=10,
+                         temperature=1.0, seed=42),
+            eng.complete([1, 2, 3], max_new_tokens=10,
+                         temperature=0.9, seed=7),
+            eng.complete([9, 9], max_new_tokens=10,
+                         temperature=1.3))
+        other, _ = await eng.complete(prompt, max_new_tokens=10,
+                                      temperature=1.0, seed=43)
+    finally:
+        await eng.close()
+    assert results[0][0] == solo
+    assert other != solo  # different seed diverges (overwhelmingly)
+
+
+async def test_default_seeds_vary_across_requests(tiny):
+    """Unseeded temperature requests must differ from each other (the
+    old per-dispatch rng gave every slot different noise; the
+    per-request counter must preserve that)."""
+    eng = make_engine(tiny, max_slots=2, rng_seed=0)
+    prompt = [5, 9, 2]
+    try:
+        a, _ = await eng.complete(prompt, max_new_tokens=12,
+                                  temperature=1.2)
+        b, _ = await eng.complete(prompt, max_new_tokens=12,
+                                  temperature=1.2)
+    finally:
+        await eng.close()
+    assert a != b
+
+
+async def test_logprobs_match_full_forward(tiny):
+    """Chosen-token logprobs come from the unmasked log-softmax; top-N
+    ids/values match the reference full forward at every step."""
+    import jax.nn
+
+    module, variables, _ = tiny
+    prompt = [5, 9, 2, 7, 11]
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        req = eng.submit(prompt, max_new_tokens=6, logprobs=3)
+        tokens = []
+        async for t, fin in eng.stream(req):
+            if t is not None:
+                tokens.append(t)
+    finally:
+        await eng.close()
+    assert len(req.lp_chosen) == len(tokens) == 6
+    ids = [int(t) for t in prompt]
+    for step, tok in enumerate(tokens):
+        logits = module.apply(variables, jnp.asarray([ids], jnp.int32))
+        lps = np.asarray(jax.nn.log_softmax(logits[0, -1]), np.float32)
+        assert tok == int(np.argmax(lps))  # greedy
+        np.testing.assert_allclose(req.lp_chosen[step], lps[tok],
+                                   rtol=2e-3, atol=2e-3)
+        want_top = np.argsort(-lps)[:3]
+        got_top = [t for t, _ in req.lp_top[step]]
+        assert got_top == [int(x) for x in want_top]
+        ids.append(tok)
+
+
+async def test_sampling_validation(tiny):
+    eng = make_engine(tiny, max_slots=1)
+    try:
+        with pytest.raises(InvalidInput):
+            eng.submit([1], top_p=0.0)
+        with pytest.raises(InvalidInput):
+            eng.submit([1], top_p=1.5)
+        with pytest.raises(InvalidInput):
+            eng.submit([1], top_k=-1)
+        with pytest.raises(InvalidInput):
+            eng.submit([1], logprobs=99)
+    finally:
+        await eng.close()
+
+
+# ------------------------------------------------------ pipelined decode
+
+
+async def test_pipeline_depth_parity(tiny):
+    """Token-for-token parity across pipeline depths: the device-
+    resident feed chain (depth>=2, fetch of wave N overlapping wave
+    N+1) must produce exactly the blocking path's output — greedy AND
+    seeded temperature."""
+    module, variables, _ = tiny
+    prompts = [[5, 9, 2, 7], [1, 3], [8, 8, 8, 1, 2]]
+    results = {}
+    for depth in (1, 3):
+        eng = make_engine(tiny, max_slots=4, pipeline_depth=depth,
+                          steps_per_call=2)
+        try:
+            outs = await asyncio.gather(*[
+                eng.complete(p, max_new_tokens=9) for p in prompts])
+            seeded, _ = await eng.complete([4, 2], max_new_tokens=9,
+                                           temperature=1.1, seed=77)
+        finally:
+            await eng.close()
+        results[depth] = ([t for t, _ in outs], seeded)
+    assert results[1] == results[3]
+    # and the greedy outputs equal the no-cache recompute
+    for p, got in zip(prompts, results[1][0]):
+        assert got == ref_greedy(module, variables, p, 9)
+
+
+async def test_pipeline_waste_accounting(tiny):
+    """A finishing slot wastes at most (depth-1)*K + K-1 garbage steps
+    per request; the engine must count them honestly."""
+    eng = make_engine(tiny, max_slots=1, pipeline_depth=2,
+                      steps_per_call=4)
+    try:
+        await eng.complete([1, 2, 3], max_new_tokens=2)
+        # Budget 2 with K=4: >=2 wasted in the finishing wave, plus
+        # the in-flight next wave's 4.
+        stats = eng.stats()
+        assert stats["wasted_token_steps"] >= 2
+        assert stats["pipeline_depth"] == 2
+        # Correctness after waste: a second request still matches.
+        module, variables, _ = (eng.module, eng.variables, None)
+        want = ref_greedy(module, variables, [7, 7], 5)
+        got, _ = await eng.complete([7, 7], max_new_tokens=5)
+        assert got == want
+    finally:
+        await eng.close()
+
+
+async def test_pipeline_decode_wait_tracked(tiny):
+    eng = make_engine(tiny, max_slots=1, pipeline_depth=2)
+    try:
+        await eng.complete([1, 2], max_new_tokens=4)
+        stats = eng.stats()
+        assert stats["decode_wait_s"] >= 0.0
+        assert stats["decode_steps"] >= 4
     finally:
         await eng.close()

@@ -4,10 +4,14 @@ the virtual CPU mesh, and the mesh factorization must use every device."""
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 import __graft_entry__ as graft  # noqa: E402
+
+pytestmark = pytest.mark.slow
 
 
 def test_factor_mesh_uses_all_devices():

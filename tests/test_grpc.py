@@ -280,3 +280,107 @@ async def test_grpc_raw_bytes_length_prefixed():
         (l2,) = struct.unpack_from("<I", raw, 4 + l1)
         second = raw[8 + l1:8 + l1 + l2]
         assert first == b"hello" and second == b"wo"
+
+
+# ------------------------------------------------ generation service
+
+
+def _write_gen_dir(tmp_path, **overrides):
+    model_dir = os.path.join(str(tmp_path), "gen")
+    os.makedirs(model_dir, exist_ok=True)
+    cfg = {
+        "architecture": "decoder_tiny",
+        "arch_kwargs": {"num_layers": 2, "hidden_size": 64,
+                        "num_heads": 2, "intermediate_size": 128,
+                        "max_seq": 64},
+        "max_slots": 2, "max_seq": 64,
+        "prefill_buckets": [16, 32, 64],
+        "max_new_tokens": 8, "tokenizer": "byte",
+    }
+    cfg.update(overrides)
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    return model_dir
+
+
+async def test_grpc_generate_unary_matches_http_shape(tmp_path):
+    """Unary Generate over the framework's GenerationService proto
+    (kept separate from the faithful V2 file) matches the HTTP
+    :generate result."""
+    from kfserving_tpu.predictors.llm import GenerativeModel
+    from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
+
+    model = GenerativeModel("gen", _write_gen_dir(tmp_path))
+    model.load()
+    async with grpc_server([model]) as (server, channel):
+        http_result = await model.generate(
+            {"text_input": "abc", "parameters": {"max_tokens": 5}})
+        call = _method(channel, "Generate", gpb.GenerateRequest,
+                       gpb.GenerateResponse,
+                       service="kfserving.generate.GenerationService")
+        resp = await call(gpb.GenerateRequest(
+            model_name="gen", text_input="abc", max_tokens=5))
+        assert resp.text_output == http_result["text_output"]
+        assert resp.finish_reason == \
+            http_result["details"]["finish_reason"]
+        assert resp.token_count == \
+            http_result["details"]["token_count"]
+
+
+async def test_grpc_generate_stream_parity_and_logprobs(tmp_path):
+    """Server-streaming tokens: per-message deltas concatenate to the
+    unary result, terminal message carries finish_reason, and
+    requested logprobs ride each token message."""
+    from kfserving_tpu.predictors.llm import GenerativeModel
+    from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
+
+    model = GenerativeModel("gen", _write_gen_dir(tmp_path))
+    model.load()
+    async with grpc_server([model]) as (server, channel):
+        unary = _method(channel, "Generate", gpb.GenerateRequest,
+                        gpb.GenerateResponse,
+                        service="kfserving.generate.GenerationService")
+        want = (await unary(gpb.GenerateRequest(
+            model_name="gen", text_input="abc",
+            max_tokens=6))).text_output
+        stream = channel.unary_stream(
+            "/kfserving.generate.GenerationService/GenerateStream",
+            request_serializer=gpb.GenerateRequest.SerializeToString,
+            response_deserializer=(
+                gpb.GenerateStreamResponse.FromString))
+        messages = [m async for m in stream(gpb.GenerateRequest(
+            model_name="gen", text_input="abc", max_tokens=6,
+            logprobs=2))]
+        assert len(messages) >= 2
+        text = "".join(m.token.text for m in messages
+                       if m.HasField("token"))
+        assert text == want
+        final = messages[-1]
+        assert final.finish_reason in ("eos", "length")
+        assert final.generated_text == want
+        for m in messages:
+            if m.HasField("token") and m.token.id >= 0:
+                assert m.token.HasField("logprob")
+                assert len(m.token.top_logprobs) == 2
+                assert m.token.logprob <= 0.0
+
+
+async def test_grpc_generate_invalid_argument(tmp_path):
+    from kfserving_tpu.predictors.llm import GenerativeModel
+    from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
+
+    model = GenerativeModel("gen", _write_gen_dir(tmp_path))
+    model.load()
+    async with grpc_server([model]) as (server, channel):
+        call = _method(channel, "Generate", gpb.GenerateRequest,
+                       gpb.GenerateResponse,
+                       service="kfserving.generate.GenerationService")
+        with pytest.raises(grpc.aio.AioRpcError) as err:
+            await call(gpb.GenerateRequest(
+                model_name="gen", text_input="x", top_p=5.0))
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # Unknown model -> NOT_FOUND
+        with pytest.raises(grpc.aio.AioRpcError) as err:
+            await call(gpb.GenerateRequest(
+                model_name="nope", text_input="x"))
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND

@@ -181,6 +181,7 @@ def test_explainer_spec_factory_wiring():
     assert isinstance(m, AdversarialRobustness)
 
 
+@pytest.mark.slow
 async def test_square_attack_through_control_plane(tmp_path):
     """ExplainerSpec(square_attack) deploys through the controller and
     serves :explain via the router verb split, proxying predicts to a
@@ -275,6 +276,7 @@ async def test_square_attack_high_label_never_observed():
     assert out["success"]
 
 
+@pytest.mark.slow
 async def test_subprocess_explainer_replica(tmp_path):
     """ExplainerSpec without a custom command runs as a real subprocess
     replica (`python -m kfserving_tpu.explainers`), finding the

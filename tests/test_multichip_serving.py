@@ -26,6 +26,8 @@ import numpy as np
 import pytest
 
 
+
+pytestmark = pytest.mark.slow
 def _write_model_dir(tmp_path, mesh=None, name="m"):
     d = tmp_path / name
     d.mkdir()

@@ -19,6 +19,8 @@ import sys
 import numpy as np
 import pytest
 
+
+pytestmark = pytest.mark.slow
 WORKER = r'''
 import json, os, sys
 import numpy as np
