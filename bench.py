@@ -131,7 +131,12 @@ def _compact_configs(results: dict) -> dict:
             c["xla_over_flash_sync"] = r.get("xla_over_flash_sync")
         elif name == "generate":
             c.update(pick(r, "tokens_per_s", "token_p50_ms",
-                          "token_p99_ms", "slot_occupancy"))
+                          "token_p99_ms", "slot_occupancy",
+                          "depth_speedup"))
+        elif name == "generate_poisson":
+            c.update(pick(r, "tokens_per_s", "chunk_gap_p50_ms",
+                          "chunk_gap_p99_ms", "p99_over_p50",
+                          "ttft_p50_ms"))
         elif name == "multimodel":
             c.update(pick(r, "load_all_s", "swap_cycle_ms",
                           "round_robin_req_per_s"))
@@ -162,6 +167,7 @@ def main():
         "overload": C.bench_overload,
         "bert_flash_ab": C.bench_bert_flash_ab,
         "generate": C.bench_generate,
+        "generate_poisson": C.bench_generate_poisson,
     }
     results = {}
     for name, fn in matrix.items():

@@ -306,9 +306,15 @@ class IngressRouter:
             self.inflight[gauge_cid] -= 1
             upstream.close()
 
+        from kfserving_tpu.tracing import REQUEST_ID_HEADER
+
+        # Same response-header policy as the buffered path: trace-id
+        # correlation must survive on the flagship streaming verb.
         headers = {
             k: v for k, v in upstream.headers.items()
-            if k.lower() in ("content-type",)
+            if k.lower() in ("content-type",
+                             "inference-header-content-length",
+                             REQUEST_ID_HEADER)
             or k.lower().startswith("ce-")}
         return StreamingResponse(GuardedStream(chunks(), on_close),
                                  status=upstream.status,

@@ -403,6 +403,20 @@ class SubprocessOrchestrator:
                 await asyncio.sleep(0.1)
 
     # -- recycling ----------------------------------------------------------
+    async def _startup_phases(self, host: str) -> Dict[str, float]:
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=2.0)) as session:
+                async with session.get(
+                        f"http://{host}/startup_phases") as resp:
+                    if resp.status == 200:
+                        return await resp.json()
+        except Exception:
+            logger.debug("startup phases scrape of %s failed", host)
+        return {}
+
     async def _request_count(self, host: str) -> Optional[float]:
         """Best-effort scrape of the replica's request counter (the
         server's Prometheus text endpoint)."""
@@ -530,6 +544,12 @@ class SubprocessOrchestrator:
                 self.swap_breakdown.append({
                     "successor_load_s": round(t0 - t_spawn, 2),
                     "drain_s": round(loop.time() - t0, 2),
+                    # Where the load time went, from the successor's
+                    # own boot marks (interpreter_imports / download /
+                    # init_params / warmup / serving, cumulative
+                    # seconds since process birth).
+                    "successor_phases": await self._startup_phases(
+                        successor.host),
                 })
             elif self._standby_capable(handle.spec):
                 # Fast swap: spawn the successor in STANDBY while the

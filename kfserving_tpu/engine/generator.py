@@ -20,12 +20,21 @@ is the TPU-first design for that:
   compile per bucket) that returns the prompt's k/v for every layer;
   a jitted scatter inserts them into a free slot.  Decode then costs
   O(1) tokens per step.
-- **continuous batching**: new requests are admitted at step
-  boundaries — prefill, insert, then the request's slot joins the next
-  decode step alongside in-flight sequences; finished slots free
-  immediately (EOS or token budget).  The admission policy is
-  prefill-priority: arrivals never wait for the current generation
-  wave to drain (the "continuous" in continuous batching).
+- **continuous batching, fully asynchronous**: admission enqueues
+  prefill + insert + feed-scatter and installs the slot WITHOUT a
+  host sync — prompt ingestion rides the same in-flight pipeline as
+  decode waves, so an admission burst never stalls live streams by a
+  blocking prefill dispatch.  Finished slots free immediately (EOS or
+  token budget).  The admission policy is prefill-priority: arrivals
+  never wait for the current generation wave to drain (the
+  "continuous" in continuous batching).
+- **pipelined decode waves**: feed tokens/positions are device-
+  resident and chain wave-to-wave through the jit's returned carry;
+  the scheduler keeps `pipeline_depth` waves in flight so the D2H
+  fetch of wave N overlaps wave N+1's execution — on a high-RTT
+  transport the wave period drops from RTT + K steps toward
+  max(RTT, K steps).  Stop decisions lag the device by at most
+  depth-1 waves (bounded garbage steps, counted in stats).
 - **on-device sampling**: greedy, temperature (Gumbel trick), top-k
   and top-p (nucleus) per slot — the mask-then-sample runs on device,
   so only the [S] int32 token vector crosses the host boundary per
@@ -337,11 +346,20 @@ class GenerationEngine:
 
         self._insert = jax.jit(insert_fn, donate_argnums=(0,))
 
-        # Single worker: device steps are sequential by design; the
-        # executor keeps them off the asyncio serving loop.
+        # Two single-thread executors with distinct roles: `_executor`
+        # owns blocking D2H fetches (each ~an RTT); `_enqueue_executor`
+        # owns dispatch enqueues (fast post-compile, but the FIRST call
+        # per shape traces + compiles for seconds — that must not
+        # freeze the asyncio loop, and must not queue behind an
+        # in-flight fetch either, or admission would stall on decode).
+        # Device-side ordering comes from the data-dependency chain on
+        # the cache/feed handles, not from host thread order.
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1,
             thread_name_prefix=f"generator-{name}")
+        self._enqueue_executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1,
+            thread_name_prefix=f"generator-enq-{name}")
         self._slots: List[Optional[_Active]] = [None] * self.max_slots
         self._pending: deque = deque()
         self._wakeup: Optional[asyncio.Event] = None
@@ -361,7 +379,8 @@ class GenerationEngine:
         # depth >= 2, so the stat stays <= wall clock).
         self._decode_device_s = 0.0
         self._last_fetch_done = 0.0
-        self._decode_wait_s = 0.0     # host blocked in the D2H fetch
+        self._decode_wait_s = 0.0     # host blocked in decode fetches
+        self._prefill_wait_s = 0.0    # host blocked in prefill fetches
         self._prefill_device_s = 0.0
 
     # -- public API --------------------------------------------------------
@@ -509,15 +528,17 @@ class GenerationEngine:
             except asyncio.CancelledError:
                 pass
         self._executor.shutdown(wait=True)
+        self._enqueue_executor.shutdown(wait=True)
 
     def shutdown_nowait(self):
         """Synchronous best-effort teardown (repository unload runs
         outside async context): stop admitting, let the scheduler task
-        drain, release the worker thread without joining."""
+        drain, release the worker threads without joining."""
         self._closed = True
         if self._wakeup is not None:
             self._wakeup.set()
         self._executor.shutdown(wait=False)
+        self._enqueue_executor.shutdown(wait=False)
 
     def load_gauges(self) -> Dict[str, int]:
         """Instantaneous saturation signal for the autoscaler: a
@@ -550,6 +571,7 @@ class GenerationEngine:
             "cache_bytes": self.cache_bytes(),
             "decode_device_s": round(self._decode_device_s, 4),
             "decode_wait_s": round(self._decode_wait_s, 4),
+            "prefill_wait_s": round(self._prefill_wait_s, 4),
             "prefill_device_s": round(self._prefill_device_s, 4),
         }
 
@@ -604,44 +626,54 @@ class GenerationEngine:
 
     async def _run_inner(self):
         loop = asyncio.get_event_loop()
-        # Waves in flight on the device: (token_handle, lp_handles,
-        # snapshot of _Active refs at enqueue, enqueue wall time).
+        # The in-flight pipeline: decode waves AND prefill batches
+        # share one FIFO of dispatched-but-unfetched device work.
+        # Prefill rides it like any wave — admission enqueues prompt
+        # forward + cache insert + feed scatter and returns WITHOUT a
+        # host sync (the old blocking admission added a full
+        # prefill-dispatch of inter-token stall to every live stream).
+        # Items: ("decode", toks_h, lp_h, snapshot, t0) or
+        # ("prefill", firsts_h, lp_h, entries, t0) where entries is
+        # [(slot, _Active|None)] in batch order.
         inflight: deque = deque()
         while not self._closed:
             admitted = False
             while self._pending and self._free_slot() is not None:
                 group, slots, bucket = self._take_prefill_group()
                 try:
-                    firsts = await loop.run_in_executor(
-                        self._executor, self._do_prefill_group,
+                    firsts_h, lp_h = await loop.run_in_executor(
+                        self._enqueue_executor,
+                        self._enqueue_prefill_group,
                         group, slots, bucket)
                 except Exception as e:
-                    # A prefill failure (e.g. OOM compiling a new
-                    # bucket) fails THAT group; in-flight slots keep
-                    # decoding.
-                    logger.exception("prefill failed")
+                    # An enqueue-time failure (e.g. OOM compiling a
+                    # new bucket) fails THAT group; in-flight slots
+                    # keep decoding.
+                    logger.exception("prefill enqueue failed")
                     for req in group:
                         req.out.put_nowait(
                             (None, f"error: prefill failed: {e}"))
                     continue
-                # Slot bookkeeping and token delivery happen here on
-                # the loop thread: asyncio.Queue is not thread-safe.
-                for req, slot, (first, lp_rec) in zip(group, slots,
-                                                      firsts):
+                # Install slots NOW — the first tokens arrive at fetch
+                # time, but the device feed arrays already carry them,
+                # so the very next decode wave includes these slots.
+                entries = []
+                for req, slot in zip(group, slots):
                     if req.cancelled:
-                        # Cancelled while its prefill was on the
-                        # executor: drop it before it occupies a slot.
-                        # cancel() could not emit the terminal event
-                        # for this request (it was neither pending nor
-                        # active at that moment) — deliver it here or
-                        # a consumer draining stream(req) hangs.
+                        # Cancelled between submit and here: deliver
+                        # the terminal event (cancel() saw it neither
+                        # pending nor active) and never occupy a slot.
                         req.out.put_nowait((None, "cancelled"))
                         self.requests_finished += 1
+                        entries.append((slot, None))
                         continue
-                    self._slots[slot] = _Active(
-                        req=req, length=req.prompt_ids.size,
-                        last_token=first, generated=0)
-                    self._emit(slot, first, lp_rec)
+                    act = _Active(req=req,
+                                  length=req.prompt_ids.size,
+                                  last_token=-1, generated=0)
+                    self._slots[slot] = act
+                    entries.append((slot, act))
+                inflight.append(("prefill", firsts_h, lp_h, entries,
+                                 time.perf_counter()))
                 admitted = True
             active = any(s is not None for s in self._slots)
             if not active and not inflight:
@@ -657,23 +689,66 @@ class GenerationEngine:
                                 s is not None for s in self._slots):
                             return  # idle: let the loop die; resubmit restarts
                 continue
-            # Keep the device pipeline_depth waves deep: wave N+1's
-            # feed tokens are wave N's device outputs — no host round
-            # trip sits between waves, so the fetch of wave N below
-            # overlaps wave N+1's execution.
-            while active and len(inflight) < self.pipeline_depth:
-                inflight.append(self._enqueue_wave())
-            toks_h, lp_h, snapshot, t0 = inflight.popleft()
-            tokens, lp = await loop.run_in_executor(
-                self._executor, self._fetch_wave, toks_h, lp_h)
-            # Union of busy intervals, NOT per-wave spans: at depth>=2
-            # the spans of consecutive waves overlap, and summing them
+            # Keep the device pipeline_depth decode waves deep: wave
+            # N+1's feed tokens are wave N's device outputs — no host
+            # round trip sits between waves, so the fetch of wave N
+            # below overlaps wave N+1's execution.  Prefill items
+            # don't count toward depth (they are admission work riding
+            # the same FIFO).
+            waves = sum(1 for it in inflight if it[0] == "decode")
+            while active and waves < self.pipeline_depth:
+                inflight.append(await loop.run_in_executor(
+                    self._enqueue_executor, self._enqueue_wave))
+                waves += 1
+            kind, out_h, lp_h, meta, t0 = inflight.popleft()
+            try:
+                fetched, lp, wait_s = await loop.run_in_executor(
+                    self._executor, self._fetch_wave, out_h, lp_h)
+            except Exception as e:
+                if kind == "prefill":
+                    # Fail THAT group; in-flight slots keep decoding.
+                    # (If the poisoned cache chain breaks later waves,
+                    # their fetch error still fails everything.)
+                    logger.exception("prefill failed")
+                    for slot, act in meta:
+                        if act is not None and \
+                                self._slots[slot] is act:
+                            self._slots[slot] = None
+                            act.req.out.put_nowait(
+                                (None, f"error: prefill failed: {e}"))
+                    continue
+                raise
+            # Union of busy intervals, NOT per-item spans: at depth>=2
+            # the spans of consecutive items overlap, and summing them
             # would exceed wall clock (making depth A/Bs lie).
             now = time.perf_counter()
-            self._decode_device_s += now - max(t0,
-                                               self._last_fetch_done)
+            busy = now - max(t0, self._last_fetch_done)
             self._last_fetch_done = now
-            self._distribute(tokens, lp, snapshot)
+            if kind == "decode":
+                self._decode_device_s += busy
+                self._decode_wait_s += wait_s
+                self._distribute(fetched, lp, meta)
+            else:
+                self._prefill_device_s += busy
+                self._prefill_wait_s += wait_s
+                self._finish_prefill(fetched, lp, meta)
+
+    def _finish_prefill(self, firsts: np.ndarray, lp, entries):
+        """Deliver a fetched prefill batch's first tokens.  A slot
+        whose _Active was replaced since enqueue (cancel) discards its
+        row, exactly like _distribute."""
+        self.prefills += 1
+        for i, (slot, act) in enumerate(entries):
+            if act is None or self._slots[slot] is not act:
+                continue
+            self.prefill_requests += 1
+            rec = None
+            n_lp = act.req.logprobs
+            if lp is not None and n_lp > 0:
+                rec = (float(lp[0][i]),
+                       [(int(t), float(p)) for t, p in
+                        zip(lp[1][i][:n_lp], lp[2][i][:n_lp])])
+            self._emit(slot, int(firsts[i]), rec)
 
     def _enqueue_wave(self):
         """Dispatch one K-step decode wave (non-blocking: JAX async
@@ -689,31 +764,34 @@ class GenerationEngine:
             jnp.asarray(seeds))
         lp_h = (chosen_lp, top_ids, top_lps) if want_lp else None
         self.decode_steps += 1
-        return toks, lp_h, list(self._slots), time.perf_counter()
+        return ("decode", toks, lp_h, list(self._slots),
+                time.perf_counter())
 
     def _fetch_wave(self, toks_h, lp_h):
         """Runs on the executor thread: the D2H fetch that joins the
         device timeline (block_until_ready on this transport acks the
-        dispatch without joining — only the fetch truly waits)."""
+        dispatch without joining — only the fetch truly waits).
+        Returns (tokens, lp, wait_s); the caller attributes the wait
+        to decode or prefill (this path serves both kinds)."""
         t0 = time.perf_counter()
         tokens = np.asarray(toks_h)
         lp = None
         if lp_h is not None:
             lp = tuple(np.asarray(h) for h in lp_h)
-        self._decode_wait_s += time.perf_counter() - t0
-        return tokens, lp
+        return tokens, lp, time.perf_counter() - t0
 
-    def _do_prefill_group(self, group: List[_Request],
-                          slots: List[int],
-                          bucket: int):
-        """Runs on the executor thread: one bucket-padded prefill
-        dispatch for the WHOLE group (a burst of arrivals used to pay
-        one ~RTT dispatch each — half the device time under load).
-        The batch pads to a pow2 row bucket so compile count stays
-        bounded; padding rows carry an out-of-bounds slot sentinel the
-        insert scatter drops.  Returns (first_token, lp_record|None)
-        per request; slot state is installed by the scheduler on the
-        loop thread."""
+    def _enqueue_prefill_group(self, group: List[_Request],
+                               slots: List[int],
+                               bucket: int):
+        """Runs on the enqueue executor: dispatch one bucket-padded
+        prefill for the WHOLE group (a burst of arrivals rides one
+        dispatch), chain the cache insert and the device-feed scatter
+        off it, and return the first-token handles WITHOUT any host
+        sync — prompt ingestion rides the same in-flight pipeline as
+        decode waves, so admissions no longer stall live streams by a
+        full prefill dispatch.  The batch pads to a pow2 row bucket so
+        compile count stays bounded; padding rows carry an
+        out-of-bounds slot sentinel the scatters drop."""
         jnp = self._jnp
         b = len(group)
         b_bucket = 1
@@ -737,7 +815,6 @@ class GenerationEngine:
             seeds[i] = req.seed
             slot_arr[i] = slot
             want_lp = want_lp or req.logprobs > 0
-        t0 = time.perf_counter()
         firsts, new_caches, chosen_lp, top_ids, top_lps = \
             self._prefill(
                 self.variables, jnp.asarray(ids), jnp.asarray(lengths),
@@ -748,30 +825,15 @@ class GenerationEngine:
         # The admitted slots' first feed token/position land in the
         # device-resident feed arrays; rows of slots NOT in this group
         # keep their device values (the last enqueued wave's outputs,
-        # which the host may not have seen yet).
+        # which the host may not have seen yet).  The next decode wave
+        # therefore includes these slots before the host ever sees
+        # their first token.
         self._feed_tokens, self._feed_positions = self._feed_update(
             self._feed_tokens, self._feed_positions,
             jnp.asarray(slot_arr), firsts,
             jnp.asarray(lengths))
-        firsts = np.asarray(self._jax.block_until_ready(firsts))
-        lp = None
-        if want_lp:
-            # Logprob outputs cross the host link only when asked for.
-            lp = (np.asarray(chosen_lp), np.asarray(top_ids),
-                  np.asarray(top_lps))
-        self._prefill_device_s += time.perf_counter() - t0
-        self.prefills += 1
-        self.prefill_requests += b
-        out = []
-        for i, req in enumerate(group):
-            rec = None
-            if lp is not None and req.logprobs > 0:
-                rec = (float(lp[0][i]),
-                       [(int(t), float(p)) for t, p in
-                        zip(lp[1][i][:req.logprobs],
-                            lp[2][i][:req.logprobs])])
-            out.append((int(firsts[i]), rec))
-        return out
+        lp_h = (chosen_lp, top_ids, top_lps) if want_lp else None
+        return firsts, lp_h
 
     def _sampling_arrays(self):
         """Per-slot sampling parameter arrays for a decode dispatch.

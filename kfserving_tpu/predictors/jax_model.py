@@ -123,7 +123,11 @@ class JaxModel(Model):
     def load(self) -> bool:
         from kfserving_tpu.models import create_model, init_params
 
+        from kfserving_tpu import startup
+
+        startup.mark("load_start")
         self._local_dir = Storage.download(self.model_dir)
+        startup.mark("download")
         cfg = self.config
         if cfg is None:
             cfg = JaxModelConfig.from_file(
@@ -199,10 +203,13 @@ class JaxModel(Model):
         from kfserving_tpu.parallel import build_mesh, shard_params
         from kfserving_tpu.parallel.mesh import MeshConfig
 
+        from kfserving_tpu import startup
+
         # Kept for subclasses that need the raw logits path (explainers
         # differentiate through base_apply, not the serving output mode).
         self._spec = spec
         variables = init_params(spec, seed=0)
+        startup.mark("init_params")
         ckpt_path = os.path.join(self._local_dir, CHECKPOINT_NAME)
         if os.path.exists(ckpt_path):
             from flax import serialization
@@ -210,6 +217,7 @@ class JaxModel(Model):
             with open(ckpt_path, "rb") as f:
                 variables = serialization.from_bytes(variables, f.read())
             logger.info("restored checkpoint %s", ckpt_path)
+            startup.mark("checkpoint_restore")
         else:
             logger.warning("no checkpoint at %s; serving random init",
                            ckpt_path)
@@ -281,6 +289,7 @@ class JaxModel(Model):
             if cfg.warmup:
                 example = self._example_instance(spec)
                 engine.warmup(example)
+                startup.mark("warmup")
         except Exception:
             engine.close()
             raise

@@ -314,9 +314,12 @@ class GenerativeModel(Model):
     def load(self) -> bool:
         from flax import serialization
 
+        from kfserving_tpu import startup
         from kfserving_tpu.models import create_model, init_params
 
+        startup.mark("load_start")
         local = Storage.download(self.model_dir)
+        startup.mark("download")
         cfg = self.config
         if cfg is None:
             cfg = GenerativeConfig.from_file(
@@ -327,11 +330,13 @@ class GenerativeModel(Model):
 
         spec = create_model(cfg.architecture, **cfg.arch_kwargs)
         variables = init_params(spec, seed=0)
+        startup.mark("init_params")
         ckpt = os.path.join(local, "checkpoint.msgpack")
         if os.path.exists(ckpt):
             with open(ckpt, "rb") as f:
                 variables = serialization.from_bytes(variables, f.read())
             logger.info("restored checkpoint %s", ckpt)
+            startup.mark("checkpoint_restore")
         else:
             logger.warning("no checkpoint at %s; serving random init",
                            ckpt)

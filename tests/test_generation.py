@@ -321,8 +321,15 @@ async def test_decode_failure_fails_all_inflight(tiny):
     eng = make_engine(tiny, max_slots=2)
     try:
         orig = eng._fetch_wave
+        calls = []
 
         def boom(toks_h, lp_h):
+            # Let the prefill item's fetch through (a prefill failure
+            # is group-scoped, tested separately); fail the DECODE
+            # wave fetch — that one is global.
+            if not calls:
+                calls.append(1)
+                return orig(toks_h, lp_h)
             raise RuntimeError("synthetic XLA failure")
 
         eng._fetch_wave = boom
@@ -345,7 +352,7 @@ async def test_prefill_failure_fails_only_that_group(tiny):
     want = ref_greedy(module, variables, [5, 5], 4)
     eng = make_engine(tiny, max_slots=2)
     try:
-        orig = eng._do_prefill_group
+        orig = eng._enqueue_prefill_group
         calls = {"n": 0}
 
         def flaky(group, slots, bucket):
@@ -354,7 +361,7 @@ async def test_prefill_failure_fails_only_that_group(tiny):
                 raise RuntimeError("synthetic prefill OOM")
             return orig(group, slots, bucket)
 
-        eng._do_prefill_group = flaky
+        eng._enqueue_prefill_group = flaky
         with pytest.raises(InferenceError, match="prefill failed"):
             await asyncio.wait_for(
                 eng.complete([9, 9], max_new_tokens=4), timeout=10)
@@ -543,21 +550,21 @@ async def test_cancel_during_prefill_delivers_terminal_event(tiny):
     with a terminal event — a draining consumer must never hang
     (code-review r5)."""
     eng = make_engine(tiny, max_slots=1)
-    orig = eng._do_prefill_group
+    orig = eng._enqueue_prefill_group
 
     def cancel_mid_prefill(group, slots, bucket):
         for r in group:
             eng.cancel(r)
         return orig(group, slots, bucket)
 
-    eng._do_prefill_group = cancel_mid_prefill
+    eng._enqueue_prefill_group = cancel_mid_prefill
     try:
         req = eng.submit([1, 2, 3], max_new_tokens=5)
         token, fin = await asyncio.wait_for(
             eng.stream(req).__anext__(), timeout=30)
         assert token is None and fin == "cancelled"
         # The slot never got occupied; a follow-up request works.
-        eng._do_prefill_group = orig
+        eng._enqueue_prefill_group = orig
         got, reason = await eng.complete([4, 5], max_new_tokens=2)
         assert len(got) == 2 and reason == "length"
     finally:
