@@ -137,6 +137,9 @@ def _compact_configs(results: dict) -> dict:
             c.update(pick(r, "tokens_per_s", "chunk_gap_p50_ms",
                           "chunk_gap_p99_ms", "p99_over_p50",
                           "ttft_p50_ms"))
+        elif name == "generate_4k":
+            c.update(pick(r, "tokens_per_s", "ttft_p50_ms",
+                          "prefix_hit_rate", "hbm_vs_dense"))
         elif name == "multimodel":
             c.update(pick(r, "load_all_s", "swap_cycle_ms",
                           "round_robin_req_per_s"))
@@ -168,6 +171,7 @@ def main():
         "bert_flash_ab": C.bench_bert_flash_ab,
         "generate": C.bench_generate,
         "generate_poisson": C.bench_generate_poisson,
+        "generate_4k": C.bench_generate_4k,
     }
     results = {}
     for name, fn in matrix.items():

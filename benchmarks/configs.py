@@ -1035,13 +1035,20 @@ async def bench_generate_poisson(smoke: bool) -> Dict[str, Any]:
                             gaps.append((now - last) * 1000.0)
                         last = now
 
-            # Warmup: compile both prefill buckets + decode scan.
+            # Warmup: compile both prefill buckets + decode scan, AND
+            # the pow2 batched-prefill row buckets a burst compiles
+            # (b2/b4) — the first capacity run here once ate a 20 s
+            # b4-prefill compile and the arrival rate collapsed to the
+            # floor.
             warm_gaps, warm_ttft = [], []
             await one_stream(short_len, warm_gaps, warm_ttft)
             await one_stream(long_len, warm_gaps, warm_ttft)
+            await asyncio.gather(*[
+                one_stream(short_len, warm_gaps, warm_ttft)
+                for _ in range(4)])
 
-            # Capacity estimate from a closed burst, then Poisson at
-            # ~0.7x so the system has headroom and stalls are
+            # Capacity estimate from a warm closed burst, then Poisson
+            # at ~0.7x so the system has headroom and stalls are
             # attributable to admission interference, not saturation.
             t0 = time.perf_counter()
             est_gaps, est_ttft = [], []
@@ -1090,6 +1097,137 @@ async def bench_generate_poisson(smoke: bool) -> Dict[str, Any]:
             "ttft_p99_ms": round(float(np.percentile(t, 99)), 2),
             "prefills": delta("prefills"),
             "wasted_token_steps": delta("wasted_token_steps"),
+        }
+    finally:
+        await server.stop_async()
+
+
+async def bench_generate_4k(smoke: bool) -> Dict[str, Any]:
+    """Long-context generation with the PAGED cache (VERDICT r4 #4's
+    bench half): 4096-token context, flash-eligible prefill bucket,
+    a shared long system prompt exercising prefix reuse at scale, and
+    a pool sized well UNDER dense parity — the HBM the paging exists
+    to save.  Reports tokens/s, TTFT, prefix-hit rate, and cache
+    bytes vs the dense layout."""
+    import aiohttp
+
+    from kfserving_tpu.predictors.llm import GenerativeModel
+
+    if smoke:
+        cfg = {
+            "arch_kwargs": {"num_layers": 2, "hidden_size": 64,
+                            "num_heads": 2, "intermediate_size": 128,
+                            "max_seq": 256},
+            "max_slots": 4, "max_seq": 256,
+            "prefill_buckets": [64, 256],
+            "block_size": 32, "cache_blocks": 20,
+            "steps_per_call": 2,
+        }
+        n_req, conc, max_tokens = 6, 3, 8
+        system_len, tail_len = 150, 12
+    else:
+        cfg = {
+            "arch_kwargs": {"vocab_size": 32000, "hidden_size": 768,
+                            "num_layers": 12, "num_heads": 12,
+                            "intermediate_size": 3072,
+                            "max_seq": 4096},
+            "max_slots": 8, "max_seq": 4096,
+            "prefill_buckets": [512, 4096],
+            # Dense parity would be 8 * (4096/128) = 256 blocks; 112
+            # covers the shared prefix (23 blocks) + per-slot tails +
+            # growth with ~2.3x headroom — 43.75% of dense HBM.
+            "block_size": 128, "cache_blocks": 112,
+            "steps_per_call": int(os.environ.get("BENCH_GEN_K", "16")),
+        }
+        n_req, conc, max_tokens = 16, 8, 48
+        system_len, tail_len = 2980, 40
+    arch_kwargs = cfg.pop("arch_kwargs")
+    model_dir = _write_jax_model_dir(
+        "decoder_tiny" if smoke else "decoder", arch_kwargs, **cfg)
+    model = GenerativeModel("gen4k", model_dir)
+    t0 = time.perf_counter()
+    model.load()
+    load_s = round(time.perf_counter() - t0, 1)
+    server = await _serve([model])
+    base = f"http://127.0.0.1:{server.http_port}"
+    system = "the quick brown fox jumps over the lazy dog. " * 80
+    system = system[:system_len]
+    try:
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=1800)) as s:
+            async def one(i, ttfts):
+                body = json.dumps({
+                    "text_input": system + f" request {i:04d} " +
+                                  "x" * (tail_len - 14),
+                    "max_tokens": max_tokens}).encode()
+                t_post = time.perf_counter()
+                first = None
+                async with s.post(
+                        f"{base}/v2/models/gen4k/generate_stream",
+                        data=body) as r:
+                    assert r.status == 200, await r.text()
+                    async for chunk in r.content.iter_any():
+                        if first is None and b"data: " in chunk:
+                            first = time.perf_counter()
+                            ttfts.append((first - t_post) * 1000.0)
+                return None
+
+            # Warmup: compiles the 4096 prefill bucket (flash path)
+            # + decode scan + the pow2 batched-prefill ROW buckets a
+            # concurrent burst forms (b8/b4/b2 — without this they
+            # compile mid-measurement and pollute TTFT by seconds);
+            # also seeds the prefix index.
+            warm_ttft: List[float] = []
+            t0 = time.perf_counter()
+            await one(9999, warm_ttft)
+            for burst in (8, 4, 2):
+                if burst <= conc:
+                    await asyncio.gather(*[
+                        one(9000 + burst * 10 + j, warm_ttft)
+                        for j in range(burst)])
+            compile_s = round(time.perf_counter() - t0, 1)
+
+            pre = dict(model.engine_stats())
+            ttfts: List[float] = []
+            sem = asyncio.Semaphore(conc)
+
+            async def gated(i):
+                async with sem:
+                    await one(i, ttfts)
+
+            t0 = time.perf_counter()
+            await asyncio.gather(*[gated(i) for i in range(n_req)])
+            wall = time.perf_counter() - t0
+        stats = model.engine_stats()
+        paged = stats.get("paged", {})
+        hits = paged.get("prefix_hits", 0) - \
+            pre.get("paged", {}).get("prefix_hits", 0)
+        misses = paged.get("prefix_misses", 0) - \
+            pre.get("paged", {}).get("prefix_misses", 0)
+        dense_bytes = (cfg["max_slots"] * cfg["max_seq"]
+                       * arch_kwargs.get("num_heads", 2)
+                       * (arch_kwargs["hidden_size"]
+                          // arch_kwargs.get("num_heads", 2))
+                       * 2 * arch_kwargs.get("num_layers", 2)
+                       * (2 if not smoke else 4))
+        return {
+            "requests": n_req, "concurrency": conc,
+            "context": cfg["max_seq"],
+            "block_size": cfg["block_size"],
+            "pool_blocks": cfg["cache_blocks"],
+            "load_s": load_s, "compile_s": compile_s,
+            "wall_s": round(wall, 2),
+            "tokens_per_s": round(
+                (stats.get("tokens_generated", 0)
+                 - pre.get("tokens_generated", 0)) / wall, 2),
+            "ttft_p50_ms": round(float(np.percentile(
+                np.asarray(ttfts or [0.0]), 50)), 2),
+            "prefix_hits": hits, "prefix_misses": misses,
+            "prefix_hit_rate": round(hits / max(1, hits + misses), 3),
+            "cache_bytes": stats.get("cache_bytes"),
+            "dense_cache_bytes": dense_bytes,
+            "hbm_vs_dense": round(
+                stats.get("cache_bytes", 0) / max(1, dense_bytes), 3),
         }
     finally:
         await server.stop_async()

@@ -86,7 +86,25 @@ class DecoderBlock(nn.Module):
         k = proj("key")(x)
         v = proj("value")(x)
         new_cache = None
-        if cache is not None:
+        if cache is not None and len(cache) == 3:
+            # Paged decode: cache = (pool_k, pool_v, block_table) —
+            # shared block pools [NB, BS, H, D] plus this batch's
+            # [B, MB] table (engine/generator.py paged mode; the
+            # static 3-vs-2 tuple arity picks the branch at trace
+            # time).  The table flows in per dispatch and is not
+            # returned — only the written pools are.
+            from kfserving_tpu.ops.paged_attention import (
+                paged_attention_xla,
+                paged_write,
+            )
+
+            pool_k, pool_v, table = cache
+            pool_k, pool_v = paged_write(pool_k, pool_v, k[:, 0],
+                                         v[:, 0], table, positions)
+            new_cache = (pool_k, pool_v)
+            out = paged_attention_xla(q, pool_k, pool_v, table,
+                                      positions + 1)
+        elif cache is not None:
             k_cache, v_cache = cache
             b = k_cache.shape[0]
             rows = jnp.arange(b)

@@ -22,6 +22,11 @@ config.json schema:
       "max_new_tokens": 64,        # default generation budget
       "temperature": 0.0,          # default sampling temperature
       "tokenizer": "byte",         # "byte" | "hf:<name>"
+      "block_size": 64,            # paged KV cache (optional): HBM
+      "cache_blocks": 48,          #   scales with resident tokens,
+                                   #   shared prompt prefixes share
+                                   #   blocks; default pool = dense
+                                   #   parity (max_slots*max_seq)
       "mesh": {"tp": 2}            # within-replica tensor parallelism
     }
 
@@ -261,6 +266,8 @@ class GenerativeConfig:
                  steps_per_call: int = 1,
                  pipeline_depth: int = 2,
                  logprob_topk: int = 5,
+                 block_size: Optional[int] = None,
+                 cache_blocks: Optional[int] = None,
                  mesh: Optional[Dict[str, int]] = None,
                  **_ignored):
         self.architecture = architecture
@@ -280,6 +287,12 @@ class GenerativeConfig:
         # device compute; 1 = strictly blocking, the A/B baseline).
         self.pipeline_depth = int(pipeline_depth)
         self.logprob_topk = int(logprob_topk)
+        # Paged KV cache: block_size enables it (HBM scales with
+        # resident tokens; identical prompt prefixes share blocks);
+        # cache_blocks sizes the pool (default: dense-parity capacity).
+        self.block_size = int(block_size) if block_size else None
+        self.cache_blocks = (int(cache_blocks) if cache_blocks
+                             else None)
         self.mesh = mesh or {}
 
     @classmethod
@@ -364,6 +377,8 @@ class GenerativeModel(Model):
             steps_per_call=cfg.steps_per_call,
             pipeline_depth=cfg.pipeline_depth,
             logprob_topk=cfg.logprob_topk,
+            block_size=cfg.block_size,
+            cache_blocks=cfg.cache_blocks,
             mesh=mesh, name=self.name)
         if self.hbm is not None:
             # Generation residency = params + the slot cache pool.
