@@ -289,12 +289,26 @@ class SubprocessOrchestrator:
     async def create_replica(self, component_id: str, revision: str,
                              spec, placement=None,
                              standby: bool = False,
-                             nice: int = 0) -> Replica:
+                             nice: int = 0,
+                             minimal_warmup: bool = False) -> Replica:
         port = _free_port(self.host)
         argv = self._command(component_id, spec, port)
         env = dict(os.environ)
         if standby:
             env["KFS_STANDBY"] = "1"
+        if minimal_warmup or standby:
+            # Recycle successors (and standby activations, whose
+            # warmup sits inside the exclusive-device swap gap) warm
+            # only the largest bucket: the predecessor populated the
+            # persistent compile cache, so the rest load on demand —
+            # the full grid was the dominant term of successor load
+            # time (r5 SOAK successor_phases).
+            env["KFS_MINIMAL_WARMUP"] = "1"
+        else:
+            # A cold first replica (empty persistent cache) must do
+            # the full grid; never inherit a stray flag from the
+            # orchestrator's own environment.
+            env.pop("KFS_MINIMAL_WARMUP", None)
         # The package must be importable from the child even when not
         # pip-installed.
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -518,7 +532,8 @@ class SubprocessOrchestrator:
                 successor = await self.create_replica(
                     replica.component_id, replica.revision, handle.spec,
                     placement=replica.placement,
-                    nice=self.recycle.successor_nice)
+                    nice=self.recycle.successor_nice,
+                    minimal_warmup=True)
                 # Loaded and serving: restore normal CPU priority.
                 if self.recycle.successor_nice > 0:
                     try:
@@ -605,7 +620,7 @@ class SubprocessOrchestrator:
                 await self.delete_replica(replica)
                 await self.create_replica(
                     replica.component_id, replica.revision, handle.spec,
-                    placement=replica.placement)
+                    placement=replica.placement, minimal_warmup=True)
                 self.swap_windows_s.append(
                     round(loop.time() - t0, 3))
         finally:

@@ -210,6 +210,14 @@ class JaxEngine:
         with tracer.span("engine.execute") as span:
             t0 = time.perf_counter()
             padded, n = self._prepare(inputs)
+            # A bucket warmup never visited (minimal-warmup recycle
+            # successors warm only the largest) still records its cost
+            # model on first execution — otherwise flops_total/MFU
+            # silently collapse on exactly those replicas.
+            if self._flops_key(padded) not in self._flops_by_bucket:
+                self._record_flops(
+                    padded.shape[0] if hasattr(padded, "shape")
+                    else len(next(iter(padded.values()))), padded)
             t1 = time.perf_counter()
             if self._explicit_transfer:
                 # Async H2D dispatch: with pipeline_depth worker threads,
@@ -267,15 +275,25 @@ class JaxEngine:
         return self._execute_sync(inputs)
 
     # -- lifecycle -----------------------------------------------------------
-    def warmup(self, example: Any, buckets: Optional[List[int]] = None) -> float:
+    def warmup(self, example: Any, buckets: Optional[List[int]] = None,
+               minimal: bool = False) -> float:
         """Pre-compile every executable a request can hit: all batch
         buckets x all seq buckets (sequence models without the full grid
         warm compile at serve time instead — measured ~25s per shape on
         a tunneled chip, which turns first requests into timeouts).
         Returns total compile seconds.  `example` is a single instance
-        (no batch dim) as array or dict of arrays."""
+        (no batch dim) as array or dict of arrays.
+
+        minimal=True warms only the LARGEST batch bucket per seq
+        bucket — the recycle-successor mode: the predecessor populated
+        the persistent compile cache, so the remaining programs load
+        on demand in sub-seconds, and the full grid's ~RTT-per-program
+        dispatch tax was the dominant term of successor load time
+        (measured r5 SOAK: warmup was 11 of a warm successor's 21 s)."""
         start = time.perf_counter()
         batch_buckets = buckets or self.batch_buckets.buckets
+        if minimal:
+            batch_buckets = [max(batch_buckets)]
         seq_buckets = (self.seq_buckets.buckets
                        if self.seq_buckets is not None else [None])
 

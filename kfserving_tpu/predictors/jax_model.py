@@ -288,7 +288,13 @@ class JaxModel(Model):
         try:
             if cfg.warmup:
                 example = self._example_instance(spec)
-                engine.warmup(example)
+                # Recycle successors trim the grid: the predecessor's
+                # persistent compile cache makes on-demand bucket
+                # loads cheap, and a fast successor shortens the
+                # contention window that drives the swap's p99.
+                engine.warmup(example, minimal=(
+                    os.environ.get("KFS_MINIMAL_WARMUP", "")
+                    not in ("", "0", "false")))
                 startup.mark("warmup")
         except Exception:
             engine.close()

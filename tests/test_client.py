@@ -565,7 +565,7 @@ async def test_recycle_drain_window_counts_as_pending_create():
         pending_during["drain"] = orch.pending_creates(cid, rev)
         await asyncio.sleep(0)
 
-    async def fake_create(cid_, rev_, spec_, placement=None):
+    async def fake_create(cid_, rev_, spec_, placement=None, **kw):
         pending_during["create"] = orch.pending_creates(cid_, rev_)
         return replica
 

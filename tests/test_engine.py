@@ -75,6 +75,17 @@ class TestJaxEngine:
         out = engine.predict_sync(np.zeros((5, 3), np.float32))
         assert out.shape == (5, 4)
 
+    def test_warmup_minimal_only_largest_bucket(self):
+        """Recycle-successor mode: warm the largest bucket only; the
+        rest load on demand from the persistent cache (r5 SOAK found
+        the full grid was the dominant successor-load term)."""
+        engine, _ = make_engine()
+        engine.warmup(np.zeros((3,), np.float32), minimal=True)
+        assert engine.compile_count == 1
+        # Smaller buckets still serve (on-demand compile).
+        out = engine.predict_sync(np.zeros((2, 3), np.float32))
+        assert out.shape == (2, 4)
+
     def test_seq_buckets(self):
         import jax.numpy as jnp
 
