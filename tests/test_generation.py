@@ -355,11 +355,11 @@ async def test_prefill_failure_fails_only_that_group(tiny):
         orig = eng._enqueue_prefill_group
         calls = {"n": 0}
 
-        def flaky(group, slots, bucket):
+        def flaky(group, slots, bucket, dest_rows=None):
             calls["n"] += 1
             if calls["n"] == 1:
                 raise RuntimeError("synthetic prefill OOM")
-            return orig(group, slots, bucket)
+            return orig(group, slots, bucket, dest_rows)
 
         eng._enqueue_prefill_group = flaky
         with pytest.raises(InferenceError, match="prefill failed"):
@@ -552,10 +552,10 @@ async def test_cancel_during_prefill_delivers_terminal_event(tiny):
     eng = make_engine(tiny, max_slots=1)
     orig = eng._enqueue_prefill_group
 
-    def cancel_mid_prefill(group, slots, bucket):
+    def cancel_mid_prefill(group, slots, bucket, dest_rows=None):
         for r in group:
             eng.cancel(r)
-        return orig(group, slots, bucket)
+        return orig(group, slots, bucket, dest_rows)
 
     eng._enqueue_prefill_group = cancel_mid_prefill
     try:
