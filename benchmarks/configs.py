@@ -1043,21 +1043,32 @@ async def bench_generate_poisson(smoke: bool) -> Dict[str, Any]:
             warm_gaps, warm_ttft = [], []
             await one_stream(short_len, warm_gaps, warm_ttft)
             await one_stream(long_len, warm_gaps, warm_ttft)
-            await asyncio.gather(*[
-                one_stream(short_len, warm_gaps, warm_ttft)
-                for _ in range(4)])
+            # Row buckets b4 AND b2 for both length buckets: the
+            # estimate's arrival order forms b2 groups, and a cold b2
+            # trace inside est_wall collapses the rate to the floor.
+            for n, length in ((4, short_len), (2, short_len),
+                              (2, long_len)):
+                await asyncio.gather(*[
+                    one_stream(length, warm_gaps, warm_ttft)
+                    for _ in range(n)])
 
-            # Capacity estimate from a warm closed burst, then Poisson
-            # at ~0.7x so the system has headroom and stalls are
-            # attributable to admission interference, not saturation.
+            # Capacity estimate from a warm closed burst of the MIXED
+            # length distribution (an all-short estimate once
+            # overshot: short streams skip the long-bucket prefill
+            # compute that dominates mixed load, the resulting 0.7x
+            # rate exceeded true capacity, and the arrival queue
+            # exploded to 32 s TTFTs).  Then Poisson at 0.6x so
+            # stalls are attributable to admission interference, not
+            # saturation.
             t0 = time.perf_counter()
             est_gaps, est_ttft = [], []
             await asyncio.gather(*[
-                one_stream(short_len, est_gaps, est_ttft)
-                for _ in range(4)])
+                one_stream(short_len if i % 3 else long_len,
+                           est_gaps, est_ttft)
+                for i in range(6)])
             est_wall = time.perf_counter() - t0
-            req_rate_capacity = 4 / est_wall if est_wall > 0 else 1.0
-            rate = max(0.2, 0.7 * req_rate_capacity)
+            req_rate_capacity = 6 / est_wall if est_wall > 0 else 1.0
+            rate = max(0.2, 0.6 * req_rate_capacity)
 
             # Snapshot counters so the measured phase's stats exclude
             # warmup + capacity-estimate traffic.

@@ -444,16 +444,20 @@ class GenerationEngine:
 
         self._insert = jax.jit(insert_fn, donate_argnums=(0,))
 
-        # Two single-thread executors with distinct roles: `_executor`
-        # owns blocking D2H fetches (each ~an RTT); `_enqueue_executor`
-        # owns dispatch enqueues (fast post-compile, but the FIRST call
-        # per shape traces + compiles for seconds — that must not
-        # freeze the asyncio loop, and must not queue behind an
-        # in-flight fetch either, or admission would stall on decode).
-        # Device-side ordering comes from the data-dependency chain on
-        # the cache/feed handles, not from host thread order.
+        # Two executors with distinct roles: `_executor` owns blocking
+        # D2H fetches (each ~an RTT) — TWO workers, because fetches
+        # are submitted EAGERLY at enqueue time and a decode wave's
+        # tokens must not queue behind a prefill fetch's round trip
+        # (results are awaited in FIFO order regardless of completion
+        # order).  `_enqueue_executor` owns dispatch enqueues (fast
+        # post-compile, but the FIRST call per shape traces + compiles
+        # for seconds — that must not freeze the asyncio loop, and
+        # must not queue behind an in-flight fetch either, or
+        # admission would stall on decode).  Device-side ordering
+        # comes from the data-dependency chain on the cache/feed
+        # handles, not from host thread order.
         self._executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1,
+            max_workers=2,
             thread_name_prefix=f"generator-{name}")
         self._enqueue_executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1,
@@ -951,10 +955,25 @@ class GenerationEngine:
         # forward + cache insert + feed scatter and returns WITHOUT a
         # host sync (the old blocking admission added a full
         # prefill-dispatch of inter-token stall to every live stream).
-        # Items: ("decode", toks_h, lp_h, snapshot, t0) or
-        # ("prefill", firsts_h, lp_h, entries, t0) where entries is
-        # [(slot, _Active|None)] in batch order.
+        # Items: ("decode", fetch_future, snapshot, t0) or
+        # ("prefill", fetch_future, entries, t0) where entries is
+        # [(slot, _Active|None)] in batch order.  Fetch futures are
+        # submitted EAGERLY at enqueue (round trips overlap on the
+        # 2-worker fetch executor); awaiting in FIFO order preserves
+        # delivery order.
         inflight: deque = deque()
+        try:
+            await self._run_pipeline(loop, inflight)
+        finally:
+            # A global failure (or close) can leave eagerly-submitted
+            # fetch futures behind; consume their exceptions so a
+            # poisoned chain doesn't log 'Future exception was never
+            # retrieved' for every orphaned wave.
+            for item in inflight:
+                item[1].add_done_callback(
+                    lambda f: f.cancelled() or f.exception())
+
+    async def _run_pipeline(self, loop, inflight: deque):
         while not self._closed:
             admitted = False
             while self._pending and self._free_slot() is not None:
@@ -1009,7 +1028,12 @@ class GenerationEngine:
                                   last_token=-1, generated=0)
                     self._slots[slot] = act
                     entries.append((slot, act))
-                inflight.append(("prefill", firsts_h, lp_h, entries,
+                # Eager fetch: the D2H round trip starts NOW and
+                # overlaps other fetches; the FIFO await below keeps
+                # delivery order.
+                fut = loop.run_in_executor(
+                    self._executor, self._fetch_wave, firsts_h, lp_h)
+                inflight.append(("prefill", fut, entries,
                                  time.perf_counter()))
                 admitted = True
             active = any(s is not None for s in self._slots)
@@ -1072,13 +1096,22 @@ class GenerationEngine:
             # the same FIFO).
             waves = sum(1 for it in inflight if it[0] == "decode")
             while active and waves < self.pipeline_depth:
-                inflight.append(await loop.run_in_executor(
-                    self._enqueue_executor, self._enqueue_wave))
+                kind_, toks_h, lp_h, snap, t0_ = \
+                    await loop.run_in_executor(
+                        self._enqueue_executor, self._enqueue_wave)
+                fut = loop.run_in_executor(
+                    self._executor, self._fetch_wave, toks_h, lp_h)
+                inflight.append((kind_, fut, snap, t0_))
                 waves += 1
-            kind, out_h, lp_h, meta, t0 = inflight.popleft()
+            kind, fut, meta, t0 = inflight.popleft()
+            t_await = time.perf_counter()
             try:
-                fetched, lp, wait_s = await loop.run_in_executor(
-                    self._executor, self._fetch_wave, out_h, lp_h)
+                fetched, lp, _worker_span = await fut
+                # Host-blocked time is the LOOP-side await, not the
+                # worker's span: eager fetches overlap on the worker
+                # pool and their spans cover whole-wave latency — the
+                # sum would exceed wall clock and lie in A/Bs.
+                wait_s = time.perf_counter() - t_await
             except Exception as e:
                 if kind == "prefill":
                     # Fail THAT group; in-flight slots keep decoding.
