@@ -94,7 +94,7 @@ class DecoderBlock(nn.Module):
             # time).  The table flows in per dispatch and is not
             # returned — only the written pools are.
             from kfserving_tpu.ops.paged_attention import (
-                paged_attention_xla,
+                paged_attention,
                 paged_write,
             )
 
@@ -102,8 +102,8 @@ class DecoderBlock(nn.Module):
             pool_k, pool_v = paged_write(pool_k, pool_v, k[:, 0],
                                          v[:, 0], table, positions)
             new_cache = (pool_k, pool_v)
-            out = paged_attention_xla(q, pool_k, pool_v, table,
-                                      positions + 1)
+            out = paged_attention(q, pool_k, pool_v, table,
+                                  positions + 1)
         elif cache is not None:
             k_cache, v_cache = cache
             b = k_cache.shape[0]

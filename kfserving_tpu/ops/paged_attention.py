@@ -31,8 +31,154 @@ Contract (per layer):
 Returns [B, 1, H, D].
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scratch, l_scratch, acc_scratch, *,
+                  block_size: int, scale: float, num_heads: int):
+    """One batch row's online-softmax walk over its block table, all
+    heads per program (head-batched dot_generals keep the block
+    shapes' trailing dims equal to the array dims — Mosaic's tiling
+    requirement).  Grid: (B, MB) with the block axis innermost and
+    sequential; the index maps clamp the pool-block index so programs
+    past a row's valid length re-DMA an already-resident block —
+    invalid blocks cost neither HBM traffic nor FLOPs (the flash
+    kernel's kv_lengths clamp, applied to a block table).  The
+    gathered [B, MB*BS, H, D] view the XLA fallback materializes
+    every step never exists here."""
+    b_idx = pl.program_id(0)
+    j_idx = pl.program_id(1)
+    num_j = pl.num_programs(1)
+    row_len = len_ref[b_idx]
+
+    @pl.when(j_idx == 0)
+    def _init():
+        m_scratch[:] = jnp.full_like(m_scratch, _NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+
+    h = num_heads
+
+    def _run_block():
+        # Decode attention is a per-head matvec — bandwidth-bound, so
+        # everything here is VPU elementwise+reduce (Mosaic's in-kernel
+        # dot does not take batched dimension numbers).  Scores keep
+        # the [bs, h] orientation end-to-end: reductions run over the
+        # major axis and no relayout-heavy transposes are needed.
+        q = q_ref[0, 0].astype(jnp.float32)               # [h, d]
+        k = k_ref[0].astype(jnp.float32)                  # [bs, h, d]
+        s = jnp.sum(k * q[None], axis=-1) * scale         # [bs, h]
+        pos = j_idx * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (block_size, h), 0)
+        s = jnp.where(pos < row_len, s, _NEG_INF)
+        m_prev = m_scratch[0:1, 0:h]                      # [1, h]
+        l_prev = l_scratch[0:1, 0:h]
+        m_cur = jnp.max(s, axis=0, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                            # [bs, h]
+        alpha = jnp.exp(m_prev - m_new)                   # [1, h]
+        l_new = alpha * l_prev + jnp.sum(p, axis=0, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)                  # [bs, h, d]
+        pv = jnp.sum(p[:, :, None] * v, axis=0)           # [h, d]
+        alpha_col = jnp.swapaxes(alpha, 0, 1)             # [h, 1]
+        acc_scratch[0:h] = acc_scratch[0:h] * alpha_col + pv
+        m_scratch[0:1, 0:h] = m_new
+        l_scratch[0:1, 0:h] = l_new
+
+    # Blocks wholly past the row's length never run.
+    pl.when(j_idx * block_size < row_len)(_run_block)
+
+    @pl.when(j_idx == num_j - 1)
+    def _finalize():
+        l_col = jnp.swapaxes(l_scratch[0:1, 0:h], 0, 1)   # [h, 1]
+        o_ref[0, 0] = (acc_scratch[0:h]
+                       / jnp.maximum(l_col, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_tpu(q, pool_k, pool_v, block_table, lengths,
+                        interpret: bool = False):
+    """Pallas paged decode attention — same contract as
+    `paged_attention_xla`, without materializing the gathered cache
+    view, and reading only blocks that hold valid tokens (a short
+    sequence in a long-context pool costs its length, not the pool
+    width)."""
+    b, lq, h, d = q.shape
+    nb, bs, _, _ = pool_k.shape
+    mb = block_table.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    table_flat = jnp.maximum(block_table, 0).reshape(-1)
+    lengths = lengths.astype(jnp.int32)
+
+    def q_index(bi, ji, table, lens):
+        return (bi, 0, 0, 0)
+
+    def kv_index(bi, ji, table, lens):
+        # Clamp the walk to the row's last VALID table entry: programs
+        # past the length re-address a resident block (no new DMA, and
+        # pl.when skips their compute).
+        last = jnp.maximum(
+            jax.lax.div(lens[bi] - 1, jnp.int32(bs)), 0)
+        jj = jnp.minimum(ji, last)
+        return (table[bi * mb + jj], 0, 0, 0)
+
+    # Stats scratch is lane-padded to 128 (Mosaic tiling); only
+    # column 0 is used.
+    h_pad = max(8, -(-h // 8) * 8)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, h, d), q_index),
+            pl.BlockSpec((1, bs, h, d), kv_index),
+            pl.BlockSpec((1, bs, h, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, h, d), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((h_pad, 128), jnp.float32),
+            pltpu.VMEM((h_pad, 128), jnp.float32),
+            pltpu.VMEM((h_pad, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_kernel, block_size=bs,
+                               scale=scale, num_heads=h)
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, lq, h, d), q.dtype),
+        interpret=interpret,
+    )(table_flat, lengths, q, pool_k, pool_v)
+
+
+def paged_attention(q, pool_k, pool_v, block_table, lengths):
+    """Dispatcher: the Pallas kernel on TPU when the shapes meet its
+    assumptions (single-token query, block_size a lane multiple,
+    head_dim a 64-multiple like the flash gate, heads within the
+    stats scratch's 128 lanes), XLA gather otherwise (CPU tests, odd
+    shapes).  KFS_DISABLE_PAGED_KERNEL=1 forces the XLA path — the
+    on-chip A/B kill-switch, mirroring the flash kernel's
+    KFS_DISABLE_FLASH."""
+    import os
+
+    from kfserving_tpu.ops.attention import _tpu_backend
+
+    bs = pool_k.shape[1]
+    d = q.shape[-1]
+    h = q.shape[2]
+    if (_tpu_backend() and q.shape[1] == 1 and h <= 128
+            and bs % 128 == 0 and d % 64 == 0
+            and os.environ.get("KFS_DISABLE_PAGED_KERNEL", "")
+            in ("", "0", "false")):
+        return paged_attention_tpu(q, pool_k, pool_v, block_table,
+                                   lengths)
+    return paged_attention_xla(q, pool_k, pool_v, block_table, lengths)
 
 
 def paged_attention_xla(q, pool_k, pool_v, block_table, lengths):

@@ -467,3 +467,47 @@ async def test_prefill_enqueue_failure_releases_planned_blocks(tiny):
         assert got == want
     finally:
         await eng.close()
+
+
+# --------------------------------------------- pallas paged kernel
+
+
+def test_pallas_paged_kernel_matches_xla():
+    """The Pallas paged-decode kernel (interpret mode on CPU) matches
+    the XLA gather reference across partial blocks, shared blocks,
+    and unallocated (-1) table tails."""
+    from kfserving_tpu.ops import paged_attention as pa
+
+    rng = np.random.default_rng(0)
+    B, H, D, BSZ, NB, MB = 3, 4, 64, 128, 8, 4
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+    pool_k = jnp.asarray(rng.normal(size=(NB, BSZ, H, D)), jnp.float32)
+    pool_v = jnp.asarray(rng.normal(size=(NB, BSZ, H, D)), jnp.float32)
+    table = jnp.asarray([[0, 1, 2, -1],
+                         [3, -1, -1, -1],
+                         [0, 4, -1, -1]], jnp.int32)  # row 2 shares 0
+    lengths = jnp.asarray([300, 40, 200], jnp.int32)
+    want = pa.paged_attention_xla(q, pool_k, pool_v, table, lengths)
+    got = pa.paged_attention_tpu.__wrapped__(
+        q, pool_k, pool_v, table, lengths, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_paged_kernel_block_boundary_lengths():
+    from kfserving_tpu.ops import paged_attention as pa
+
+    rng = np.random.default_rng(1)
+    B, H, D, BSZ, NB, MB = 2, 2, 64, 128, 6, 3
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+    pool_k = jnp.asarray(rng.normal(size=(NB, BSZ, H, D)), jnp.float32)
+    pool_v = jnp.asarray(rng.normal(size=(NB, BSZ, H, D)), jnp.float32)
+    table = jnp.asarray([[0, 1, 2], [3, 4, 5]], jnp.int32)
+    for lens in ([128, 256], [1, 384], [127, 129]):
+        lengths = jnp.asarray(lens, jnp.int32)
+        want = pa.paged_attention_xla(q, pool_k, pool_v, table,
+                                      lengths)
+        got = pa.paged_attention_tpu.__wrapped__(
+            q, pool_k, pool_v, table, lengths, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5, err_msg=str(lens))
