@@ -37,7 +37,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: multi-process / subprocess / long-parity tests.  CI "
-        "default: `pytest -m 'not slow'` (~3 min hermetic core); "
+        "default: `pytest -m 'not slow'` (~9 min hermetic core); "
         "nightly/full: `pytest tests/` (everything)")
 
 

@@ -93,6 +93,7 @@ async def test_engine_greedy_matches_full_recompute(tiny):
     assert reason == "length"
 
 
+@pytest.mark.slow
 async def test_concurrent_requests_match_isolated(tiny):
     """Slots sharing one decode batch must not influence each other:
     every concurrent result equals its isolated baseline."""
@@ -603,6 +604,7 @@ async def test_top_p_tiny_equals_greedy(tiny):
     assert got == want
 
 
+@pytest.mark.slow
 async def test_top_k_and_top_p_restrict_support(tiny):
     """Every sampled token lies inside the declared support: top-k's
     k best ids, and top-p's nucleus (smallest prefix of the sorted
@@ -637,6 +639,7 @@ async def test_top_k_and_top_p_restrict_support(tiny):
         await eng.close()
 
 
+@pytest.mark.slow
 async def test_seed_reproduces_regardless_of_scheduling(tiny):
     """A seeded temperature request reproduces exactly — solo or
     sharing decode waves with other requests (noise is keyed on
@@ -679,6 +682,7 @@ async def test_default_seeds_vary_across_requests(tiny):
     assert a != b
 
 
+@pytest.mark.slow
 async def test_logprobs_match_full_forward(tiny):
     """Chosen-token logprobs come from the unmasked log-softmax; top-N
     ids/values match the reference full forward at every step."""
@@ -727,6 +731,7 @@ async def test_sampling_validation(tiny):
 # ------------------------------------------------------ pipelined decode
 
 
+@pytest.mark.slow
 async def test_pipeline_depth_parity(tiny):
     """Token-for-token parity across pipeline depths: the device-
     resident feed chain (depth>=2, fetch of wave N overlapping wave

@@ -454,6 +454,7 @@ async def test_stop_sequence_truncates(tmp_path):
         model.unload()
 
 
+@pytest.mark.slow
 async def test_stop_sequence_streaming_holdback(tmp_path):
     """Streaming with a stop sequence: no emitted chunk ever contains
     stop text (split-across-chunks included — K>1 makes chunks span
@@ -673,6 +674,7 @@ async def test_stream_flag_upgrade_through_ingress(tmp_path):
         await orch.shutdown()
 
 
+@pytest.mark.slow
 async def test_stream_canary_split_through_ingress(tmp_path):
     """Canary weights apply at stream START: with a 50% canary both
     revisions serve streams (deterministic rng seed drives the
@@ -804,6 +806,7 @@ async def test_server_drain_waits_for_streams(tmp_path):
         await server.stop_async()
 
 
+@pytest.mark.slow
 async def test_autoscaler_scales_on_slot_occupancy(tmp_path):
     """Scale-up driven PURELY by engine slot saturation at low request
     count: 2 slots busy + pending prefills with a near-zero router

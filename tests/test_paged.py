@@ -69,6 +69,7 @@ async def test_paged_greedy_matches_full_recompute(tiny):
     assert reason == "length"
 
 
+@pytest.mark.slow
 async def test_paged_block_boundary_cases(tiny):
     """Prompts AT a block boundary and budgets that cross one: the
     scatter/gather seams must be invisible."""
@@ -90,6 +91,7 @@ async def test_paged_block_boundary_cases(tiny):
         await eng.close()
 
 
+@pytest.mark.slow
 async def test_paged_concurrent_requests_isolated(tiny):
     module, variables, _ = tiny
     prompts = [[3, 1, 4], [1, 5, 9, 2, 6, 5],
@@ -143,6 +145,7 @@ async def test_prefix_reuse_shares_blocks_and_preserves_output(tiny):
     assert hits_after - hits_before == 2  # both shared blocks hit
 
 
+@pytest.mark.slow
 async def test_prefix_blocks_linger_and_get_evicted_under_pressure(
         tiny):
     """Zero-ref registered blocks stay reclaimable (future requests
@@ -196,6 +199,7 @@ def test_paged_cache_bytes_scale_with_pool(tiny):
         half.shutdown_nowait()
 
 
+@pytest.mark.slow
 async def test_paged_pool_pressure_queues_not_fails(tiny):
     """A pool smaller than the offered load: requests WAIT for block
     releases and all complete (progress guarantee), matching their
@@ -368,6 +372,7 @@ async def test_paged_growth_preemption_resumes_exactly(tiny):
     assert stats["preemptions"] >= 1  # pressure actually happened
 
 
+@pytest.mark.slow
 async def test_paged_preemption_exact_under_sampling(tiny):
     """Seeded temperature stream preempted mid-flight == the same
     stream run solo with ample blocks."""
@@ -428,6 +433,7 @@ async def test_plan_rollback_deregisters_provisional_chains(tiny):
         await eng.close()
 
 
+@pytest.mark.slow
 async def test_prefill_enqueue_failure_releases_planned_blocks(tiny):
     """An enqueue-time prefill failure must release the planned
     blocks AND deregister provisional chains — leaked refs shrink the
