@@ -43,7 +43,16 @@ class Downloader:
 
     def download(self, model_name: str, spec: dict) -> Optional[str]:
         """Download spec["storageUri"] into <model_dir>/<model_name>.
-        Returns the local path, or None when already current."""
+        Returns the local path, or None when already current.
+
+        Replay-safe by construction: the marker lands only after a
+        full pull and a changed/partial generation is wiped first, so
+        the puller's retry policy can re-invoke this freely (the
+        `agent.pull` fault site injects failures here, before any
+        filesystem mutation)."""
+        from kfserving_tpu.reliability import faults
+
+        faults.inject_sync("agent.pull", key=model_name)
         digest = spec_digest(spec)
         target = self.model_path(model_name)
         marker = self._marker(model_name, digest)

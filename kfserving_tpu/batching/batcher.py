@@ -55,6 +55,13 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, Hashable, List, Optional
 
+from kfserving_tpu.reliability.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    clear_deadline,
+    current_deadline,
+)
+
 logger = logging.getLogger("kfserving_tpu.batcher")
 
 DEFAULT_MAX_BATCH_SIZE = 32   # reference handler.go:34
@@ -74,12 +81,26 @@ class BatchResult:
 
 
 @dataclass
+class _Waiter:
+    start: int                  # offset of this request's instances
+    count: int
+    future: asyncio.Future
+    # loop.time()-based flush deadline (arrival + max_latency) so a
+    # remainder left behind by a prefix flush can re-arm its timer at
+    # its own oldest request's deadline.
+    flush_at: float = 0.0
+    # The request's reliability budget (x-request-timeout-ms / gRPC
+    # deadline), captured from the ambient context at submit: a waiter
+    # whose budget expires while queued fails with 504 *before* it
+    # wastes a batch slot.
+    budget: Optional[Deadline] = None
+    expiry: Optional[asyncio.TimerHandle] = None
+
+
+@dataclass
 class _Pending:
     instances: List[Any] = field(default_factory=list)
-    # (start, count, future, deadline) — deadline is loop.time()-based so a
-    # remainder left behind by a prefix flush can re-arm its timer at its
-    # own oldest request's deadline.
-    waiters: List = field(default_factory=list)
+    waiters: List[_Waiter] = field(default_factory=list)
     timer: Optional[asyncio.TimerHandle] = None
     ripe: bool = False  # flush requested but deferred (no inflight slot)
 
@@ -150,6 +171,10 @@ class DynamicBatcher:
         # native codec fast path, where bool() on >1 element raises.
         if len(instances) == 0:
             raise ValueError("no instances in the request")
+        budget = current_deadline()
+        if budget is not None:
+            # Already over budget: 504 before touching the queue.
+            budget.raise_if_expired("batch queue admission")
         key = self.key_fn(instances[0]) if self.key_fn else None
         loop = asyncio.get_running_loop()
         pending = self._pending.get(key)
@@ -161,11 +186,90 @@ class DynamicBatcher:
         start = len(pending.instances)
         pending.instances.extend(instances)
         future = loop.create_future()
-        pending.waiters.append((start, len(instances), future,
-                                loop.time() + self.max_latency_ms / 1000.0))
+        waiter = _Waiter(start, len(instances), future,
+                         loop.time() + self.max_latency_ms / 1000.0,
+                         budget)
+        pending.waiters.append(waiter)
+        if budget is not None:
+            # Fail at the budget's expiry moment, not at the next
+            # flush: a 5s flush deadline must not sit on a 50ms
+            # budget's 504.
+            waiter.expiry = loop.call_later(
+                max(0.0, budget.remaining_s()),
+                self._expire_waiter, key, waiter)
         if len(pending.instances) >= self.max_batch_size:
             self._begin_flush(key)
-        return await future
+        try:
+            return await future
+        except asyncio.CancelledError:
+            # Client disconnect / caller timeout: a cancelled submit
+            # withdraws its still-queued instances so they never waste
+            # batch-slot capacity (an already-flushed waiter rides its
+            # batch out; the result is simply dropped).
+            self._discard_waiter(key, waiter)
+            if future.done() and not future.cancelled():
+                # Retrieve the exception an expiry set in the race
+                # window, or asyncio logs "exception was never
+                # retrieved" on GC.
+                future.exception()
+            raise
+        finally:
+            if waiter.expiry is not None:
+                waiter.expiry.cancel()
+
+    def _expire_waiter(self, key: Hashable, waiter: _Waiter) -> None:
+        """Budget ran out while queued: fail THIS waiter with 504 and
+        withdraw its instances (the rest of the batch is untouched)."""
+        if waiter.future.done():
+            return
+        if not waiter.budget.expired:
+            # Timer fired early (clock clamping/drift): the 504 must
+            # follow the BUDGET, not timer arithmetic — re-arm.
+            waiter.expiry = asyncio.get_running_loop().call_later(
+                max(0.001, waiter.budget.remaining_s()),
+                self._expire_waiter, key, waiter)
+            return
+        waiter.future.set_exception(
+            DeadlineExceeded("expired in batch queue"))
+        self._discard_waiter(key, waiter)
+
+    def _discard_waiter(self, key: Hashable, waiter: _Waiter) -> None:
+        """Remove a dead waiter (expired / cancelled) from its pending
+        group, rebuilding sibling offsets.  No-op once flushed."""
+        pending = self._pending.get(key)
+        if pending is None or waiter not in pending.waiters:
+            return
+        pending.waiters.remove(waiter)
+        del pending.instances[waiter.start:waiter.start + waiter.count]
+        for w in pending.waiters:
+            if w.start > waiter.start:
+                w.start -= waiter.count
+        if not pending.waiters:
+            if pending.timer is not None:
+                pending.timer.cancel()
+            self._pending.pop(key, None)
+
+    def _reap_dead(self, pending: _Pending) -> None:
+        """Drop waiters that can no longer use a result — budget spent
+        (fail them with 504 now) or future already done (cancelled) —
+        before a flush commits batch slots to them."""
+        dead = [w for w in pending.waiters
+                if w.future.done()
+                or (w.budget is not None and w.budget.expired)]
+        if not dead:
+            return
+        for w in dead:
+            if not w.future.done():
+                w.future.set_exception(
+                    DeadlineExceeded("expired in batch queue"))
+            pending.waiters.remove(w)
+        instances, pos = [], 0
+        for w in pending.waiters:
+            instances.extend(
+                pending.instances[w.start:w.start + w.count])
+            w.start = pos
+            pos += w.count
+        pending.instances = instances
 
     def _flush_by_timer(self, key: Hashable):
         if key in self._pending and self._pending[key].instances:
@@ -177,10 +281,10 @@ class DynamicBatcher:
         Returns (pending, None) when no split is possible (everything
         fits, or the first waiter alone exceeds target)."""
         cum = j = 0
-        for _, count, _, _ in pending.waiters:
-            if cum + count > target:
+        for w in pending.waiters:
+            if cum + w.count > target:
                 break
-            cum += count
+            cum += w.count
             j += 1
         if j == 0 or j == len(pending.waiters):
             return pending, None
@@ -190,15 +294,23 @@ class DynamicBatcher:
         # their own deadline timer (re-armed by the caller) or the next
         # size trigger flushes them; marking them ripe would make
         # _on_batch_done flush a tiny padded batch early.
-        rest = _Pending(
-            instances=pending.instances[cum:],
-            waiters=[(s - cum, c, f, d)
-                     for s, c, f, d in pending.waiters[j:]])
+        rest = _Pending(instances=pending.instances[cum:],
+                        waiters=pending.waiters[j:])
+        for w in rest.waiters:
+            w.start -= cum
         return head, rest
 
     def _begin_flush(self, key: Hashable, align: bool = True):
         pending = self._pending.get(key)
         if pending is None:
+            return
+        # Shed dead weight first: expired-budget and cancelled waiters
+        # must not occupy slots in the batch about to execute.
+        self._reap_dead(pending)
+        if not pending.waiters:
+            if pending.timer is not None:
+                pending.timer.cancel()
+            self._pending.pop(key, None)
             return
         if self.max_inflight is not None and \
                 self._inflight >= self.max_inflight:
@@ -222,13 +334,13 @@ class DynamicBatcher:
             # Re-arm at the remainder's own oldest deadline (may be in
             # the past if this flush was slot-deferred — fires ~now).
             loop = asyncio.get_running_loop()
-            rest.timer = loop.call_at(rest.waiters[0][3],
+            rest.timer = loop.call_at(rest.waiters[0].flush_at,
                                       self._flush_by_timer, key)
         else:
             self._pending.pop(key)
         if head.waiters:
             loop = asyncio.get_running_loop()
-            oldest_arrival = head.waiters[0][3] \
+            oldest_arrival = head.waiters[0].flush_at \
                 - self.max_latency_ms / 1000.0
             age_ms = max(0.0, (loop.time() - oldest_arrival) * 1000.0)
             rec = self.queue_age_ms.setdefault(
@@ -255,7 +367,7 @@ class DynamicBatcher:
         # KEY, so the 512 bucket always beat the 32 bucket for a freed
         # slot — the r3 mixed-length inversion (len24 p99 1.9s vs
         # len450 1.3s) was this line.
-        ripe = [(p.waiters[0][3], -len(p.instances), id(p), k)
+        ripe = [(p.waiters[0].flush_at, -len(p.instances), id(p), k)
                 for k, p in self._pending.items()
                 if p.ripe and p.instances]
         if ripe:
@@ -263,13 +375,19 @@ class DynamicBatcher:
             self._begin_flush(ripe[0][3])
 
     async def _run_batch(self, key: Hashable, pending: _Pending):
+        # This task inherits the context of whichever request's submit
+        # (or timer) triggered the flush; the batch serves MANY
+        # requests, so that single request's deadline must not govern
+        # the shared execution (budgets were enforced per-waiter at
+        # flush time).
+        clear_deadline()
         batch_id = str(uuid.uuid4())
         try:
             predictions = await self._run_chunked(pending.instances, key)
         except Exception as e:
-            for _, _, future, _ in pending.waiters:
-                if not future.done():
-                    future.set_exception(
+            for w in pending.waiters:
+                if not w.future.done():
+                    w.future.set_exception(
                         e if len(pending.waiters) == 1 else _clone_exc(e))
             return
         finally:
@@ -277,10 +395,10 @@ class DynamicBatcher:
         self.batches_flushed += 1
         self.instances_batched += len(pending.instances)
         self.last_batch_size = len(pending.instances)
-        for start, count, future, _ in pending.waiters:
-            if not future.done():
-                future.set_result(BatchResult(
-                    predictions[start:start + count], batch_id))
+        for w in pending.waiters:
+            if not w.future.done():
+                w.future.set_result(BatchResult(
+                    predictions[w.start:w.start + w.count], batch_id))
 
     async def _run_chunked(self, instances: List[Any],
                            key: Hashable) -> List[Any]:

@@ -23,6 +23,8 @@ import json
 from dataclasses import asdict, is_dataclass
 from typing import Any, Dict, List, Optional
 
+from kfserving_tpu.reliability import RetryPolicy, faults
+
 DEFAULT_TIMEOUT_S = 60.0
 
 
@@ -50,11 +52,33 @@ class KFServingClient:
 
     def __init__(self, control_url: str,
                  ingress_url: Optional[str] = None,
-                 timeout_s: float = DEFAULT_TIMEOUT_S):
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 retry: Optional[RetryPolicy] = None):
         self.control_url = control_url.rstrip("/")
         self.ingress_url = (ingress_url or "").rstrip("/") or None
         self.timeout_s = timeout_s
         self._session = None
+        # Connection-level retry (KFS_CLIENT_RETRY_* knobs): a refused
+        # or unroutable connect means the request never reached the
+        # server, so replay is safe for every verb — including the
+        # non-idempotent ones.  Errors AFTER dispatch (HTTP statuses,
+        # mid-body resets, timeouts) are never retried here: a replayed
+        # POST could double-create or double-infer.  Built lazily when
+        # not supplied (the retryable-class tuple needs aiohttp).
+        self._retry = retry
+
+    @property
+    def retry(self) -> RetryPolicy:
+        if self._retry is None:
+            import aiohttp
+
+            from kfserving_tpu.reliability import FaultInjected
+
+            self._retry = RetryPolicy.from_env(
+                "KFS_CLIENT",
+                retry_on=(aiohttp.ClientConnectorError,
+                          ConnectionRefusedError, FaultInjected))
+        return self._retry
 
     async def _ensure_session(self):
         if self._session is None:
@@ -81,17 +105,25 @@ class KFServingClient:
                        ) -> Dict[str, Any]:
         session = await self._ensure_session()
         data = json.dumps(body).encode() if body is not None else None
-        async with session.request(method, url, data=data) as resp:
-            payload = await resp.read()
-            try:
-                decoded = json.loads(payload) if payload else {}
-            except ValueError:
-                decoded = {"raw": payload.decode("utf-8", "replace")}
-            if resp.status >= 400:
-                raise ClientError(
-                    resp.status,
-                    decoded.get("error", decoded.get("raw", "")))
-            return decoded
+
+        async def attempt():
+            await faults.inject("client.request", key=url)
+            async with session.request(method, url, data=data) as resp:
+                payload = await resp.read()
+                try:
+                    decoded = json.loads(payload) if payload else {}
+                except ValueError:
+                    decoded = {"raw": payload.decode("utf-8", "replace")}
+                if resp.status >= 400:
+                    raise ClientError(
+                        resp.status,
+                        decoded.get("error", decoded.get("raw", "")))
+                return decoded
+
+        # Only pre-dispatch connection errors are classified retryable
+        # (see __init__); ClientError carries the server's verdict and
+        # is final.
+        return await self.retry.acall(attempt)
 
     # -- InferenceService CRUD (reference kf_serving_client.py:89-231) ------
     async def create(self, isvc: Any) -> Dict[str, Any]:

@@ -18,10 +18,17 @@ import itertools
 import json
 import logging
 import random
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import aiohttp
 
+from kfserving_tpu.reliability import (
+    CircuitBreaker,
+    Deadline,
+    FaultInjected,
+    TIMEOUT_HEADER,
+    faults,
+)
 from kfserving_tpu.server.http import HTTPServer, Request, Response, Router
 
 logger = logging.getLogger("kfserving_tpu.control.router")
@@ -32,7 +39,9 @@ ACTIVATOR_TIMEOUT_S = 60.0
 class IngressRouter:
     def __init__(self, controller, http_port: int = 0, seed: int = 0,
                  upstream_timeout_s: Optional[float] = None,
-                 buffer_deadline_s: Optional[float] = None):
+                 buffer_deadline_s: Optional[float] = None,
+                 breaker_factory: Optional[
+                     Callable[[str], CircuitBreaker]] = None):
         self.controller = controller  # Controller (store + reconciler)
         self.http_port = http_port
         self.upstream_timeout_s = upstream_timeout_s or ACTIVATOR_TIMEOUT_S
@@ -53,6 +62,20 @@ class IngressRouter:
         self._session = None
         self.inflight: Dict[str, int] = {}  # component_id -> gauge
         self.request_count: Dict[str, int] = {}
+        # One circuit breaker per replica host (KFS_ROUTER_BREAKER_*
+        # knobs).  half_open_max=0: recovery is NEVER a trial request —
+        # an opened breaker's host rejoins rotation only after the
+        # background health reprobe sees it answer its liveness route.
+        # Timeouts feed the breaker but (unlike connect failures) do
+        # not evict: a hung replica may still be chewing real work, so
+        # it is *skipped* and reprobed — graceful degradation instead
+        # of an error storm against a sick upstream.
+        self._breaker_factory = breaker_factory or (
+            lambda host: CircuitBreaker.from_env(
+                "KFS_ROUTER", half_open_max=0,
+                name=f"replica:{host}"))
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._reprobes: Dict[str, asyncio.Task] = {}
 
     # -- routes ------------------------------------------------------------
     def _register_routes(self):
@@ -106,10 +129,96 @@ class IngressRouter:
             orch.cluster_local_url = f"{host}:{self.http_port}"
 
     async def stop_async(self):
+        for task in self._reprobes.values():
+            task.cancel()
+        if self._reprobes:
+            await asyncio.gather(*self._reprobes.values(),
+                                 return_exceptions=True)
+        self._reprobes.clear()
         if self._session is not None:
             await self._session.close()
             self._session = None
         await self.http_server.stop()
+
+    # -- per-replica circuit breaking ---------------------------------------
+    def _breaker(self, host: str) -> CircuitBreaker:
+        breaker = self._breakers.get(host)
+        if breaker is None:
+            breaker = self._breaker_factory(host)
+            self._breakers[host] = breaker
+        return breaker
+
+    def _record_failure(self, host: str) -> None:
+        breaker = self._breaker(host)
+        breaker.record_failure()
+        if breaker.state != "closed":
+            self._ensure_reprobe(host)
+
+    def _record_success(self, host: str) -> None:
+        # Success == no failure history worth keeping (record_success
+        # clears the rolling window anyway), so drop the entry: the
+        # breaker map then holds ONLY hosts with in-window failures,
+        # staying bounded under replica churn (a healthy replica that
+        # scales away never leaves an entry behind).
+        self._breakers.pop(host, None)
+
+    def _ensure_reprobe(self, host: str) -> None:
+        task = self._reprobes.get(host)
+        if task is not None and not task.done():
+            return
+        self._reprobes[host] = asyncio.get_running_loop().create_task(
+            self._reprobe(host))
+
+    async def _reprobe(self, host: str) -> None:
+        """Background recovery path for an open breaker: poll the
+        replica's liveness route; the first success closes the breaker
+        and rejoins the host to rotation.  Gives up once the replica
+        is no longer registered anywhere (evicted / scaled away) —
+        its breaker entry is dropped with it."""
+        try:
+            first = self._breakers.get(host)
+            if first is None:
+                return
+            interval = max(0.05, first.reset_timeout_s / 2.0)
+            while self._session is not None:
+                await asyncio.sleep(interval)
+                # get(), NOT _breaker(): an eviction pops the entry
+                # mid-probe, and recreating it would leak a breaker
+                # for a dead host:port forever.
+                breaker = self._breakers.get(host)
+                if breaker is None or breaker.state == "closed":
+                    return
+                orch = self.controller.reconciler.orchestrator
+                known = any(r.host == host
+                            for cid in getattr(orch, "state", {})
+                            for r in orch.replicas(cid))
+                if not known:
+                    self._breakers.pop(host, None)
+                    return
+                if await self._probe_ok(host):
+                    logger.info("replica %s answers liveness again; "
+                                "closing its breaker", host)
+                    breaker.record_success()
+                    self._record_success(host)  # absence == closed
+                    return
+        finally:
+            # Self-deregister so replica churn can't grow the task
+            # map unboundedly (guard: a newer task may own the slot).
+            if self._reprobes.get(host) is asyncio.current_task():
+                self._reprobes.pop(host, None)
+
+    async def _probe_ok(self, host: str) -> bool:
+        """Strict positive probe for breaker recovery: only a prompt
+        2xx-4xx answer counts.  Opposite polarity from
+        `_replica_alive` — there a timeout means "busy, don't evict";
+        here it means "still not answering, keep the breaker open"."""
+        try:
+            async with self._session.get(
+                    f"http://{host}/",
+                    timeout=aiohttp.ClientTimeout(total=2.0)) as resp:
+                return resp.status < 500
+        except Exception:
+            return False
 
     # -- routing core ------------------------------------------------------
     def _entry_component(self, isvc, verb: str) -> str:
@@ -133,16 +242,44 @@ class IngressRouter:
                 return t.revision
         return targets[-1].revision
 
+    def _eligible(self, cid: str, revision: str, exclude=()):
+        """Replicas that could serve (revision match, not excluded) —
+        BEFORE breaker gating.  The single source of eligibility for
+        both the picker and _resolve's circuit-open-vs-scale-from-zero
+        distinction, so the two can never drift."""
+        return [r for r in
+                self.controller.reconciler.orchestrator.replicas(cid)
+                if r.revision == revision and r.host not in exclude]
+
     def _pick_replica(self, cid: str, revision: str,
                       exclude=()) -> Optional[str]:
-        replicas = [r for r in
-                    self.controller.reconciler.orchestrator.replicas(cid)
-                    if r.revision == revision and r.host not in exclude]
+        # A host whose breaker is open is skipped exactly like an
+        # excluded one: traffic flows to the healthy replicas while
+        # the background reprobe decides when the sick one returns.
+        # Filtering reads `state` (pure); allow() — which consumes a
+        # half-open trial slot — runs only on the replica round-robin
+        # actually picks, so candidates that lose the pick never burn
+        # their trial (matters for caller-supplied breaker factories
+        # with half_open_max > 0).
+        # .get(), never _breaker(): a host with no failure history has
+        # no entry (== closed), and creating one per filtered host
+        # would grow the map with every replica ever seen.
+        def gate(host):
+            return self._breakers.get(host)
+
+        replicas = [r for r in self._eligible(cid, revision, exclude)
+                    if gate(r.host) is None
+                    or gate(r.host).state != "open"]
         if not replicas:
             return None
-        idx = self._rr.get(cid, 0)
-        self._rr[cid] = idx + 1
-        return replicas[idx % len(replicas)].host
+        for _ in range(len(replicas)):
+            idx = self._rr.get(cid, 0)
+            self._rr[cid] = idx + 1
+            pick = replicas[idx % len(replicas)]
+            breaker = gate(pick.host)
+            if breaker is None or breaker.allow():
+                return pick.host
+        return None
 
     async def _replica_alive(self, host: str) -> bool:
         """Quick liveness probe (the server's `/` route) deciding
@@ -188,12 +325,15 @@ class IngressRouter:
                 except Exception:
                     logger.exception("evicting dead replica %s failed",
                                      host)
+                # The host is gone; its breaker (and any reprobe
+                # chasing it) goes with it.
+                self._breakers.pop(host, None)
                 logger.warning("evicted dead replica %s of %s", host, cid)
                 return
 
     async def _resolve(self, name: str, verb: str,
                        component: Optional[str] = None,
-                       exclude=()
+                       exclude=(), deadline: Optional[Deadline] = None
                        ) -> Tuple[Optional[str], Optional[str],
                                   Optional[str]]:
         """Returns (host, component_name, error)."""
@@ -212,13 +352,26 @@ class IngressRouter:
         cid = self.controller.reconciler.component_id(isvc, cname)
         host = self._pick_replica(cid, revision, exclude=exclude)
         if host is None:
-            host = await self._activate(isvc, cname, cid, revision)
+            # Distinguish "nothing registered" (scale-from-zero: spin
+            # up and buffer) from "replicas exist but every breaker is
+            # open / every host already failed" — activating there
+            # would churn scale() and park each request for the full
+            # buffer deadline, the exact error-storm amplification the
+            # breaker exists to prevent.  Shed fast instead; the
+            # reprobe (or the reconciler) restores capacity.
+            if self._eligible(cid, revision, exclude):
+                return None, cname, (f"no healthy replicas for "
+                                     f"{name}/{cname} (circuit open)")
+            host = await self._activate(isvc, cname, cid, revision,
+                                        deadline=deadline)
             if host is None:
                 return None, cname, f"no replicas for {name}/{cname}"
         return host, cname, None
 
     async def _activate(self, isvc, cname: str, cid: str,
-                        revision: str) -> Optional[str]:
+                        revision: str,
+                        deadline: Optional[Deadline] = None
+                        ) -> Optional[str]:
         """Scale-from-zero: bring up one replica and wait (activator
         buffering)."""
         logger.info("activating %s (scale from zero)", cid)
@@ -236,9 +389,14 @@ class IngressRouter:
             if pending(cid, revision) == 0 and \
                     self._pick_replica(cid, revision) is None:
                 return None
-        deadline = asyncio.get_running_loop().time() \
-            + self.buffer_deadline_s
-        while asyncio.get_running_loop().time() < deadline:
+        # Activator buffering is bounded by BOTH the router's own
+        # deadline and the request's remaining budget: parking a
+        # 2s-budget request for a 60s scale-up serves nobody.
+        budget_s = self.buffer_deadline_s
+        if deadline is not None:
+            budget_s = min(budget_s, max(0.0, deadline.remaining_s()))
+        until = asyncio.get_running_loop().time() + budget_s
+        while asyncio.get_running_loop().time() < until:
             host = self._pick_replica(cid, revision)
             if host is not None:
                 return host
@@ -339,21 +497,43 @@ class IngressRouter:
             import uuid
 
             headers[REQUEST_ID_HEADER] = uuid.uuid4().hex[:16]
+        # The client's budget governs the router's OWN waiting
+        # (activator buffering, failover attempts), and the replica
+        # receives the REMAINING budget, not the original — time spent
+        # buffered at the router must not be granted twice.
+        deadline = Deadline.from_headers(headers)
 
         failed: set = set()
         gauge_cid = None
         try:
             for attempt in range(self.MAX_UPSTREAM_ATTEMPTS):
+                if deadline is not None and deadline.expired:
+                    return Response(
+                        body=b'{"error": "request deadline exceeded '
+                             b'(router)"}',
+                        status=504)
                 host, cname, err = await self._resolve(
-                    name, verb, component, exclude=failed)
+                    name, verb, component, exclude=failed,
+                    deadline=deadline)
                 if err is not None:
                     # Unknown service/component is a true 404; replica
                     # exhaustion (e.g. after evicting a crashed one) is
                     # transient unavailability and must stay 503 so
                     # clients keep retrying.
                     status = (503 if err.startswith(("no replicas",
+                                                     "no healthy",
                                                      "no traffic"))
                               else 404)
+                    if status == 503 and deadline is not None \
+                            and deadline.expired:
+                        # The budget died while we buffered/failed
+                        # over: every other expiry path answers 504,
+                        # and telling the client to retry a request
+                        # whose budget is spent helps nobody.
+                        return Response(
+                            body=b'{"error": "request deadline '
+                                 b'exceeded (router buffering)"}',
+                            status=504)
                     # json.dumps, not f-string interpolation: err embeds
                     # the client-supplied model name (may contain quotes).
                     resp_headers = {}
@@ -379,9 +559,34 @@ class IngressRouter:
                         total=None, sock_connect=10.0,
                         sock_read=self.upstream_timeout_s)
                 try:
+                    # Chaos hook: an injected error exercises the same
+                    # pre-dispatch failover path a refused connection
+                    # would (FaultInjected is handled with
+                    # ClientConnectorError below), and an injected
+                    # hang sits under the SAME timeout envelope a hung
+                    # replica would — wait_for turns hang_s into the
+                    # TimeoutError branch (breaker food), not a silent
+                    # stall aiohttp's own timeout cannot see.  The
+                    # configured() guard keeps the no-faults hot path
+                    # at one dict lookup (no Task/timer allocation).
+                    if faults.configured("router.dispatch"):
+                        await asyncio.wait_for(
+                            faults.inject("router.dispatch", key=url),
+                            timeout=self.upstream_timeout_s)
+                    # Forwarded budget computed AFTER the fault sleep:
+                    # injected (or real) pre-dispatch latency must
+                    # come out of the replica's remaining budget, or
+                    # that time is granted twice.
+                    if deadline is not None:
+                        headers[TIMEOUT_HEADER] = str(max(
+                            1, int(deadline.remaining_s() * 1000)))
                     upstream = await self._session.request(
                         req.method, url, data=req.body or None,
                         headers=headers, **request_kwargs)
+                    # Any completed HTTP exchange means the transport
+                    # to this replica works (the status is the app's
+                    # verdict, not the wire's).
+                    self._record_success(host)
                     if stream_ok and upstream.headers.get(
                             "content-type", "").startswith(
                                 "text/event-stream"):
@@ -408,11 +613,18 @@ class IngressRouter:
                     # compile): do NOT evict (it would kill in-flight
                     # work) and do NOT retry (the request may still
                     # execute — a retry would duplicate inference).
+                    # The breaker DOES count it: enough consecutive
+                    # hangs open it, rotation skips the replica, and
+                    # the health reprobe decides when it returns —
+                    # degradation to the healthy replicas instead of
+                    # feeding every request into a 60s timeout.
                     logger.warning("proxy to %s timed out", url)
+                    self._record_failure(host)
                     return Response(
                         body=b'{"error": "upstream timeout"}',
                         status=504)
-                except aiohttp.ClientConnectorError as e:
+                except (aiohttp.ClientConnectorError,
+                        FaultInjected) as e:
                     # PRE-dispatch connection failure (refused / no
                     # route): the request never reached the replica, so
                     # a retry cannot duplicate inference — evict and
@@ -420,6 +632,7 @@ class IngressRouter:
                     # never retried.
                     logger.warning("proxy to %s failed (attempt %d): %s",
                                    url, attempt + 1, e)
+                    self._record_failure(host)
                     await self._mark_failed_and_evict(
                         name, cname, host, failed)
                 except aiohttp.ClientError as e:
@@ -444,6 +657,7 @@ class IngressRouter:
                     # need dedup should key on the logger's request id.
                     logger.warning("proxy to %s failed mid-request: %s",
                                    url, e)
+                    self._record_failure(host)
                     if await self._replica_alive(host):
                         return Response(
                             body=b'{"error": "upstream connection '

@@ -84,6 +84,11 @@ class _Request:
     logprobs: int = 0         # top-N logprobs per token; 0 = off
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
     cancelled: bool = False
+    # Request latency budget (captured from the ambient contextvar at
+    # submit): the scheduler expires the request between decode waves
+    # with terminal reason "timeout" — partial text is delivered, the
+    # slot frees instead of decoding to the token budget.
+    deadline: Optional[Any] = None
     # Per-token logprob records appended by the scheduler in emit
     # order (chosen logprob, [(token_id, logprob)] top-N); consumers
     # read them aligned with the token stream.
@@ -612,10 +617,13 @@ class GenerationEngine:
         if seed is None:
             seed = self._seed_counter
             self._seed_counter += 1
+        from kfserving_tpu.reliability.deadline import current_deadline
+
         req = _Request(ids, budget, float(temperature),
                        top_k=int(top_k), top_p=float(top_p),
                        seed=int(seed) & 0x7FFFFFFF,
-                       logprobs=int(logprobs))
+                       logprobs=int(logprobs),
+                       deadline=current_deadline())
         self._pending.append(req)
         self._ensure_loop()
         return req
@@ -973,8 +981,32 @@ class GenerationEngine:
                 item[1].add_done_callback(
                     lambda f: f.cancelled() or f.exception())
 
+    def _expire_deadlines(self) -> None:
+        """Between decode waves: requests whose budget ran out get a
+        terminal "timeout" event and free their slot (active) or leave
+        the queue (pending) — the engine never spends another wave on
+        a request nobody is still waiting for."""
+        for i, s in enumerate(self._slots):
+            if s is not None and s.req.deadline is not None \
+                    and s.req.deadline.expired:
+                s.req.out.put_nowait((None, "timeout"))
+                self._free_slot_state(i)
+                self.requests_finished += 1
+        if any(r.deadline is not None and r.deadline.expired
+               for r in self._pending):
+            keep = deque()
+            while self._pending:
+                r = self._pending.popleft()
+                if r.deadline is not None and r.deadline.expired:
+                    r.out.put_nowait((None, "timeout"))
+                    self.requests_finished += 1
+                else:
+                    keep.append(r)
+            self._pending = keep
+
     async def _run_pipeline(self, loop, inflight: deque):
         while not self._closed:
+            self._expire_deadlines()
             admitted = False
             while self._pending and self._free_slot() is not None:
                 group, slots, bucket, dest_rows = \

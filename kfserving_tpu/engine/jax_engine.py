@@ -205,8 +205,16 @@ class JaxEngine:
 
     # -- execution -----------------------------------------------------------
     def _execute_sync(self, inputs: Any) -> Any:
+        from kfserving_tpu.reliability.deadline import check_deadline
         from kfserving_tpu.tracing import tracer
 
+        # Last stop before device work: the caller's context (and so
+        # its deadline) rode into this worker thread via ctx.run — an
+        # over-budget request fails 504 here instead of occupying the
+        # chip.  Batched executions carry no ambient deadline (the
+        # batcher clears it; per-request budgets were settled at the
+        # queue edge).
+        check_deadline("engine dispatch")
         with tracer.span("engine.execute") as span:
             t0 = time.perf_counter()
             padded, n = self._prepare(inputs)

@@ -164,7 +164,11 @@ def paged_attention(q, pool_k, pool_v, block_table, lengths):
     stats scratch's 128 lanes), XLA gather otherwise (CPU tests, odd
     shapes).  KFS_DISABLE_PAGED_KERNEL=1 forces the XLA path — the
     on-chip A/B kill-switch, mirroring the flash kernel's
-    KFS_DISABLE_FLASH."""
+    KFS_DISABLE_FLASH.  NOTE: this branch runs at TRACE time inside
+    the jitted decode function, so the env var is read once at the
+    first decode compile (effectively process start); flipping it
+    later has no effect in-process — restart the replica to switch
+    paths (same semantics as KFS_DISABLE_FLASH)."""
     import os
 
     from kfserving_tpu.ops.attention import _tpu_backend

@@ -22,11 +22,18 @@ config.json schema:
       "max_new_tokens": 64,        # default generation budget
       "temperature": 0.0,          # default sampling temperature
       "tokenizer": "byte",         # "byte" | "hf:<name>"
-      "block_size": 64,            # paged KV cache (optional): HBM
+      "block_size": 128,           # paged KV cache (optional): HBM
       "cache_blocks": 48,          #   scales with resident tokens,
                                    #   shared prompt prefixes share
                                    #   blocks; default pool = dense
-                                   #   parity (max_slots*max_seq)
+                                   #   parity (max_slots*max_seq).
+                                   #   NOTE: the TPU Pallas paged
+                                   #   kernel requires block_size to
+                                   #   be a multiple of 128 (lane
+                                   #   width); other sizes serve
+                                   #   correctly but fall back to the
+                                   #   slower XLA gather path (logged
+                                   #   once at load)
       "mesh": {"tp": 2}            # within-replica tensor parallelism
     }
 
@@ -70,6 +77,24 @@ logger = logging.getLogger("kfserving_tpu.llm")
 
 BOS_ID = 256
 EOS_ID = 257
+
+_warned_block_size = False
+
+
+def _warn_paged_kernel_ineligible(block_size: int) -> None:
+    """One warning per process: a block_size that isn't a 128-multiple
+    silently loses the Pallas paged-kernel speedup on TPU (the XLA
+    gather fallback serves correctly) — surface the config smell
+    instead of hiding a perf cliff (ADVICE r5)."""
+    global _warned_block_size
+    if _warned_block_size:
+        return
+    _warned_block_size = True
+    logger.warning(
+        "block_size=%d is not a multiple of 128: the TPU Pallas paged-"
+        "attention kernel is ineligible and decode uses the slower XLA "
+        "gather path. Use a 128-multiple block_size to enable it.",
+        block_size)
 
 
 def _find_stop(text: str, stops: List[str]) -> int:
@@ -120,7 +145,8 @@ class IncrementalDecoder:
       so per-token work stays O(window), not O(generated-so-far).
     """
 
-    def __init__(self, tokenizer, stops: List[str]):
+    def __init__(self, tokenizer, stops: List[str],
+                 history: Optional[List[int]] = None):
         self.tok = tokenizer
         self.stops = stops
         self.max_stop = max((len(s) for s in stops), default=0)
@@ -131,17 +157,37 @@ class IncrementalDecoder:
         #                        tokenizer): deltas go best-effort and
         #                        the terminal text must come from a
         #                        full decode
+        # Full token history, read only by the degraded path.  Callers
+        # that already keep one (and append BEFORE each push) share it
+        # via `history` so the fast path never stores a duplicate
+        # O(generation) list next to the deliberately-bounded window.
+        self._all: List[int] = [] if history is None else history
+        self._owns_history = history is None
+        self._final: Optional[str] = None  # degraded-stop truncation
 
     def push(self, token: int):
         """Feed one token; returns (delta, stopped).  `delta` is the
         newly releasable text (possibly empty); `stopped` means a stop
         sequence matched — delta then ends exactly before the match
         and the caller must stop the stream."""
+        if self._owns_history:
+            self._all.append(token)
+        if self.degraded:
+            return "", self._degraded_stop()
         self._pending.append(token)
         ptext = self.tok.decode(self._pending)
         if not ptext.startswith(self._p_emitted):
+            # Decode rewrote already-emitted text: incremental deltas
+            # are no longer trustworthy, but stop matching must NOT
+            # silently vanish with them (ADVICE r5) — it falls back to
+            # scanning the full re-decoded history each token.
             self.degraded = True
-            return "", False
+            if self.stops:
+                logger.warning(
+                    "tokenizer decode rewrote emitted text; stop-"
+                    "sequence matching degraded to full re-decode "
+                    "(deltas suspended, stops still honored)")
+            return "", self._degraded_stop()
         rest = ptext[len(self._p_emitted):]
         if self.stops:
             idx = _find_stop(rest, self.stops)
@@ -158,9 +204,32 @@ class IncrementalDecoder:
         self._emit(candidate, ptext)
         return candidate, False
 
+    def _degraded_stop(self) -> bool:
+        """Degraded-mode stop matching.  The common per-token check
+        decodes only a bounded token tail (stops are short; the window
+        gives each stop char 4x token slack), so a long degraded
+        generation stays O(n·window), not O(n²).  Only a tail HIT pays
+        one full re-decode — which both confirms the match against the
+        authoritative text and yields the exact truncation index for
+        `text()`."""
+        if not self.stops:
+            return False
+        window = self.max_stop * 4 + 16
+        tail = self.tok.decode(self._all[-window:])
+        if _find_stop(tail, self.stops) < 0:
+            return False
+        full = self.tok.decode(self._all)
+        idx = _find_stop(full, self.stops)
+        if idx < 0:  # tail boundary artifact, not a real match
+            return False
+        self._final = full[:idx]
+        return True
+
     def finish(self) -> str:
         """Flush everything still held (no stop matched); returns the
         final delta."""
+        if self.degraded:
+            return ""
         ptext = self.tok.decode(self._pending)
         if not ptext.startswith(self._p_emitted):
             self.degraded = True
@@ -171,7 +240,11 @@ class IncrementalDecoder:
 
     def text(self) -> str:
         """Text emitted so far (== the full truncated output after a
-        stop, or the full output after finish())."""
+        stop, or the full output after finish()).  After a degraded-
+        mode stop this is the truncated full decode; other degraded
+        outcomes leave the terminal text to the caller's full decode."""
+        if self._final is not None:
+            return self._final
         return "".join(self._sent)
 
     # Tokens of context kept across window compaction: a window that
@@ -340,6 +413,8 @@ class GenerativeModel(Model):
                 overrides=self.config_overrides)
             self.config = cfg
         self.tokenizer = build_tokenizer(cfg.tokenizer)
+        if cfg.block_size is not None and cfg.block_size % 128 != 0:
+            _warn_paged_kernel_ineligible(cfg.block_size)
 
         spec = create_model(cfg.architecture, **cfg.arch_kwargs)
         variables = init_params(spec, seed=0)
@@ -449,8 +524,11 @@ class GenerativeModel(Model):
 
     async def _run_one(self, parsed: Dict[str, Any]) -> Dict[str, Any]:
         req = self._submit(parsed)
-        decoder = IncrementalDecoder(self.tokenizer, parsed["stop"])
         tokens: List[int] = []
+        # tokens is appended BEFORE each push, so the decoder's
+        # degraded path can share it instead of duplicating history.
+        decoder = IncrementalDecoder(self.tokenizer, parsed["stop"],
+                                     history=tokens)
         reason = "length"
         async for token, fin in self.engine.stream(req):
             if token is not None:
@@ -466,6 +544,14 @@ class GenerativeModel(Model):
                                         "stop", parsed)
             if fin is not None:
                 reason = fin
+        if reason == "timeout" and not tokens:
+            # Budget died in the queue before a single token: a clean
+            # 504 beats an empty 200.  With partial text, deliver it
+            # with finish_reason "timeout" (the client paid for those
+            # tokens; the engine freed the slot either way).
+            from kfserving_tpu.reliability import DeadlineExceeded
+
+            raise DeadlineExceeded("generation")
         decoder.finish()
         text = (self.tokenizer.decode(tokens) if decoder.degraded
                 else decoder.text())
@@ -551,7 +637,10 @@ class GenerativeModel(Model):
         async def events():
             nonlocal finished
             collected: List[int] = []
-            decoder = IncrementalDecoder(self.tokenizer, stops)
+            # collected is appended BEFORE each push (shared history,
+            # see IncrementalDecoder.__init__).
+            decoder = IncrementalDecoder(self.tokenizer, stops,
+                                         history=collected)
 
             def token_event(token, text_delta):
                 event = {"token": {"id": int(token),

@@ -1,0 +1,44 @@
+"""End-to-end reliability substrate: deadlines, retries, breakers, faults.
+
+The reference survives flaky storage, slow models, and overload with
+infrastructure the cluster provides for free — queue-proxy timeouts,
+sidecar retries, kubelet probes (SURVEY.md §5.3).  A single-host fabric
+owns those behaviors itself:
+
+- `Deadline` — a per-request latency budget minted at ingress
+  (`x-request-timeout-ms` / gRPC deadline) and carried through the
+  stack by contextvar, so every layer (dataplane, batcher queue,
+  engine dispatch, decode loop) can shed work that can no longer
+  meet its budget instead of wasting device time on it (the
+  InferLine per-stage deadline discipline, arxiv 1812.01776).
+- `RetryPolicy` — exponential backoff + jitter with retryable-error
+  classification, wrapping idempotent I/O edges (artifact downloads,
+  model pulls, pre-dispatch client connects — the TensorFlow-Serving
+  retried-model-load discipline, arxiv 1712.06139).
+- `CircuitBreaker` — closed/open/half-open with a rolling failure
+  window; the router keeps one per replica so a sick upstream is
+  skipped (and health-reprobed) instead of feeding an error storm.
+- `faults` — the injection harness that keeps the rest honest: tests
+  and soak runs inject deterministic error-rate / added-latency /
+  hang faults at each wrapped edge (env `KFS_FAULTS` or programmatic).
+"""
+
+from kfserving_tpu.reliability.breaker import CircuitBreaker
+from kfserving_tpu.reliability.deadline import (
+    Deadline,
+    DeadlineExceeded,
+    TIMEOUT_HEADER,
+    clear_deadline,
+    current_deadline,
+    deadline_scope,
+)
+from kfserving_tpu.reliability.faults import FaultInjected, faults
+from kfserving_tpu.reliability.retry import RetryPolicy
+
+__all__ = [
+    "CircuitBreaker",
+    "Deadline", "DeadlineExceeded", "TIMEOUT_HEADER",
+    "clear_deadline", "current_deadline", "deadline_scope",
+    "FaultInjected", "faults",
+    "RetryPolicy",
+]

@@ -1,0 +1,209 @@
+"""Deterministic fault injection at the reliability layer's edges.
+
+Every retry/breaker-wrapped edge calls into the process-global
+`faults` injector with a stable site name before doing real work:
+
+    storage.download    Storage.download per-scheme dispatch
+    agent.pull          Downloader.download (the agent's model pull)
+    client.request      KFServingClient HTTP calls
+    router.dispatch     IngressRouter upstream proxy attempts
+
+A site with no configuration costs one dict lookup (the common case).
+Configuration comes from the `KFS_FAULTS` env var (JSON object keyed
+by site) or programmatically (`faults.configure({...})`, tests):
+
+    KFS_FAULTS='{"storage.download": {"error_rate": 0.1, "seed": 7},
+                 "router.dispatch":  {"latency_ms": 50, "match": ":9001"}}'
+
+Per-site knobs:
+
+    error_rate   probability of raising FaultInjected (seeded RNG —
+                 the sequence of outcomes is deterministic per site)
+    fail_first   deterministically fail the first N matching calls
+                 (then stop — the retry-then-succeed test shape)
+    latency_ms   added delay per call
+    hang_s       long sleep per call (simulates a hung dependency;
+                 timeout-wrapped edges like the router convert it
+                 into the same TimeoutError a real hang produces,
+                 so it feeds breakers, not silent stalls)
+    match        substring that must appear in the call's `key`
+                 (e.g. a replica host:port) for the fault to apply
+    seed         RNG seed for error_rate draws (default 0)
+
+`FaultInjected` subclasses ConnectionError on purpose: every wrapped
+edge already classifies connection-level errors as transient, so an
+injected fault exercises exactly the retry/breaker path a real
+network flake would.
+"""
+
+import asyncio
+import json
+import logging
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+logger = logging.getLogger("kfserving_tpu.reliability.faults")
+
+ENV_VAR = "KFS_FAULTS"
+
+
+class FaultInjected(ConnectionError):
+    """An injected failure (classified transient by retry policies)."""
+
+    def __init__(self, site: str, key: str = ""):
+        detail = f" ({key})" if key else ""
+        super().__init__(f"injected fault at {site}{detail}")
+        self.site = site
+
+
+@dataclass
+class FaultSpec:
+    error_rate: float = 0.0
+    fail_first: int = 0
+    latency_ms: float = 0.0
+    hang_s: float = 0.0
+    match: str = ""
+    seed: int = 0
+    # Per-spec mutable state.
+    calls: int = 0
+    injected: int = 0
+    rng: random.Random = field(default_factory=random.Random,
+                               repr=False)
+
+    def __post_init__(self):
+        self.rng = random.Random(self.seed)
+
+
+class FaultInjector:
+    """Process-global registry of per-site fault specs."""
+
+    def __init__(self):
+        self._sites: Dict[str, FaultSpec] = {}
+        self._env_loaded = False
+
+    # Config-surface knobs (name -> coercion); the dataclass's
+    # bookkeeping fields (calls/injected/rng) are NOT settable —
+    # accepting them would silently disable fail_first counting.
+    # Values coerce at CONFIG time: a JSON string "0.5" from KFS_FAULTS
+    # must fail here, not as a TypeError inside the serving path.
+    _KNOBS = {"error_rate": float, "fail_first": int,
+              "latency_ms": float, "hang_s": float,
+              "match": str, "seed": int}
+
+    # -- configuration -------------------------------------------------------
+    def configure(self, config: Dict[str, Dict]) -> None:
+        """Install per-site specs (replaces those sites; other sites
+        keep their existing spec).  Unknown keys are rejected loudly —
+        a typo'd knob must not silently disable a chaos test — and
+        validation is all-or-nothing: a bad spec for one site installs
+        NOTHING (a half-applied fault config is the worst kind of
+        lie)."""
+        specs = {}
+        for site, raw in config.items():
+            unknown = set(raw) - set(self._KNOBS)
+            if unknown:
+                raise TypeError(
+                    f"unknown fault knob(s) {sorted(unknown)} for "
+                    f"site {site!r} (valid: {sorted(self._KNOBS)})")
+            coerced = {}
+            for knob, value in raw.items():
+                try:
+                    coerced[knob] = self._KNOBS[knob](value)
+                except (TypeError, ValueError):
+                    raise TypeError(
+                        f"fault knob {knob}={value!r} for site "
+                        f"{site!r} is not "
+                        f"{self._KNOBS[knob].__name__}-coercible")
+            specs[site] = FaultSpec(**coerced)
+        self._sites.update(specs)
+        self._env_loaded = True  # explicit config wins over env
+
+    def reset(self) -> None:
+        """Drop all fault specs (tests call this in teardown)."""
+        self._sites.clear()
+        self._env_loaded = False
+
+    def _load_env(self) -> None:
+        if self._env_loaded:
+            return
+        self._env_loaded = True
+        raw = os.environ.get(ENV_VAR)
+        if not raw:
+            return
+        try:
+            config = json.loads(raw)
+        except ValueError:
+            logger.error("malformed %s (not JSON); no faults active",
+                         ENV_VAR)
+            return
+        try:
+            self.configure(config)
+        except TypeError as e:
+            logger.error("bad fault spec in %s: %s", ENV_VAR, e)
+        else:
+            logger.warning("fault injection ACTIVE at sites: %s",
+                           ", ".join(sorted(config)))
+
+    def configured(self, site: str) -> bool:
+        """Cheap hot-path guard: is any spec installed for `site`?
+        Lets latency-critical callers skip wrapper machinery (e.g. a
+        wait_for envelope) in the no-faults production case."""
+        self._load_env()
+        return site in self._sites
+
+    def _spec(self, site: str, key: str) -> Optional[FaultSpec]:
+        self._load_env()
+        spec = self._sites.get(site)
+        if spec is None:
+            return None
+        if spec.match and spec.match not in key:
+            return None
+        return spec
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        return {site: {"calls": s.calls, "injected": s.injected}
+                for site, s in self._sites.items()}
+
+    # -- injection -----------------------------------------------------------
+    def _decide(self, spec: FaultSpec, site: str, key: str
+                ) -> Optional[FaultInjected]:
+        spec.calls += 1
+        if spec.fail_first and spec.calls <= spec.fail_first:
+            spec.injected += 1
+            return FaultInjected(site, key)
+        if spec.error_rate > 0 and spec.rng.random() < spec.error_rate:
+            spec.injected += 1
+            return FaultInjected(site, key)
+        return None
+
+    def inject_sync(self, site: str, key: str = "") -> None:
+        """Executor-thread edges (storage): blocking sleeps."""
+        spec = self._spec(site, key)
+        if spec is None:
+            return
+        if spec.latency_ms:
+            time.sleep(spec.latency_ms / 1000.0)
+        if spec.hang_s:
+            time.sleep(spec.hang_s)
+        err = self._decide(spec, site, key)
+        if err is not None:
+            raise err
+
+    async def inject(self, site: str, key: str = "") -> None:
+        """Event-loop edges (client, router): async sleeps."""
+        spec = self._spec(site, key)
+        if spec is None:
+            return
+        if spec.latency_ms:
+            await asyncio.sleep(spec.latency_ms / 1000.0)
+        if spec.hang_s:
+            await asyncio.sleep(spec.hang_s)
+        err = self._decide(spec, site, key)
+        if err is not None:
+            raise err
+
+
+faults = FaultInjector()

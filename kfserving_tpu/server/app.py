@@ -284,6 +284,7 @@ class ModelServer:
         return await self._inference(req, "explain", self.dataplane.explain)
 
     async def _inference(self, req: Request, verb: str, op) -> Response:
+        from kfserving_tpu.reliability import Deadline
         from kfserving_tpu.tracing import (
             REQUEST_ID_HEADER,
             ensure_request_id,
@@ -291,13 +292,21 @@ class ModelServer:
 
         name = req.path_params["name"]
         rid = ensure_request_id(req.headers)
+        # Per-request budget (x-request-timeout-ms): minted here at
+        # ingress, carried by contextvar through dataplane, batcher
+        # queue, and engine dispatch — each stage sheds the request
+        # with 504 the moment the budget is spent instead of wasting
+        # device work on an answer nobody is waiting for.
+        deadline = Deadline.from_headers(req.headers)
         start = time.perf_counter()
         if self._admission is not None:
-            if not await self._admission.enter():
+            admitted = await self._enter_admission(deadline)
+            if admitted is not True:
+                status, error = self._shed_reason(admitted)
                 latency_ms = (time.perf_counter() - start) * 1000.0
-                resp = _json(
-                    {"error": "concurrency limit exceeded"}, status=503)
-                self.metrics.observe_request(name, verb, 503, latency_ms)
+                resp = _json({"error": error}, status=status)
+                self.metrics.observe_request(name, verb, status,
+                                             latency_ms)
                 # Shed requests still reach the hooks: the payload logger
                 # must not go blind exactly during overload.
                 for hook in self.request_hooks:
@@ -309,28 +318,66 @@ class ModelServer:
                 return resp
             try:
                 resp = await self._inference_inner(
-                    req, verb, op, name, start)
+                    req, verb, op, name, start, deadline)
             finally:
                 self._admission.exit()
         else:
-            resp = await self._inference_inner(req, verb, op, name, start)
+            resp = await self._inference_inner(req, verb, op, name,
+                                               start, deadline)
         resp.headers[REQUEST_ID_HEADER] = rid
         return resp
 
+    @staticmethod
+    def _shed_reason(admitted: Optional[bool]):
+        """Status + message for a failed admission.  False: queue full
+        (503, the load balancer retries elsewhere).  None: the budget
+        died while queued — 504 without ever holding a slot, so an
+        engine batch slot is never consumed for it."""
+        if admitted is False:
+            return 503, "concurrency limit exceeded"
+        return 504, "request deadline exceeded (admission queue)"
+
+    async def _enter_admission(self, deadline) -> Optional[bool]:
+        """Admission with a budget-bounded queue wait: True = slot
+        held, False = queue full (503), None = deadline expired while
+        queued (504).  wait_for's cancellation is safe against the
+        grant race: _AdmissionGate.enter() hands an already-granted
+        slot to the next waiter when cancelled."""
+        if deadline is None:
+            return await self._admission.enter()
+        remaining = deadline.remaining_s()
+        if remaining <= 0:
+            return None
+        try:
+            return await asyncio.wait_for(self._admission.enter(),
+                                          timeout=remaining)
+        except asyncio.TimeoutError:
+            return None
+
     async def _inference_inner(self, req: Request, verb: str, op,
-                               name: str, start: float) -> Response:
+                               name: str, start: float,
+                               deadline=None) -> Response:
+        from kfserving_tpu.reliability import deadline_scope
         from kfserving_tpu.tracing import tracer
 
         status = 200
         try:
-            with tracer.span("server.decode", model=name, verb=verb):
-                body = self.dataplane.decode_body(
-                    req.headers, req.body,
-                    dtype_hint=self.dataplane.wire_dtype_hint(name))
-            with tracer.span("server.infer", model=name, verb=verb):
-                response = await op(name, body)
-            with tracer.span("server.encode", model=name, verb=verb):
-                resp = self._encode_response(req, body, response)
+            if deadline is not None and deadline.expired:
+                # Budget spent waiting for the admission slot: 504
+                # without touching decode or the engine (the slot is
+                # released by the caller's finally).
+                from kfserving_tpu.reliability import DeadlineExceeded
+
+                raise DeadlineExceeded("admission queue")
+            with deadline_scope(deadline):
+                with tracer.span("server.decode", model=name, verb=verb):
+                    body = self.dataplane.decode_body(
+                        req.headers, req.body,
+                        dtype_hint=self.dataplane.wire_dtype_hint(name))
+                with tracer.span("server.infer", model=name, verb=verb):
+                    response = await op(name, body)
+                with tracer.span("server.encode", model=name, verb=verb):
+                    resp = self._encode_response(req, body, response)
         except ServingError as e:
             status = e.status_code
             resp = _error(e)
@@ -400,6 +447,13 @@ class ModelServer:
 
         name = req.path_params["name"]
         rid = ensure_request_id(req.headers)
+        # Budget applies to submission AND rides into the engine
+        # request (captured at submit): a stream whose budget expires
+        # mid-generation finishes with reason "timeout" instead of
+        # holding its decode slot to the token budget.
+        from kfserving_tpu.reliability import Deadline, deadline_scope
+
+        deadline = Deadline.from_headers(req.headers)
         if body is None:
             try:
                 body = json.loads(req.body) if req.body else {}
@@ -414,16 +468,18 @@ class ModelServer:
         # handler).
         gated = False
         if self._admission is not None:
-            if not await self._admission.enter():
-                resp = _json({"error": "concurrency limit exceeded"},
-                             status=503)
+            admitted = await self._enter_admission(deadline)
+            if admitted is not True:
+                status, error = self._shed_reason(admitted)
+                resp = _json({"error": error}, status=status)
                 self.metrics.observe_request(name, "generate_stream",
-                                             503, 0.0)
+                                             status, 0.0)
                 resp.headers[REQUEST_ID_HEADER] = rid
                 return resp
             gated = True
         try:
-            events = await self.dataplane.generate_stream(name, body)
+            with deadline_scope(deadline):
+                events = await self.dataplane.generate_stream(name, body)
         except ServingError as e:
             if gated:
                 self._admission.exit()

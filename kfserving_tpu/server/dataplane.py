@@ -19,6 +19,10 @@ from kfserving_tpu.protocol.errors import (
     ModelNotReady,
 )
 from kfserving_tpu.protocol.v2 import InferRequest
+from kfserving_tpu.reliability.deadline import (
+    check_deadline,
+    deadline_scope,
+)
 
 SERVER_NAME = "kfserving-tpu"
 
@@ -62,12 +66,21 @@ class DataPlane:
     # -- inference ---------------------------------------------------------
     async def get_model(self, name: str) -> Model:
         """Fetch a model, lazily loading on first use like the reference
-        (handlers/http.py:32-41)."""
+        (handlers/http.py:32-41).
+
+        The load runs OUTSIDE the request's deadline scope: a lazy
+        load (download + compile grid, multi-second) is shared state
+        benefiting every future request, so one short-budget client
+        must not abort it mid-warmup — that would discard the compile
+        work and make each budgeted request restart the same doomed
+        load.  The triggering request's own budget is still enforced
+        by the caller's check right after this returns."""
         model = self.repository.get_model(name)
         if model is None:
             raise ModelNotFound(name)
         if not model.ready:
-            await maybe_await(model.load())
+            with deadline_scope(None):
+                await maybe_await(model.load())
         return model
 
     def wire_dtype_hint(self, name: str) -> Any:
@@ -111,21 +124,30 @@ class DataPlane:
             raise InvalidInput(f"Unrecognized request format: {e}")
 
     async def infer(self, name: str, body: Any) -> Any:
+        # Stage-boundary deadline checks (InferLine discipline): a
+        # request already over budget after a lazy model load or a
+        # slow preprocess fails 504 HERE, before the model/batcher
+        # spends a slot on it.
         model = await self.get_model(name)
+        check_deadline("dataplane.infer")
         request = await model.preprocess(body)
         request = self.validate(request)
+        check_deadline("dataplane.infer preprocess")
         response = await maybe_await(model.predict(request))
         return await model.postprocess(response)
 
     async def explain(self, name: str, body: Any) -> Any:
         model = await self.get_model(name)
+        check_deadline("dataplane.explain")
         request = await model.preprocess(body)
         request = self.validate(request)
+        check_deadline("dataplane.explain preprocess")
         response = await maybe_await(model.explain(request))
         return await model.postprocess(response)
 
     async def generate(self, name: str, body: Any) -> Any:
         model = await self.get_model(name)
+        check_deadline("dataplane.generate")
         generate = getattr(model, "generate", None)
         if generate is None:
             raise InvalidInput(

@@ -138,7 +138,19 @@ def _output_to_tensor(out: Dict[str, Any], response, use_raw: bool
 
 
 _STATUS_BY_CODE = {404: "NOT_FOUND", 400: "INVALID_ARGUMENT",
-                   503: "UNAVAILABLE"}
+                   503: "UNAVAILABLE", 504: "DEADLINE_EXCEEDED"}
+
+
+def _deadline_from(context):
+    """The caller's gRPC deadline as a reliability Deadline, carried
+    through the same contextvar channel the HTTP header uses — one
+    budget discipline, two wire protocols."""
+    from kfserving_tpu.reliability import Deadline
+
+    remaining = context.time_remaining()
+    if remaining is None:
+        return None
+    return Deadline(remaining)
 
 
 class GRPCServer:
@@ -201,10 +213,13 @@ class GRPCServer:
         return resp
 
     async def ModelInfer(self, request, context):
+        from kfserving_tpu.reliability import deadline_scope
+
         try:
             infer_req = _request_to_infer(request)
-            result = await self.dataplane.infer(
-                request.model_name, infer_req)
+            with deadline_scope(_deadline_from(context)):
+                result = await self.dataplane.infer(
+                    request.model_name, infer_req)
         except Exception as e:
             await self._abort(context, e)
         response = pb2.ModelInferResponse(
@@ -234,10 +249,12 @@ class GRPCServer:
 
     async def Generate(self, request, context):
         from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
+        from kfserving_tpu.reliability import deadline_scope
 
         try:
-            result = await self.dataplane.generate(
-                request.model_name, self._generate_body(request))
+            with deadline_scope(_deadline_from(context)):
+                result = await self.dataplane.generate(
+                    request.model_name, self._generate_body(request))
         except Exception as e:
             await self._abort(context, e)
         details = result.get("details", {})
@@ -249,6 +266,14 @@ class GRPCServer:
         for rec in details.get("logprobs", []) or []:
             resp.chosen_logprobs.add(id=rec["id"],
                                      logprob=rec["logprob"])
+            # Full logprob parity with the HTTP generate surface: the
+            # top-N alternatives ride a Token per generated token
+            # (text stays empty — text_output carries the aggregate).
+            tok = resp.tokens.add(id=rec["id"],
+                                  logprob=rec["logprob"])
+            for top in rec.get("top", []) or []:
+                tok.top_logprobs.add(id=top["id"],
+                                     logprob=top["logprob"])
         return resp
 
     async def GenerateStream(self, request, context):
@@ -259,11 +284,16 @@ class GRPCServer:
         stream); consumer cancellation propagates to the engine via
         the event stream's close hook."""
         from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
+        from kfserving_tpu.reliability import deadline_scope
         from kfserving_tpu.streams import aclose_quietly
 
         try:
-            events = await self.dataplane.generate_stream(
-                request.model_name, self._generate_body(request))
+            # The deadline covers validation + submission and rides
+            # into the engine request: an over-budget stream finishes
+            # with reason "timeout" at the next decode-wave boundary.
+            with deadline_scope(_deadline_from(context)):
+                events = await self.dataplane.generate_stream(
+                    request.model_name, self._generate_body(request))
         except Exception as e:
             await self._abort(context, e)
         try:

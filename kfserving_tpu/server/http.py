@@ -26,7 +26,8 @@ STATUS_PHRASES = {
     200: "OK", 204: "No Content", 400: "Bad Request", 404: "Not Found",
     405: "Method Not Allowed", 411: "Length Required",
     413: "Payload Too Large", 500: "Internal Server Error",
-    503: "Service Unavailable",
+    502: "Bad Gateway", 503: "Service Unavailable",
+    504: "Gateway Timeout",
 }
 
 

@@ -17,6 +17,12 @@ library is absent (this environment is hermetic).  Downloads are idempotent
 via `SUCCESS.<sha256(uri)>` marker files, the same scheme the reference Go
 agent uses to skip completed pulls across restarts
 (reference pkg/agent/downloader.go:42-75).
+
+Remote downloads retry with exponential backoff (`KFS_STORAGE_RETRY_*`
+env knobs; markers make replays idempotent — the TensorFlow-Serving
+retried-model-load discipline), and the `storage.download` fault site
+lets chaos tests inject failures exactly where a flaky object store
+would produce them.
 """
 
 import glob
@@ -75,25 +81,39 @@ class Storage:
             logger.info("Found %s, skipping download of %s", marker, uri)
             return out_dir
 
-        if uri.startswith(_GCS_PREFIX):
-            Storage._download_gcs(uri, out_dir)
-        elif uri.startswith(_S3_PREFIX):
-            Storage._download_s3(uri, out_dir)
-        elif re.search(_AZURE_BLOB_RE, uri):
-            Storage._download_azure(uri, out_dir)
-        elif uri.startswith(_PVC_PREFIX):
+        if uri.startswith(_PVC_PREFIX):
             return Storage._download_local(
                 "file:///" + uri[len(_PVC_PREFIX):], out_dir)
-        elif is_local:
+        if is_local:
             return Storage._download_local(uri, out_dir)
-        elif uri.startswith(_HTTP_PREFIX):
-            Storage._download_from_uri(uri, out_dir)
-        else:
-            raise Exception(
-                "Cannot recognize storage type for " + uri +
-                "\n'%s', '%s', '%s', and '%s' are the current available "
-                "storage type." % (_GCS_PREFIX, _S3_PREFIX, _LOCAL_PREFIX,
-                                   "https://"))
+
+        # Remote pulls go through the retry policy: transient transport
+        # errors (and the `storage.download` fault site) replay with
+        # backoff — safe because the marker is only written after a
+        # full success, so a half-pulled attempt just re-pulls.
+        # Terminal errors (unknown scheme, missing SDK, HTTP 4xx) are
+        # not connection-level and fail fast.
+        from kfserving_tpu.reliability import RetryPolicy, faults
+
+        def pull():
+            faults.inject_sync("storage.download", key=uri)
+            if uri.startswith(_GCS_PREFIX):
+                Storage._download_gcs(uri, out_dir)
+            elif uri.startswith(_S3_PREFIX):
+                Storage._download_s3(uri, out_dir)
+            elif re.search(_AZURE_BLOB_RE, uri):
+                Storage._download_azure(uri, out_dir)
+            elif uri.startswith(_HTTP_PREFIX):
+                Storage._download_from_uri(uri, out_dir)
+            else:
+                raise Exception(
+                    "Cannot recognize storage type for " + uri +
+                    "\n'%s', '%s', '%s', and '%s' are the current "
+                    "available storage type." % (
+                        _GCS_PREFIX, _S3_PREFIX, _LOCAL_PREFIX,
+                        "https://"))
+
+        RetryPolicy.from_env("KFS_STORAGE").call(pull)
         with open(marker, "w") as f:
             f.write(uri)
         logger.info("Successfully copied %s to %s", uri, out_dir)

@@ -39,6 +39,12 @@ def pytest_configure(config):
         "slow: multi-process / subprocess / long-parity tests.  CI "
         "default: `pytest -m 'not slow'` (~9 min hermetic core); "
         "nightly/full: `pytest tests/` (everything)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection tests of the reliability layer "
+        "(kfserving_tpu/reliability/).  Deliberately NOT slow: the "
+        "fast tier runs them (`-m 'not slow'`), and soak runs can "
+        "select just them with `-m chaos`")
 
 
 @pytest.hookimpl(tryfirst=True)

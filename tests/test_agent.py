@@ -286,8 +286,10 @@ async def test_puller_survives_failing_op(tmp_path):
         spec = {"storageUri": f"file://{src}"}
         await puller.events.put(("load", "bad", spec))
         await puller.events.put(("load", "good", spec))
+        # Wait for BOTH outcomes: the good load landing does not imply
+        # the bad op's failure accounting has (workers are concurrent).
         for _ in range(200):
-            if repo.loaded:
+            if repo.loaded and puller.ops_failed:
                 break
             await asyncio.sleep(0.01)
         assert repo.loaded == ["good"]

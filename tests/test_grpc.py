@@ -327,6 +327,31 @@ async def test_grpc_generate_unary_matches_http_shape(tmp_path):
             http_result["details"]["token_count"]
 
 
+async def test_grpc_generate_unary_top_logprobs_parity(tmp_path):
+    """Unary Generate carries full top-N logprob detail (repeated
+    `tokens`), matching the HTTP surface — chosen_logprobs alone
+    dropped the alternatives (ADVICE r5)."""
+    from kfserving_tpu.predictors.llm import GenerativeModel
+    from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
+
+    model = GenerativeModel("gen", _write_gen_dir(tmp_path))
+    model.load()
+    async with grpc_server([model]) as (server, channel):
+        call = _method(channel, "Generate", gpb.GenerateRequest,
+                       gpb.GenerateResponse,
+                       service="kfserving.generate.GenerationService")
+        resp = await call(gpb.GenerateRequest(
+            model_name="gen", text_input="abc", max_tokens=4,
+            logprobs=2))
+        assert len(resp.tokens) == resp.token_count > 0
+        assert len(resp.chosen_logprobs) == resp.token_count
+        for tok, chosen in zip(resp.tokens, resp.chosen_logprobs):
+            assert tok.id == chosen.id
+            assert tok.logprob == chosen.logprob
+            assert len(tok.top_logprobs) == 2
+            assert all(t.logprob <= 0.0 for t in tok.top_logprobs)
+
+
 async def test_grpc_generate_stream_parity_and_logprobs(tmp_path):
     """Server-streaming tokens: per-message deltas concatenate to the
     unary result, terminal message carries finish_reason, and

@@ -913,6 +913,47 @@ def test_incremental_decoder_window_stays_bounded():
     assert dec.text() == "a" * 500
 
 
+def test_incremental_decoder_degraded_mode_still_matches_stops():
+    """A tokenizer whose decode rewrites already-emitted text flips
+    the decoder into degraded mode; stop sequences must STILL
+    truncate (ADVICE r5: they were silently disabled), via full
+    re-decode."""
+    from kfserving_tpu.predictors.llm import IncrementalDecoder
+
+    class _RewritingTok:
+        # Joint cleanup rewrites "ab" -> "AB" once both tokens are
+        # present (sentencepiece-style non-append-stable decode).
+        def decode(self, ids):
+            return "".join(chr(i) for i in ids).replace("ab", "AB")
+
+    dec = IncrementalDecoder(_RewritingTok(), ["E"])
+    stopped_at = None
+    for i, ch in enumerate("abcEx"):
+        _, stopped = dec.push(ord(ch))
+        if stopped:
+            stopped_at = i
+            break
+    assert dec.degraded
+    assert stopped_at == 3           # the "E" push matched
+    assert dec.text() == "ABc"       # truncated BEFORE the stop text
+
+
+def test_incremental_decoder_degraded_without_stops_stays_silent():
+    from kfserving_tpu.predictors.llm import IncrementalDecoder
+
+    class _RewritingTok:
+        def decode(self, ids):
+            return "".join(chr(i) for i in ids).replace("ab", "AB")
+
+    dec = IncrementalDecoder(_RewritingTok(), [])
+    for ch in "abcd":
+        _, stopped = dec.push(ord(ch))
+        assert not stopped
+    assert dec.degraded
+    # Terminal text comes from the caller's full decode in this mode.
+    assert dec.finish() == ""
+
+
 def test_incremental_decoder_trailing_partial_flushes_at_finish():
     from kfserving_tpu.predictors.llm import IncrementalDecoder
 
