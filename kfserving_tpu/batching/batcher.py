@@ -55,6 +55,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, Hashable, List, Optional
 
+from kfserving_tpu.observability import metrics as obs
 from kfserving_tpu.reliability.deadline import (
     Deadline,
     DeadlineExceeded,
@@ -347,6 +348,25 @@ class DynamicBatcher:
                 key, {"max": 0.0, "last": 0.0})
             rec["last"] = round(age_ms, 1)
             rec["max"] = round(max(rec["max"], age_ms), 1)
+            # Stage-timing series (InferLine's per-stage visibility):
+            # every flushed request's queue wait, and the flush's fill
+            # ratio against the bucket it will execute in (1.0 = no
+            # pad slots burned).
+            wait_hist = obs.batch_queue_wait_ms()
+            now = loop.time()
+            for w in head.waiters:
+                wait_hist.labels(bucket=str(key)).observe(max(
+                    0.0, (now - (w.flush_at
+                                 - self.max_latency_ms / 1000.0))
+                    * 1000.0))
+            n = len(head.instances)
+            if self._bucket_policy is not None:
+                padded = self._bucket_policy.fit(
+                    min(n, self.max_batch_size)) or n
+            else:
+                padded = self.max_batch_size
+            obs.batch_fill_ratio().labels(bucket=str(key)).observe(
+                min(1.0, n / padded))
         self._inflight += 1
         task = asyncio.ensure_future(self._run_batch(key, head))
         self._tasks.add(task)

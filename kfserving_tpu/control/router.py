@@ -18,10 +18,15 @@ import itertools
 import json
 import logging
 import random
+import time
 from typing import Callable, Dict, Optional, Tuple
 
 import aiohttp
 
+from kfserving_tpu.observability import REGISTRY
+from kfserving_tpu.observability import metrics as obs
+from kfserving_tpu.observability.accesslog import log_access
+from kfserving_tpu.observability.federation import merge_scrapes
 from kfserving_tpu.reliability import (
     CircuitBreaker,
     Deadline,
@@ -30,6 +35,13 @@ from kfserving_tpu.reliability import (
     faults,
 )
 from kfserving_tpu.server.http import HTTPServer, Request, Response, Router
+from kfserving_tpu.tracing import (
+    REQUEST_ID_HEADER,
+    TRACEPARENT_HEADER,
+    TraceContext,
+    ensure_trace_context,
+    tracer,
+)
 
 logger = logging.getLogger("kfserving_tpu.control.router")
 
@@ -103,6 +115,12 @@ class IngressRouter:
               self._predict_direct)
         r.add("POST", "/direct/predictor/v2/models/{name}/infer",
               self._predict_direct)
+        # Fleet telemetry: the router's own series plus every replica
+        # scrape federated under a `replica` label, and a federated
+        # trace view (?trace_id=&limit=&replica= pull from one replica
+        # without dumping every ring buffer).
+        r.add("GET", "/metrics", self._metrics)
+        r.add("GET", "/debug/traces", self._debug_traces)
 
     async def start_async(self, host: str = "127.0.0.1"):
         # force_close: no keep-alive pooling to upstreams.  A reused
@@ -267,9 +285,14 @@ class IngressRouter:
         def gate(host):
             return self._breakers.get(host)
 
-        replicas = [r for r in self._eligible(cid, revision, exclude)
-                    if gate(r.host) is None
-                    or gate(r.host).state != "open"]
+        replicas = []
+        for r in self._eligible(cid, revision, exclude):
+            breaker = gate(r.host)
+            if breaker is not None and breaker.state == "open":
+                obs.router_rotation_skips_total().labels(
+                    replica=r.host).inc()
+                continue
+            replicas.append(r)
         if not replicas:
             return None
         for _ in range(len(replicas)):
@@ -427,6 +450,130 @@ class IngressRouter:
     async def _health(self, req: Request) -> Response:
         return await self._proxy(req, "health")
 
+    # -- fleet telemetry ---------------------------------------------------
+    def _replica_hosts(self):
+        """Every replica host currently registered anywhere (the
+        federation scrape set)."""
+        orch = self.controller.reconciler.orchestrator
+        hosts = []
+        for cid in getattr(orch, "state", {}):
+            for r in orch.replicas(cid):
+                if r.host not in hosts:
+                    hosts.append(r.host)
+        return hosts
+
+    def _refresh_own_series(self) -> None:
+        """Mirror the router's live dict-based telemetry (kept as
+        plain dicts — the autoscaler reads them directly) into the
+        registry at scrape time."""
+        for cid, v in self.inflight.items():
+            obs.router_inflight().labels(component=cid).set(v)
+        for cid, v in self.request_count.items():
+            # Mirror, not increment: the dict is the source of truth
+            # and the registry child just exposes its current total.
+            obs.router_requests_total().labels(
+                component=cid).value = float(v)
+
+    async def _scrape(self, host: str, path: str,
+                      accept: Optional[str] = None) -> Optional[str]:
+        """One replica GET with a bounded timeout; None on any
+        failure (a sick replica must not fail the fleet scrape)."""
+        headers = {"accept": accept} if accept else None
+        try:
+            async with self._session.get(
+                    f"http://{host}{path}", headers=headers,
+                    timeout=aiohttp.ClientTimeout(total=2.0)) as resp:
+                if resp.status != 200:
+                    return None
+                return await resp.text()
+        except Exception:
+            logger.debug("scrape of %s%s failed", host, path)
+            return None
+
+    async def _metrics(self, req: Request) -> Response:
+        self._refresh_own_series()
+        want_om = "application/openmetrics-text" in \
+            req.headers.get("accept", "")
+        lines = REGISTRY.render_lines(exemplars=want_om)
+        if req.query.get("federate", "1") != "0" \
+                and self._session is not None:
+            hosts = self._replica_hosts()
+            # Concurrent scrapes: N sick replicas must cost ONE
+            # 2s timeout, not N sequential ones (a hung fleet is
+            # exactly when the scrape must still answer fast).
+            texts = await asyncio.gather(
+                *[self._scrape(host, "/metrics",
+                               accept="application/openmetrics-text")
+                  for host in hosts])
+            # Family-grouped merge: each metric declared once, all of
+            # its samples (own + per-replica) contiguous — strict
+            # parsers reject re-declared or scattered families.
+            lines = merge_scrapes(
+                lines,
+                [(host, text) for host, text in zip(hosts, texts)
+                 if text is not None],
+                keep_exemplars=want_om)
+        body = "\n".join(lines) + "\n"
+        if want_om:
+            body += "# EOF\n"
+            ctype = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8")
+        else:
+            ctype = "text/plain; version=0.0.4"
+        return Response(body.encode(),
+                        headers={"content-type": ctype})
+
+    async def _debug_traces(self, req: Request) -> Response:
+        trace_id = req.query.get("trace_id")
+        try:
+            limit = int(req.query.get("limit", "100"))
+        except ValueError:
+            return Response(b'{"error": "limit must be an integer"}',
+                            status=400)
+        only = req.query.get("replica")
+        # Dedup key: in-process deployments share ONE tracer between
+        # router and replicas, so the router's local read and the
+        # federation scrape return the same spans — merge them by
+        # identity (replica-labeled copy wins, except router-minted
+        # spans keep their router attribution).
+        merged: Dict[tuple, dict] = {}
+
+        def add(span: dict, source: str):
+            key = (span.get("trace_id"), span.get("name"),
+                   span.get("start"), span.get("duration_ms"))
+            if key in merged and span.get("name", "").startswith(
+                    "router."):
+                return
+            merged[key] = dict(span, replica=source)
+
+        if only is None or only == "router":
+            for s in tracer.spans(trace_id, limit):
+                add(s, "router")
+        qs = f"?limit={limit}"
+        if trace_id:
+            qs += f"&trace_id={trace_id}"
+        if only == "router":
+            hosts = []
+        elif only is not None:
+            hosts = [only]
+        else:
+            hosts = self._replica_hosts()
+        if self._session is not None and hosts:
+            texts = await asyncio.gather(
+                *[self._scrape(host, f"/debug/traces{qs}")
+                  for host in hosts])
+            for host, text in zip(hosts, texts):
+                if text is None:
+                    continue
+                try:
+                    body = json.loads(text)
+                except ValueError:
+                    continue
+                for s in body.get("spans", []):
+                    add(s, host)
+        return Response(json.dumps(
+            {"spans": list(merged.values())}).encode())
+
     # Transport-level failover attempts per request: a crashed replica is
     # evicted and the request retries the next one (the reference leans
     # on kubelet restart + readiness gates; a single-host fabric must
@@ -464,8 +611,6 @@ class IngressRouter:
             self.inflight[gauge_cid] -= 1
             upstream.close()
 
-        from kfserving_tpu.tracing import REQUEST_ID_HEADER
-
         # Same response-header policy as the buffered path: trace-id
         # correlation must survive on the flagship streaming verb.
         headers = {
@@ -482,8 +627,42 @@ class IngressRouter:
                      component: Optional[str] = None,
                      strip_prefix: str = "",
                      stream_ok: bool = False) -> Response:
-        from kfserving_tpu.tracing import REQUEST_ID_HEADER
+        """Telemetry envelope around the proxy core: joins/mints the
+        W3C trace context at ingress, records a router span + latency
+        histogram (exemplared with the trace id), counts sheds, and
+        emits one JSON access-log line per request."""
+        name = req.path_params["name"]
+        ctx = ensure_trace_context(req.headers, mint="w3c")
+        info: Dict[str, Optional[str]] = {}
+        start = time.perf_counter()
+        with tracer.span("router.proxy", model=name, verb=verb) as sp:
+            resp = await self._proxy_inner(req, verb, ctx, info,
+                                           component, strip_prefix,
+                                           stream_ok)
+            sp["status"] = resp.status
+            if info.get("upstream"):
+                sp["upstream"] = info["upstream"]
+        latency_ms = (time.perf_counter() - start) * 1000.0
+        obs.router_request_ms().labels(verb=verb).observe(
+            latency_ms, trace_id=ctx.trace_id)
+        if resp.status in (502, 503, 504):
+            obs.router_shed_total().labels(
+                status=str(resp.status)).inc()
+        log_access("router", trace_id=ctx.trace_id, model=name,
+                   verb=verb, status=resp.status,
+                   latency_ms=round(latency_ms, 3),
+                   upstream=info.get("upstream"))
+        # Echo the trace id even on router-local answers (404/503
+        # sheds never reach a replica's echo path).
+        resp.headers.setdefault(REQUEST_ID_HEADER, ctx.trace_id)
+        return resp
 
+    async def _proxy_inner(self, req: Request, verb: str,
+                           ctx: TraceContext,
+                           info: Dict[str, Optional[str]],
+                           component: Optional[str] = None,
+                           strip_prefix: str = "",
+                           stream_ok: bool = False) -> Response:
         name = req.path_params["name"]
         path = req.path
         if strip_prefix and path.startswith(strip_prefix):
@@ -491,12 +670,15 @@ class IngressRouter:
         headers = {k: v for k, v in req.headers.items()
                    if k.lower() not in ("host", "content-length",
                                         "connection")}
-        # Mint the request id at ingress so router, replica, and
-        # engine spans all share one trace id.
-        if REQUEST_ID_HEADER not in headers:
-            import uuid
-
-            headers[REQUEST_ID_HEADER] = uuid.uuid4().hex[:16]
+        # Forward the trace context so router, replica, and engine
+        # spans all share one trace id: a W3C-shaped id rides
+        # `traceparent` (with this hop's span id as the parent); any
+        # client-supplied x-request-id passes through untouched, and a
+        # router-minted id fills it for legacy correlation.
+        forward = ctx.forward_traceparent()
+        if forward is not None:
+            headers[TRACEPARENT_HEADER] = forward
+        headers.setdefault(REQUEST_ID_HEADER, ctx.trace_id)
         # The client's budget governs the router's OWN waiting
         # (activator buffering, failover attempts), and the replica
         # receives the REMAINING budget, not the original — time spent
@@ -553,6 +735,7 @@ class IngressRouter:
                     self.request_count[gauge_cid] = \
                         self.request_count.get(gauge_cid, 0) + 1
                 url = f"http://{host}{path}"
+                info["upstream"] = host
                 request_kwargs = {}
                 if stream_ok:
                     request_kwargs["timeout"] = aiohttp.ClientTimeout(

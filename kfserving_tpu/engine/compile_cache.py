@@ -41,5 +41,11 @@ def enable(cache_dir: Optional[str] = None,
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_time_secs)
     _active_dir = cache_dir
+    from kfserving_tpu.observability import REGISTRY
+
+    REGISTRY.gauge(
+        "kfserving_tpu_compile_cache_enabled",
+        "1 when the persistent XLA compile cache is active").labels(
+            dir=cache_dir).set(1)
     logger.info("persistent XLA compile cache at %s", cache_dir)
     return cache_dir

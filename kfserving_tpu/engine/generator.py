@@ -68,6 +68,7 @@ from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from kfserving_tpu.observability import metrics as obs
 from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
 
 logger = logging.getLogger("kfserving_tpu.engine.generator")
@@ -94,6 +95,12 @@ class _Request:
     # read them aligned with the token stream.
     lp_chosen: List[float] = field(default_factory=list)
     lp_top: List[List[Tuple[int, float]]] = field(default_factory=list)
+    # Telemetry: the submitting request's trace id (rides onto the
+    # TTFT / inter-token-latency / tokens-per-second histograms as
+    # OpenMetrics exemplars) and emission timestamps.
+    trace_id: Optional[str] = None
+    submit_t: float = 0.0
+    last_emit_t: Optional[float] = None
 
 
 @dataclass
@@ -618,12 +625,15 @@ class GenerationEngine:
             seed = self._seed_counter
             self._seed_counter += 1
         from kfserving_tpu.reliability.deadline import current_deadline
+        from kfserving_tpu.tracing import current_request_id
 
         req = _Request(ids, budget, float(temperature),
                        top_k=int(top_k), top_p=float(top_p),
                        seed=int(seed) & 0x7FFFFFFF,
                        logprobs=int(logprobs),
-                       deadline=current_deadline())
+                       deadline=current_deadline(),
+                       trace_id=current_request_id.get(),
+                       submit_t=time.perf_counter())
         self._pending.append(req)
         self._ensure_loop()
         return req
@@ -1320,6 +1330,20 @@ class GenerationEngine:
         s = self._slots[slot]
         s.generated += 1
         self.tokens_generated += 1
+        obs.llm_tokens_total().labels(direction="out").inc()
+        # Generation latency series: first emission is TTFT, later
+        # ones inter-token gaps; both carry the request's trace id as
+        # an exemplar so a slow tail links straight to its trace.
+        now = time.perf_counter()
+        if s.req.last_emit_t is None:
+            obs.llm_ttft_ms().observe(
+                (now - s.req.submit_t) * 1000.0,
+                trace_id=s.req.trace_id)
+        else:
+            obs.llm_inter_token_ms().observe(
+                (now - s.req.last_emit_t) * 1000.0,
+                trace_id=s.req.trace_id)
+        s.req.last_emit_t = now
         finished = None
         if self.eos_id is not None and token == self.eos_id:
             finished = "eos"
@@ -1337,6 +1361,11 @@ class GenerationEngine:
             s.tokens.append(token)
             s.req.out.put_nowait((token, finished))
         if finished is not None:
+            duration_s = now - s.req.submit_t
+            if duration_s > 0:
+                obs.llm_tokens_per_second().observe(
+                    s.generated / duration_s,
+                    trace_id=s.req.trace_id)
             self._free_slot_state(slot)
             self.requests_finished += 1
         else:

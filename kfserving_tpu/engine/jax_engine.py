@@ -147,6 +147,11 @@ class JaxEngine:
         self._bucket_waste: Dict[Any, float] = {}
         self._slots_total = 0
         self._padded_slots_total = 0
+        # Shapes this engine has dispatched before: the first dispatch
+        # per (batch, seq) bucket pays jit trace+compile (a persistent-
+        # XLA-cache hit still costs a load), later ones are cache hits
+        # — the compile-cache counter series feeds off this.
+        self._compiled_shapes: set = set()
         self._explicit_transfer = _params_on_single_device(jax, params)
         self._peak_flops = device_peak_flops()
         # One host<->device synchronization per batch, not two: the result
@@ -233,6 +238,7 @@ class JaxEngine:
                 # in-flight compute (double buffering across the PCIe /
                 # tunnel hop).
                 padded = self._jax.device_put(padded)
+            t_transfer = time.perf_counter()
             out = self._jitted(self.params, padded)
             if self._blocking_stats:
                 # Attribution mode: pay the extra sync so device_ms is
@@ -249,7 +255,28 @@ class JaxEngine:
                         prepare_ms=round((t1 - t0) * 1e3, 3),
                         device_ms=round((t2 - t1) * 1e3, 3),
                         fetch_ms=round((t3 - t2) * 1e3, 3))
+            # Stage histograms, exemplared with the request's trace id
+            # (the contextvar rode into this worker thread): the
+            # fleet-wide view of where a request's milliseconds go.
+            from kfserving_tpu.observability import metrics as obs
+            from kfserving_tpu.tracing import current_request_id
+
+            trace_id = current_request_id.get()
+            stage_hist = obs.engine_stage_ms()
+            for stage, ms in (("prepare", (t1 - t0) * 1e3),
+                              ("transfer", (t_transfer - t1) * 1e3),
+                              ("compute", (t2 - t_transfer) * 1e3),
+                              ("fetch", (t3 - t2) * 1e3)):
+                stage_hist.labels(stage=stage).observe(
+                    ms, trace_id=trace_id)
             with self._stats_lock:
+                if flops_key not in self._compiled_shapes:
+                    self._compiled_shapes.add(flops_key)
+                    obs.compile_cache_events().labels(
+                        outcome="miss").inc()
+                else:
+                    obs.compile_cache_events().labels(
+                        outcome="hit").inc()
                 # dispatch -> host-visible result (full device path)
                 self.last_execute_ms = (t3 - t1) * 1000.0
                 self.execute_count += 1

@@ -1,0 +1,33 @@
+"""Unified telemetry for the sidecar-free TPU serving stack.
+
+The reference delegates request telemetry to the Istio/Knative mesh
+(queue-proxy traces, controller metrics on :8080); this data plane has
+no sidecar, so SURVEY §5.1 makes the serving stack own its spans and
+metrics.  This package is the shared substrate:
+
+- `registry` — a process-wide labeled metrics registry (counters /
+  gauges / histograms) rendering Prometheus text with OpenMetrics
+  exemplars that link latency observations to trace ids.
+- `metrics` — the catalog of instrument accessors every layer uses
+  (batcher queue-wait, engine stage timings, LLM TTFT/ITL/TPS,
+  breaker/retry/deadline series).  Accessors re-resolve from the
+  registry on every call, so a test-time `REGISTRY.reset()` never
+  leaves a stale instrument behind.
+- `accesslog` — one structured JSON line per request (trace id,
+  model, verb, status, stage timings, token counts).
+- `federation` — /metrics relabeling helpers for the ingress router's
+  fleet scrape (every replica series re-emitted under a `replica`
+  label).
+
+Import discipline: this package imports nothing from `server/`,
+`control/`, `engine/`, or `reliability/` — those layers import *it*,
+so reliability instrumentation (and everything else) stays cycle-free.
+"""
+
+from kfserving_tpu.observability.registry import (
+    LATENCY_BUCKETS_MS,
+    REGISTRY,
+    Registry,
+)
+
+__all__ = ["LATENCY_BUCKETS_MS", "REGISTRY", "Registry"]

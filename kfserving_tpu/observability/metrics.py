@@ -1,0 +1,136 @@
+"""The metric catalog: accessors for every cross-layer instrument.
+
+Each accessor re-resolves its family from the process registry on
+every call (registration is an idempotent dict lookup), so a
+test-time `REGISTRY.reset()` can never leave a caller holding a stale
+instrument.  Layers call e.g.::
+
+    from kfserving_tpu.observability import metrics as obs
+
+    obs.batch_queue_wait_ms().labels(bucket=str(key)).observe(age_ms)
+    obs.llm_ttft_ms().observe(ttft, trace_id=req.trace_id)
+
+Series naming follows the seed's `kfserving_tpu_` prefix; histograms
+are milliseconds unless the name says otherwise.
+"""
+
+from kfserving_tpu.observability.registry import (
+    LATENCY_BUCKETS_MS,
+    RATIO_BUCKETS,
+    REGISTRY,
+    THROUGHPUT_BUCKETS,
+)
+
+
+# -- batcher ------------------------------------------------------------
+def batch_queue_wait_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_batch_queue_wait_ms",
+        "Time a request's oldest instance waited in the dynamic "
+        "batcher queue before its batch flushed")
+
+
+def batch_fill_ratio():
+    return REGISTRY.histogram(
+        "kfserving_tpu_batch_fill_ratio",
+        "Flushed batch size as a fraction of the executed bucket "
+        "(1.0 = zero pad slots)", buckets=RATIO_BUCKETS)
+
+
+# -- engine -------------------------------------------------------------
+def engine_stage_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_engine_stage_ms",
+        "Per-execution engine stage timing (stage=prepare|transfer|"
+        "compute|fetch)")
+
+
+def compile_cache_events():
+    return REGISTRY.counter(
+        "kfserving_tpu_compile_cache_total",
+        "Compiled-executable cache lookups by outcome (outcome=hit "
+        "means the shape was already compiled; miss paid a compile)")
+
+
+# -- LLM generation -----------------------------------------------------
+def llm_ttft_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_llm_ttft_ms",
+        "Time from generation submit to the first emitted token")
+
+
+def llm_inter_token_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_llm_inter_token_ms",
+        "Gap between consecutive emitted tokens of one generation")
+
+
+def llm_tokens_per_second():
+    return REGISTRY.histogram(
+        "kfserving_tpu_llm_tokens_per_second",
+        "Whole-generation decode throughput at finish",
+        buckets=THROUGHPUT_BUCKETS)
+
+
+def llm_tokens_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_llm_tokens_total",
+        "Prompt and generated tokens by direction (direction=in|out)")
+
+
+# -- reliability --------------------------------------------------------
+def breaker_state():
+    return REGISTRY.gauge(
+        "kfserving_tpu_breaker_state",
+        "Circuit breaker state (0=closed, 1=half_open, 2=open)")
+
+
+def breaker_transitions():
+    return REGISTRY.counter(
+        "kfserving_tpu_breaker_transitions_total",
+        "Circuit breaker state transitions (to=open|closed)")
+
+
+def retry_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_retry_total",
+        "Retries performed, labeled by edge (policy name) and reason "
+        "(exception class)")
+
+
+def deadline_exceeded_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_deadline_exceeded_total",
+        "Requests shed because their latency budget ran out, by stage")
+
+
+# -- ingress router -----------------------------------------------------
+def router_inflight():
+    return REGISTRY.gauge(
+        "kfserving_tpu_router_inflight",
+        "In-flight proxied requests per component")
+
+
+def router_requests_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_router_requests_total",
+        "Requests routed per component")
+
+
+def router_rotation_skips_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_router_rotation_skips_total",
+        "Replica picks skipped because the host's breaker was open")
+
+
+def router_shed_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_router_shed_total",
+        "Requests the router shed instead of proxying, by reason")
+
+
+def router_request_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_router_request_ms",
+        "Router-observed request latency (proxy hop included)",
+        buckets=LATENCY_BUCKETS_MS)

@@ -68,6 +68,7 @@ import numpy as np
 
 from kfserving_tpu.engine.generator import GenerationEngine
 from kfserving_tpu.engine.hbm import HBMManager
+from kfserving_tpu.observability import metrics as obs_metrics
 from kfserving_tpu.model.model import Model
 from kfserving_tpu.protocol import v1
 from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
@@ -516,6 +517,10 @@ class GenerativeModel(Model):
 
     def _submit(self, parsed: Dict[str, Any]):
         ids = self.tokenizer.encode(parsed["prompt"])
+        # Prompt-side token accounting (the "out" side increments per
+        # emitted token in the engine's _emit).
+        obs_metrics.llm_tokens_total().labels(direction="in").inc(
+            len(ids))
         return self.engine.submit(
             ids, max_new_tokens=parsed["max_tokens"],
             temperature=parsed["temperature"],

@@ -33,6 +33,7 @@ import time
 from collections import deque
 from typing import Callable, Deque
 
+from kfserving_tpu.observability import metrics as obs
 from kfserving_tpu.reliability.envknobs import env_float
 
 logger = logging.getLogger("kfserving_tpu.reliability.breaker")
@@ -40,6 +41,10 @@ logger = logging.getLogger("kfserving_tpu.reliability.breaker")
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
+
+# Gauge encoding of breaker state (per-replica breaker visibility on
+# /metrics: a router scrape shows which hosts rotation is skipping).
+_STATE_VALUE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 
 
 def _env_float(name: str, prefix: str, default: float) -> float:
@@ -87,6 +92,11 @@ class CircuitBreaker:
                 self._clock() - self._opened_at >= self.reset_timeout_s:
             self._state = HALF_OPEN
             self._half_open_inflight = 0
+            self._export_state()
+
+    def _export_state(self) -> None:
+        obs.breaker_state().labels(name=self.name).set(
+            _STATE_VALUE[self._state])
 
     def _prune(self, now: float) -> None:
         horizon = now - self.window_s
@@ -110,6 +120,8 @@ class CircuitBreaker:
         if self._state != CLOSED:
             logger.info("breaker %s closed (probe succeeded)",
                         self.name)
+            obs.breaker_transitions().labels(
+                name=self.name, to=CLOSED).inc()
         self.reset()
 
     def record_failure(self) -> None:
@@ -130,12 +142,16 @@ class CircuitBreaker:
             logger.warning(
                 "breaker %s OPEN (%d failures in %.0fs window)",
                 self.name, len(self._failures) or 1, self.window_s)
+            obs.breaker_transitions().labels(
+                name=self.name, to=OPEN).inc()
         self._state = OPEN
         self._opened_at = now
         self._half_open_inflight = 0
+        self._export_state()
 
     def reset(self) -> None:
         """Force-close (external health probe confirmed recovery)."""
         self._state = CLOSED
         self._failures.clear()
         self._half_open_inflight = 0
+        self._export_state()

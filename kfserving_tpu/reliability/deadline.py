@@ -32,7 +32,12 @@ MAX_TIMEOUT_MS = 24 * 3600 * 1000.0
 
 class DeadlineExceeded(ServingError):
     """The request's latency budget ran out (maps to HTTP 504 /
-    gRPC DEADLINE_EXCEEDED)."""
+    gRPC DEADLINE_EXCEEDED).
+
+    Construction IS the shed event (every path that gives up on a
+    request builds one of these, whether it raises or sets it on a
+    waiter future), so the per-stage shed counter increments here —
+    one central point instead of a counter call at every edge."""
 
     status_code = HTTPStatus.GATEWAY_TIMEOUT
 
@@ -41,6 +46,13 @@ class DeadlineExceeded(ServingError):
         if where:
             reason = f"{reason} ({where})"
         super().__init__(reason)
+        try:
+            from kfserving_tpu.observability import metrics as obs
+
+            obs.deadline_exceeded_total().labels(
+                stage=where or "unknown").inc()
+        except Exception:  # telemetry must never mask the 504
+            pass
 
 
 class Deadline:

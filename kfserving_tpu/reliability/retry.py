@@ -28,6 +28,7 @@ import time
 import urllib.error
 from typing import Any, Callable, Iterator, Optional, Tuple, Type
 
+from kfserving_tpu.observability import metrics as obs
 from kfserving_tpu.reliability.deadline import current_deadline
 from kfserving_tpu.reliability.envknobs import env_float
 
@@ -152,6 +153,8 @@ class RetryPolicy:
                     "in %.0fms", self.name, attempt, self.max_attempts,
                     type(e).__name__, e, delay * 1000)
                 self.retries += 1
+                obs.retry_total().labels(
+                    edge=self.name, reason=type(e).__name__).inc()
                 time.sleep(delay)
 
     async def acall(self, fn: Callable[..., Any], *args, **kwargs
@@ -174,4 +177,6 @@ class RetryPolicy:
                     "in %.0fms", self.name, attempt, self.max_attempts,
                     type(e).__name__, e, delay * 1000)
                 self.retries += 1
+                obs.retry_total().labels(
+                    edge=self.name, reason=type(e).__name__).inc()
                 await asyncio.sleep(delay)

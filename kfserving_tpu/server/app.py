@@ -29,6 +29,7 @@ execution rather than process forking.
 
 import argparse
 import asyncio
+import contextlib
 import json
 import logging
 import os
@@ -82,6 +83,17 @@ def _np_default(obj):
         return tolist()
     raise TypeError(
         f"Object of type {type(obj).__name__} is not JSON serializable")
+
+
+@contextlib.contextmanager
+def _staged(stages: Dict[str, float], stage: str):
+    """Record a stage's wall time (ms) into `stages` for the access
+    log — one shared helper instead of per-request timer classes."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        stages[stage] = round((time.perf_counter() - t0) * 1000.0, 3)
 
 
 def _error(e: ServingError) -> Response:
@@ -306,7 +318,8 @@ class ModelServer:
                 latency_ms = (time.perf_counter() - start) * 1000.0
                 resp = _json({"error": error}, status=status)
                 self.metrics.observe_request(name, verb, status,
-                                             latency_ms)
+                                             latency_ms,
+                                             trace_id=rid)
                 # Shed requests still reach the hooks: the payload logger
                 # must not go blind exactly during overload.
                 for hook in self.request_hooks:
@@ -318,12 +331,14 @@ class ModelServer:
                 return resp
             try:
                 resp = await self._inference_inner(
-                    req, verb, op, name, start, deadline)
+                    req, verb, op, name, start, deadline,
+                    trace_id=rid)
             finally:
                 self._admission.exit()
         else:
             resp = await self._inference_inner(req, verb, op, name,
-                                               start, deadline)
+                                               start, deadline,
+                                               trace_id=rid)
         resp.headers[REQUEST_ID_HEADER] = rid
         return resp
 
@@ -356,11 +371,16 @@ class ModelServer:
 
     async def _inference_inner(self, req: Request, verb: str, op,
                                name: str, start: float,
-                               deadline=None) -> Response:
+                               deadline=None,
+                               trace_id: Optional[str] = None
+                               ) -> Response:
+        from kfserving_tpu.observability.accesslog import log_access
         from kfserving_tpu.reliability import deadline_scope
         from kfserving_tpu.tracing import tracer
 
         status = 200
+        stages: Dict[str, float] = {}
+        tokens_out = None
         try:
             if deadline is not None and deadline.expired:
                 # Budget spent waiting for the admission slot: 504
@@ -370,14 +390,21 @@ class ModelServer:
 
                 raise DeadlineExceeded("admission queue")
             with deadline_scope(deadline):
-                with tracer.span("server.decode", model=name, verb=verb):
+                with tracer.span("server.decode", model=name,
+                                 verb=verb), _staged(stages, "decode"):
                     body = self.dataplane.decode_body(
                         req.headers, req.body,
                         dtype_hint=self.dataplane.wire_dtype_hint(name))
-                with tracer.span("server.infer", model=name, verb=verb):
+                with tracer.span("server.infer", model=name,
+                                 verb=verb), _staged(stages, "infer"):
                     response = await op(name, body)
-                with tracer.span("server.encode", model=name, verb=verb):
+                with tracer.span("server.encode", model=name,
+                                 verb=verb), _staged(stages, "encode"):
                     resp = self._encode_response(req, body, response)
+                if isinstance(response, dict):
+                    tokens_out = response.get("details", {}).get(
+                        "token_count") if isinstance(
+                            response.get("details"), dict) else None
         except ServingError as e:
             status = e.status_code
             resp = _error(e)
@@ -386,7 +413,11 @@ class ModelServer:
             status = 500
             resp = _json({"error": str(e)}, status=500)
         latency_ms = (time.perf_counter() - start) * 1000.0
-        self.metrics.observe_request(name, verb, status, latency_ms)
+        self.metrics.observe_request(name, verb, status, latency_ms,
+                                     trace_id=trace_id)
+        log_access("server", trace_id=trace_id, model=name, verb=verb,
+                   status=status, latency_ms=round(latency_ms, 3),
+                   stages=stages or None, tokens_out=tokens_out)
         for hook in self.request_hooks:
             try:
                 hook(name, verb, req, resp, latency_ms)
@@ -473,7 +504,8 @@ class ModelServer:
                 status, error = self._shed_reason(admitted)
                 resp = _json({"error": error}, status=status)
                 self.metrics.observe_request(name, "generate_stream",
-                                             status, 0.0)
+                                             status, 0.0,
+                                             trace_id=rid)
                 resp.headers[REQUEST_ID_HEADER] = rid
                 return resp
             gated = True
@@ -520,7 +552,16 @@ class ModelServer:
             await aclose_quietly(events, "model event stream")
             latency_ms = (time.perf_counter() - start) * 1000.0
             metrics.observe_request(name, "generate_stream",
-                                    state["status"], latency_ms)
+                                    state["status"], latency_ms,
+                                    trace_id=rid)
+            from kfserving_tpu.observability.accesslog import (
+                log_access,
+            )
+
+            log_access("server", trace_id=rid, model=name,
+                       verb="generate_stream",
+                       status=state["status"],
+                       latency_ms=round(latency_ms, 3))
             for hook in hooks:
                 try:
                     hook(name, "generate_stream", req, None,
@@ -604,14 +645,29 @@ class ModelServer:
                             labels={"model": model.name})
             except Exception:
                 logger.exception("engine stats for %s failed", model.name)
-        return Response(self.metrics.render().encode("utf-8"),
-                        content_type="text/plain; version=0.0.4")
+        # Content negotiation: exemplars are only legal under the
+        # OpenMetrics content type; the classic text parser would
+        # reject the suffix and drop the whole scrape.
+        want_om = "application/openmetrics-text" in \
+            req.headers.get("accept", "")
+        body = self.metrics.render(exemplars=want_om)
+        if want_om:
+            body += "# EOF\n"
+            ctype = ("application/openmetrics-text; version=1.0.0; "
+                     "charset=utf-8")
+        else:
+            ctype = "text/plain; version=0.0.4"
+        return Response(body.encode("utf-8"), content_type=ctype)
 
     async def _traces(self, req: Request) -> Response:
         from kfserving_tpu.tracing import tracer
 
         trace_id = req.query.get("trace_id")
-        limit = int(req.query.get("limit", "100"))
+        try:
+            limit = int(req.query.get("limit", "100"))
+        except ValueError:
+            return _json({"error": "limit must be an integer"},
+                         status=400)
         return _json({"spans": tracer.spans(trace_id, limit)})
 
     async def _profiler_start(self, req: Request) -> Response:
@@ -655,7 +711,8 @@ class ModelServer:
             from kfserving_tpu.server.grpc_server import GRPCServer
 
             self.grpc_server = GRPCServer(
-                self.dataplane, port=self.grpc_port, host=host)
+                self.dataplane, port=self.grpc_port, host=host,
+                metrics=self.metrics)
             await self.grpc_server.start()
             self.grpc_port = self.grpc_server.port
         from kfserving_tpu import startup

@@ -23,6 +23,7 @@ from kfserving_tpu.reliability.deadline import (
     check_deadline,
     deadline_scope,
 )
+from kfserving_tpu.tracing import tracer
 
 SERVER_NAME = "kfserving-tpu"
 
@@ -130,11 +131,14 @@ class DataPlane:
         # spends a slot on it.
         model = await self.get_model(name)
         check_deadline("dataplane.infer")
-        request = await model.preprocess(body)
+        with tracer.span("dataplane.preprocess", model=name):
+            request = await model.preprocess(body)
         request = self.validate(request)
         check_deadline("dataplane.infer preprocess")
-        response = await maybe_await(model.predict(request))
-        return await model.postprocess(response)
+        with tracer.span("dataplane.predict", model=name):
+            response = await maybe_await(model.predict(request))
+        with tracer.span("dataplane.postprocess", model=name):
+            return await model.postprocess(response)
 
     async def explain(self, name: str, body: Any) -> Any:
         model = await self.get_model(name)

@@ -16,7 +16,9 @@ Tensor payloads accept both typed `InferTensorContents` fields and
 request's form: raw in -> raw out, typed in -> typed out.
 """
 
+import asyncio
 import logging
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -25,6 +27,7 @@ from kfserving_tpu.protocol.errors import ServingError
 from kfserving_tpu.protocol.grpc import pb2
 from kfserving_tpu.protocol.v2 import InferInput, InferRequest
 from kfserving_tpu.server.dataplane import DataPlane
+from kfserving_tpu.tracing import ensure_trace_context
 
 logger = logging.getLogger("kfserving_tpu.grpc")
 
@@ -153,15 +156,49 @@ def _deadline_from(context):
     return Deadline(remaining)
 
 
+def _http_status(e: Exception) -> int:
+    """The HTTP-equivalent status of a handler failure, so gRPC and
+    HTTP requests land in the SAME request counter/latency series
+    (the recycling watchdog's max_requests trigger scrapes it; a
+    gRPC-only deployment must not undercount)."""
+    if isinstance(e, ServingError):
+        return int(e.status_code)
+    if isinstance(e, (ValueError, KeyError)):
+        return 400
+    return 500
+
+
 class GRPCServer:
     """Async V2 gRPC front end over a DataPlane."""
 
     def __init__(self, dataplane: DataPlane, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", metrics=None):
         self.dataplane = dataplane
         self.port = port
         self.host = host
+        self.metrics = metrics  # shared with the HTTP app
         self._server = None
+
+    def _join_trace(self, context) -> Optional[str]:
+        """Join the caller's trace from gRPC metadata (`traceparent`
+        wins, `x-request-id` fallback) — the gRPC hop's analogue of
+        the HTTP header join, so engine spans reached through either
+        protocol carry the upstream trace id."""
+        try:
+            md = {str(k).lower(): str(v) for k, v in
+                  (context.invocation_metadata() or ())}
+        except Exception:
+            md = {}
+        return ensure_trace_context(md).trace_id
+
+    def _observe(self, model: str, verb: str, status: int,
+                 start: float, trace_id: Optional[str]) -> None:
+        if self.metrics is None:
+            return
+        self.metrics.observe_request(
+            model, verb, status,
+            (time.perf_counter() - start) * 1000.0,
+            trace_id=trace_id)
 
     # -- handlers -----------------------------------------------------------
     async def _abort(self, context, e: Exception):
@@ -215,13 +252,19 @@ class GRPCServer:
     async def ModelInfer(self, request, context):
         from kfserving_tpu.reliability import deadline_scope
 
+        start = time.perf_counter()
+        trace_id = self._join_trace(context)
         try:
             infer_req = _request_to_infer(request)
             with deadline_scope(_deadline_from(context)):
                 result = await self.dataplane.infer(
                     request.model_name, infer_req)
         except Exception as e:
+            self._observe(request.model_name, "infer",
+                          _http_status(e), start, trace_id)
             await self._abort(context, e)
+        self._observe(request.model_name, "infer", 200, start,
+                      trace_id)
         response = pb2.ModelInferResponse(
             model_name=result.get("model_name", request.model_name),
             model_version=result.get("model_version", ""),
@@ -251,12 +294,18 @@ class GRPCServer:
         from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
         from kfserving_tpu.reliability import deadline_scope
 
+        start = time.perf_counter()
+        trace_id = self._join_trace(context)
         try:
             with deadline_scope(_deadline_from(context)):
                 result = await self.dataplane.generate(
                     request.model_name, self._generate_body(request))
         except Exception as e:
+            self._observe(request.model_name, "generate",
+                          _http_status(e), start, trace_id)
             await self._abort(context, e)
+        self._observe(request.model_name, "generate", 200, start,
+                      trace_id)
         details = result.get("details", {})
         resp = gpb.GenerateResponse(
             model_name=result.get("model_name", request.model_name),
@@ -287,6 +336,8 @@ class GRPCServer:
         from kfserving_tpu.reliability import deadline_scope
         from kfserving_tpu.streams import aclose_quietly
 
+        start = time.perf_counter()
+        trace_id = self._join_trace(context)
         try:
             # The deadline covers validation + submission and rides
             # into the engine request: an over-budget stream finishes
@@ -295,7 +346,10 @@ class GRPCServer:
                 events = await self.dataplane.generate_stream(
                     request.model_name, self._generate_body(request))
         except Exception as e:
+            self._observe(request.model_name, "generate_stream",
+                          _http_status(e), start, trace_id)
             await self._abort(context, e)
+        status = 200
         try:
             async for event in events:
                 msg = gpb.GenerateStreamResponse()
@@ -316,11 +370,22 @@ class GRPCServer:
                     msg.token_count = event.get(
                         "details", {}).get("token_count", 0)
                 yield msg
+        except (GeneratorExit, asyncio.CancelledError):
+            # Client cancellation is routine, not a server error:
+            # record the nginx-style 499 so disconnect storms never
+            # read as a 5xx spike in the request counter.
+            status = 499
+            raise
+        except BaseException:
+            status = 500
+            raise
         finally:
             # gRPC cancellation (client went away) lands here as a
             # GeneratorExit — close the event stream so the engine
             # frees the decode slot.
             await aclose_quietly(events, "grpc generate stream")
+            self._observe(request.model_name, "generate_stream",
+                          status, start, trace_id)
 
     async def RepositoryIndex(self, request, context):
         resp = pb2.RepositoryIndexResponse()

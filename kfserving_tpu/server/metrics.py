@@ -5,99 +5,64 @@ The reference delegates request metrics to the Knative queue-proxy
 :8080 (reference cmd/manager/main.go:60-61).  The TPU server is its own
 sidecar-free process, so it exposes request counts/latency histograms and
 engine gauges (batch sizes, compile cache, HBM) directly on /metrics.
+
+Built on the labeled registry (observability/registry.py): request
+series live on a PRIVATE per-server registry (two servers in one
+process must not double-count each other's requests), and `render()`
+appends the process-wide REGISTRY so batcher / engine / generator /
+reliability series ride the same scrape.  Request latency observations
+carry OpenMetrics exemplars linking them to trace ids.
 """
 
-import bisect
 import time
-from typing import Dict, List, Tuple
+from typing import Dict, Optional
 
-LATENCY_BUCKETS_MS = [0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500,
-                      5000, 10000]
+from kfserving_tpu.observability.registry import (
+    LATENCY_BUCKETS_MS,
+    REGISTRY,
+    Registry,
+)
 
 # The request counter's series name, shared with every consumer that
 # scrapes it (the recycling watchdog's max_requests trigger keys on this
 # literal — a rename here without the constant would silently disable
 # request-count recycling).
 REQUEST_TOTAL_SERIES = "kfserving_tpu_request_total"
-
-
-class Histogram:
-    __slots__ = ("buckets", "counts", "total", "sum")
-
-    def __init__(self, buckets: List[float] = LATENCY_BUCKETS_MS):
-        self.buckets = buckets
-        self.counts = [0] * (len(buckets) + 1)
-        self.total = 0
-        self.sum = 0.0
-
-    def observe(self, value: float) -> None:
-        self.counts[bisect.bisect_left(self.buckets, value)] += 1
-        self.total += 1
-        self.sum += value
+LATENCY_SERIES = "kfserving_tpu_request_latency_ms"
 
 
 class Metrics:
     def __init__(self):
-        self.request_count: Dict[Tuple[str, str, int], int] = {}
-        self.latency: Dict[Tuple[str, str], Histogram] = {}
-        self.gauges: Dict[str, float] = {}
+        self.registry = Registry()
         self.start_time = time.time()
 
     def observe_request(self, model: str, verb: str, status: int,
-                        latency_ms: float) -> None:
-        key = (model, verb, status)
-        self.request_count[key] = self.request_count.get(key, 0) + 1
-        hkey = (model, verb)
-        if hkey not in self.latency:
-            self.latency[hkey] = Histogram()
-        self.latency[hkey].observe(latency_ms)
+                        latency_ms: float,
+                        trace_id: Optional[str] = None) -> None:
+        self.registry.counter(
+            REQUEST_TOTAL_SERIES,
+            "Total requests by model/verb/status").labels(
+                model=model, verb=verb, status=str(status)).inc()
+        self.registry.histogram(
+            LATENCY_SERIES, "Request latency histogram",
+            buckets=LATENCY_BUCKETS_MS).labels(
+                model=model, verb=verb).observe(latency_ms,
+                                                trace_id=trace_id)
 
     def set_gauge(self, name: str, value: float,
                   labels: Dict[str, str] = None) -> None:
-        if labels:
-            label_str = ",".join(
-                f'{k}="{v}"' for k, v in sorted(labels.items()))
-            self.gauges[f"{name}{{{label_str}}}"] = value
-        else:
-            self.gauges[name] = value
+        self.registry.gauge(name).labels(**(labels or {})).set(value)
 
-    def render(self) -> str:
-        lines = [
-            f"# HELP {REQUEST_TOTAL_SERIES} Total requests by "
-            f"model/verb/status",
-            f"# TYPE {REQUEST_TOTAL_SERIES} counter",
-        ]
-        for (model, verb, status), count in sorted(self.request_count.items()):
-            lines.append(
-                f'{REQUEST_TOTAL_SERIES}{{model="{model}",verb="{verb}",'
-                f'status="{status}"}} {count}')
-        lines += [
-            "# HELP kfserving_tpu_request_latency_ms Request latency histogram",
-            "# TYPE kfserving_tpu_request_latency_ms histogram",
-        ]
-        for (model, verb), hist in sorted(self.latency.items()):
-            cumulative = 0
-            for bound, count in zip(hist.buckets, hist.counts):
-                cumulative += count
-                lines.append(
-                    f'kfserving_tpu_request_latency_ms_bucket{{model="{model}",'
-                    f'verb="{verb}",le="{bound}"}} {cumulative}')
-            lines.append(
-                f'kfserving_tpu_request_latency_ms_bucket{{model="{model}",'
-                f'verb="{verb}",le="+Inf"}} {hist.total}')
-            lines.append(
-                f'kfserving_tpu_request_latency_ms_sum{{model="{model}",'
-                f'verb="{verb}"}} {hist.sum}')
-            lines.append(
-                f'kfserving_tpu_request_latency_ms_count{{model="{model}",'
-                f'verb="{verb}"}} {hist.total}')
-        typed = set()
-        for name, value in sorted(self.gauges.items()):
-            base = name.split("{", 1)[0]
-            if base not in typed:
-                lines.append(f"# TYPE {base} gauge")
-                typed.add(base)
-            lines.append(f"{name} {value}")
+    def render(self, include_global: bool = True,
+               exemplars: bool = False) -> str:
+        """``exemplars=True`` only for the OpenMetrics content type —
+        the classic text/plain parser rejects exemplar suffixes and
+        would drop the entire scrape."""
+        lines = self.registry.render_lines(exemplars=exemplars)
+        if include_global:
+            # Process-wide series (batcher, engine stages, generator
+            # TTFT/ITL, breaker/retry/deadline) join the scrape.
+            lines += REGISTRY.render_lines(exemplars=exemplars)
         lines.append(
             f"kfserving_tpu_uptime_seconds {time.time() - self.start_time}")
         return "\n".join(lines) + "\n"

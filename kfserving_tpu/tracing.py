@@ -21,21 +21,101 @@ The `jax.profiler` toggle (``POST /debug/profiler/start|stop``) wraps
 import contextlib
 import contextvars
 import logging
+import re
 import threading
 import time
 import uuid
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 logger = logging.getLogger("kfserving_tpu.tracing")
 
 REQUEST_ID_HEADER = "x-request-id"
+# W3C Trace Context (https://www.w3.org/TR/trace-context/): the
+# cross-hop carrier.  `traceparent` wins over x-request-id when both
+# arrive; x-request-id stays the echo/correlation header for clients
+# that never adopted W3C.
+TRACEPARENT_HEADER = "traceparent"
+
+_HEX32 = re.compile(r"^[0-9a-f]{32}$")
+_HEX16 = re.compile(r"^[0-9a-f]{16}$")
 
 # Current request id; propagated into engine worker threads by running
 # the executor callable under contextvars.copy_context().
 current_request_id: contextvars.ContextVar[Optional[str]] = \
     contextvars.ContextVar("kfs_request_id", default=None)
+
+
+def mint_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def mint_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def parse_traceparent(value: str) -> Optional[Tuple[str, str]]:
+    """(trace_id, parent_span_id) from a `traceparent` header, or None
+    when malformed (all-zero ids are invalid per spec)."""
+    parts = value.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    _version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if not _HEX32.match(trace_id) or not _HEX16.match(span_id):
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    return f"00-{trace_id}-{span_id}-01"
+
+
+@dataclass
+class TraceContext:
+    """One hop's view of the request's trace: the shared trace id, the
+    upstream hop's span id (None at the trace root), and this hop's
+    own span id (forwarded downstream as the parent)."""
+
+    trace_id: str
+    parent_span_id: Optional[str] = None
+    span_id: str = field(default_factory=mint_span_id)
+
+    def forward_traceparent(self) -> Optional[str]:
+        """The `traceparent` value to send downstream, or None when
+        the trace id is not W3C-shaped (a client-supplied
+        x-request-id keeps carrying context on its own header — never
+        rewrite the id the client correlates by)."""
+        if not _HEX32.match(self.trace_id):
+            return None
+        return format_traceparent(self.trace_id, self.span_id)
+
+
+def ensure_trace_context(headers: Dict[str, str],
+                         mint: str = "short") -> TraceContext:
+    """Join (or start) the request's trace and set the contextvar.
+
+    Precedence: a valid `traceparent` wins (its 32-hex trace id
+    becomes THE id on every layer's spans); else `x-request-id` (any
+    string — legacy correlation); else a fresh id is minted.
+    ``mint="w3c"`` mints a full 32-hex id (the ingress router, which
+    must emit a valid traceparent); ``"short"`` keeps the seed's
+    16-hex x-request-id shape (replica-local minting)."""
+    tp = headers.get(TRACEPARENT_HEADER)
+    if tp:
+        parsed = parse_traceparent(tp)
+        if parsed is not None:
+            ctx = TraceContext(parsed[0], parent_span_id=parsed[1])
+            current_request_id.set(ctx.trace_id)
+            return ctx
+    rid = headers.get(REQUEST_ID_HEADER)
+    if not rid:
+        rid = mint_trace_id() if mint == "w3c" else uuid.uuid4().hex[:16]
+    ctx = TraceContext(rid)
+    current_request_id.set(ctx.trace_id)
+    return ctx
 
 
 @dataclass
@@ -101,11 +181,10 @@ tracer = Tracer()
 
 
 def ensure_request_id(headers: Dict[str, str]) -> str:
-    """Read (or mint) the request id for an incoming request and set the
-    contextvar.  Returns the id so responses can echo it."""
-    rid = headers.get(REQUEST_ID_HEADER) or uuid.uuid4().hex[:16]
-    current_request_id.set(rid)
-    return rid
+    """Read (or mint) the request id for an incoming request and set
+    the contextvar.  Returns the id so responses can echo it.  Joins a
+    W3C trace when the request carries one (ensure_trace_context)."""
+    return ensure_trace_context(headers).trace_id
 
 
 class ProfilerControl:

@@ -47,6 +47,26 @@ def pytest_configure(config):
         "select just them with `-m chaos`")
 
 
+@pytest.fixture(autouse=True)
+def _metrics_registry_guard():
+    """Process-wide metrics isolation: the observability registry is
+    reset after EVERY test, and a test that begins with samples
+    already present fails loudly — that means some earlier code
+    leaked series past its teardown (bypassing this fixture), which
+    would let one test's gauges/counters assert another test's
+    /metrics expectations."""
+    from kfserving_tpu.observability import REGISTRY
+
+    leaked = REGISTRY.sample_names()
+    if leaked:
+        REGISTRY.reset()
+        pytest.fail(
+            "metrics registry held samples leaked from outside this "
+            f"test: {sorted(leaked)[:10]}")
+    yield
+    REGISTRY.reset()
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem):
     """Run `async def` tests in a fresh event loop (no pytest-asyncio in the

@@ -1,0 +1,511 @@
+"""Unified telemetry tests (ISSUE 2): W3C traceparent propagation
+across router/HTTP/gRPC hops, stage-timing metrics with OpenMetrics
+exemplars, reliability series, exposition-format validity, and the
+router's fleet /metrics federation.
+
+Runs in the tier-1 fast tier (no `slow` marker)."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.observability import REGISTRY
+from kfserving_tpu.observability.federation import (
+    merge_scrapes,
+    relabel,
+    split_sample,
+)
+from kfserving_tpu.observability.registry import Registry
+from kfserving_tpu.tracing import (
+    current_request_id,
+    ensure_trace_context,
+    format_traceparent,
+    parse_traceparent,
+    tracer,
+)
+from tests.utils import http_json, http_request, running_server
+
+TRACE_ID = "4bf92f3577b34da6a3ce929d0e0e4736"
+SPAN_ID = "00f067aa0ba902b7"
+
+
+def _write_mlp_dir(tmp_path, name="m", warmup=True):
+    from flax import serialization
+
+    from kfserving_tpu.models import create_model, init_params
+
+    model_dir = os.path.join(str(tmp_path), name)
+    os.makedirs(model_dir, exist_ok=True)
+    ak = {"input_dim": 4, "features": [8], "num_classes": 3}
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump({"architecture": "mlp", "arch_kwargs": ak,
+                   "max_latency_ms": 5, "warmup": warmup}, f)
+    spec = create_model("mlp", **ak)
+    with open(os.path.join(model_dir, "checkpoint.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(init_params(spec, seed=0)))
+    return model_dir
+
+
+# ------------------------------------------------------------ registry --
+def test_registry_labels_and_escaping():
+    reg = Registry()
+    reg.gauge("g", "help").labels(weird='a"b\\c\nd').set(2)
+    text = reg.render()
+    assert 'g{weird="a\\"b\\\\c\\nd"} 2' in text
+
+
+def test_registry_kind_conflict_raises():
+    reg = Registry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total")
+
+
+def test_registry_reset_drops_samples():
+    reg = Registry()
+    reg.counter("c_total").inc()
+    assert reg.sample_names() == ["c_total"]
+    reg.reset()
+    assert reg.sample_names() == []
+
+
+def test_histogram_exemplar_renders_on_bucket():
+    reg = Registry()
+    reg.histogram("h_ms").labels(m="x").observe(3.0, trace_id="tid-1")
+    text = reg.render()
+    assert '# {trace_id="tid-1"} 3' in text
+    # The exemplar rides the bucket the observation fell into.
+    line = next(ln for ln in text.splitlines() if "# {" in ln)
+    assert 'le="5"' in line
+
+
+# --------------------------------------------------------- traceparent --
+def test_traceparent_parse_roundtrip():
+    hdr = format_traceparent(TRACE_ID, SPAN_ID)
+    assert parse_traceparent(hdr) == (TRACE_ID, SPAN_ID)
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent("00-" + "0" * 32 + f"-{SPAN_ID}-01") is None
+    assert parse_traceparent(f"00-{TRACE_ID}-badhex-01") is None
+
+
+def test_ensure_trace_context_precedence():
+    ctx = ensure_trace_context({
+        "traceparent": format_traceparent(TRACE_ID, SPAN_ID),
+        "x-request-id": "legacy"})
+    assert ctx.trace_id == TRACE_ID
+    assert ctx.parent_span_id == SPAN_ID
+    assert current_request_id.get() == TRACE_ID
+    assert ctx.forward_traceparent().startswith(f"00-{TRACE_ID}-")
+    # A non-W3C x-request-id keeps its own header as carrier: no
+    # traceparent is fabricated for it.
+    ctx = ensure_trace_context({"x-request-id": "my-rid"})
+    assert ctx.trace_id == "my-rid"
+    assert ctx.forward_traceparent() is None
+    current_request_id.set(None)
+
+
+# ------------------------------------------------- exposition validity --
+def _parse_exposition(text):
+    """Small line parser for the Prometheus text format (exemplar
+    suffixes tolerated): returns [(name, labels_dict, value)]."""
+    samples = []
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        parsed = split_sample(line)
+        assert parsed is not None, f"unparseable line: {line!r}"
+        name, inner, rest = parsed
+        labels = {}
+        i = 0
+        while i < len(inner):
+            eq = inner.index("=", i)
+            key = inner[i:eq]
+            assert inner[eq + 1] == '"', f"bad label in {line!r}"
+            j = eq + 2
+            val = []
+            while inner[j] != '"':
+                if inner[j] == "\\":
+                    nxt = inner[j + 1]
+                    val.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                    j += 2
+                else:
+                    val.append(inner[j])
+                    j += 1
+            labels[key] = "".join(val)
+            i = j + 1
+            if i < len(inner) and inner[i] == ",":
+                i += 1
+        value = rest.split(" # ")[0].strip()
+        samples.append((name, labels, float(value)))
+    return samples
+
+
+async def test_metrics_exposition_is_valid(tmp_path):
+    """Parse the FULL /metrics output: histogram buckets must be
+    monotone, the +Inf bucket must equal _count, and set_gauge label
+    values must escape properly (satellite: exposition validation)."""
+    from kfserving_tpu.predictors.jax_model import JaxModel
+
+    model = JaxModel("m", _write_mlp_dir(tmp_path))
+    model.load()
+    async with running_server([model]) as server:
+        await http_json(server.http_port, "POST",
+                        "/v1/models/m:predict",
+                        {"instances": np.ones((2, 4)).tolist()})
+        server.metrics.set_gauge("kfs_test_escaping", 1.0,
+                                 {"m": 'we"ird\\lab\nel'})
+        status, _, raw = await http_request(server.http_port, "GET",
+                                            "/metrics")
+        # Exemplars appear ONLY under the OpenMetrics content type.
+        assert " # {" not in raw.decode()
+        _, om_headers, om_raw = await http_request(
+            server.http_port, "GET", "/metrics",
+            headers={"accept": "application/openmetrics-text"})
+        assert "openmetrics-text" in om_headers["content-type"]
+        assert " # {" in om_raw.decode()
+        assert om_raw.decode().rstrip().endswith("# EOF")
+    assert status == 200
+    samples = _parse_exposition(raw.decode())
+    gauge = [s for s in samples if s[0] == "kfs_test_escaping"]
+    assert gauge and gauge[0][1]["m"] == 'we"ird\\lab\nel'
+
+    # Group histogram buckets by (family, non-le labels).
+    hists = {}
+    for name, labels, value in samples:
+        if name.endswith("_bucket"):
+            base = name[:-len("_bucket")]
+            key = (base, tuple(sorted((k, v) for k, v in labels.items()
+                                      if k != "le")))
+            hists.setdefault(key, {})[labels["le"]] = value
+    assert hists, "no histograms in /metrics"
+    counts = {(name, labels): value
+              for name, labels, value in samples
+              if name.endswith("_count")
+              for labels in [tuple(sorted(labels.items()))]}
+    for (base, key), buckets in hists.items():
+        assert "+Inf" in buckets, f"{base} missing +Inf bucket"
+        finite = sorted(((float(le), v) for le, v in buckets.items()
+                         if le != "+Inf"))
+        cum = [v for _, v in finite] + [buckets["+Inf"]]
+        assert cum == sorted(cum), f"{base} buckets not monotone"
+        count = counts.get((f"{base}_count", key))
+        assert count is not None, f"{base}_count missing"
+        assert buckets["+Inf"] == count, \
+            f"{base} +Inf bucket != _count"
+    # The request latency series made it through with stage-timing
+    # company from the process registry.
+    names = {s[0] for s in samples}
+    assert "kfserving_tpu_request_latency_ms_bucket" in names
+    assert "kfserving_tpu_engine_stage_ms_bucket" in names
+    assert "kfserving_tpu_batch_queue_wait_ms_bucket" in names
+
+
+# ------------------------------------- contextvar trace propagation --
+async def test_concurrent_requests_never_cross_attach_spans():
+    """Two interleaved request contexts driving the SAME engine's
+    executor threads: every engine.execute span must land on the
+    trace that dispatched it (disjoint per-trace span sets)."""
+    from kfserving_tpu.engine.buckets import BucketPolicy
+    from kfserving_tpu.engine.jax_engine import JaxEngine
+
+    tracer.clear()
+    engine = JaxEngine(lambda params, x: x * 2.0, {},
+                       batch_buckets=BucketPolicy([1, 2, 4]))
+
+    async def drive(trace_id, batch):
+        current_request_id.set(trace_id)
+        for _ in range(4):
+            await engine.predict(np.ones((batch, 3), np.float32))
+
+    await asyncio.gather(drive("trace-a", 1), drive("trace-b", 2))
+    spans_a = [s for s in tracer.spans("trace-a", limit=100)
+               if s["name"] == "engine.execute"]
+    spans_b = [s for s in tracer.spans("trace-b", limit=100)
+               if s["name"] == "engine.execute"]
+    assert len(spans_a) == 4 and len(spans_b) == 4
+    # Batch size is the fingerprint: a cross-attached span would show
+    # the other request's batch under this trace id.
+    assert {s["attrs"]["batch"] for s in spans_a} == {1}
+    assert {s["attrs"]["batch"] for s in spans_b} == {2}
+    current_request_id.set(None)
+    engine.close()
+
+
+async def test_server_joins_w3c_trace(tmp_path):
+    """A traceparent header joins server AND engine spans to the W3C
+    trace id; the response echoes it for correlation."""
+    from kfserving_tpu.predictors.jax_model import JaxModel
+
+    tracer.clear()
+    model = JaxModel("m", _write_mlp_dir(tmp_path))
+    model.load()
+    async with running_server([model]) as server:
+        status, headers, _ = await http_request(
+            server.http_port, "POST", "/v1/models/m:predict",
+            json.dumps({"instances": np.ones((1, 4)).tolist()}).encode(),
+            headers={"traceparent":
+                     format_traceparent(TRACE_ID, SPAN_ID)})
+        assert status == 200
+        assert headers.get("x-request-id") == TRACE_ID
+        status, body = await http_json(
+            server.http_port, "GET",
+            f"/debug/traces?trace_id={TRACE_ID}")
+        names = {s["name"] for s in body["spans"]}
+        assert "server.infer" in names
+        assert "engine.execute" in names
+
+        # Bad limit is a clean 400, not a 500.
+        status, _ = await http_json(
+            server.http_port, "GET", "/debug/traces?limit=bogus")
+        assert status == 400
+
+
+# -------------------------------------------------- generation series --
+async def test_generation_latency_series():
+    """TTFT / inter-token / tokens-per-second histograms populate from
+    a generation, exemplared with the submitting trace id."""
+    import jax
+    import jax.numpy as jnp
+
+    from kfserving_tpu.engine.generator import GenerationEngine
+    from kfserving_tpu.models.decoder import DecoderLM, decoder_tiny
+
+    cfg = decoder_tiny(num_layers=1, hidden_size=32, num_heads=2,
+                       intermediate_size=64, max_seq=32,
+                       vocab_size=64)
+    module = DecoderLM(cfg)
+    variables = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+    engine = GenerationEngine(module, variables, max_slots=2,
+                              max_seq=32, prefill_buckets=[8, 16])
+    current_request_id.set("gen-trace-1")
+    tokens, reason = await engine.complete([1, 2, 3],
+                                           max_new_tokens=4)
+    current_request_id.set(None)
+    await engine.close()
+    assert len(tokens) >= 1
+    text = REGISTRY.render()
+    assert "kfserving_tpu_llm_ttft_ms_bucket" in text
+    assert "kfserving_tpu_llm_tokens_per_second_bucket" in text
+    assert 'kfserving_tpu_llm_tokens_total{direction="out"}' in text
+    if len(tokens) > 1:
+        assert "kfserving_tpu_llm_inter_token_ms_bucket" in text
+    assert 'trace_id="gen-trace-1"' in text  # exemplar landed
+
+
+# ------------------------------------------------- reliability series --
+def test_breaker_retry_deadline_series():
+    from kfserving_tpu.reliability import (
+        CircuitBreaker,
+        DeadlineExceeded,
+        RetryPolicy,
+    )
+
+    breaker = CircuitBreaker(failure_threshold=2, window_s=30,
+                             name="replica:h1")
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == "open"
+
+    policy = RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                         name="storage")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ConnectionError("boom")
+        return "ok"
+
+    assert policy.call(flaky) == "ok"
+
+    with pytest.raises(DeadlineExceeded):
+        raise DeadlineExceeded("batch queue")
+
+    text = REGISTRY.render()
+    assert 'kfserving_tpu_breaker_state{name="replica:h1"} 2' in text
+    assert ('kfserving_tpu_breaker_transitions_total'
+            '{name="replica:h1",to="open"} 1') in text
+    assert ('kfserving_tpu_retry_total{edge="storage",'
+            'reason="ConnectionError"} 1') in text
+    assert ('kfserving_tpu_deadline_exceeded_total'
+            '{stage="batch queue"} 1') in text
+
+
+# ---------------------------------------------------- gRPC accounting --
+async def test_grpc_requests_land_in_request_counter(tmp_path):
+    """gRPC inference shows up in kfserving_tpu_request_total (the
+    recycling watchdog's max_requests trigger scrapes it — a
+    gRPC-only deployment must not undercount)."""
+    grpc = pytest.importorskip("grpc")
+
+    from kfserving_tpu.predictors.jax_model import JaxModel
+    from kfserving_tpu.protocol.grpc import pb2
+    from kfserving_tpu.server.app import ModelServer
+
+    model = JaxModel("m", _write_mlp_dir(tmp_path, warmup=False))
+    model.load()
+    server = ModelServer(http_port=0, grpc_port=0)
+    await server.start_async([model], host="127.0.0.1")
+    channel = grpc.aio.insecure_channel(f"127.0.0.1:{server.grpc_port}")
+    try:
+        req = pb2.ModelInferRequest(model_name="m")
+        tensor = req.inputs.add()
+        tensor.name = "input_0"
+        tensor.datatype = "FP32"
+        tensor.shape.extend([1, 4])
+        tensor.contents.fp32_contents.extend([1.0] * 4)
+        infer = channel.unary_unary(
+            "/inference.GRPCInferenceService/ModelInfer",
+            request_serializer=pb2.ModelInferRequest.SerializeToString,
+            response_deserializer=pb2.ModelInferResponse.FromString)
+        await infer(req, metadata=(
+            ("traceparent", format_traceparent(TRACE_ID, SPAN_ID)),))
+        status, _, raw = await http_request(server.http_port, "GET",
+                                            "/metrics")
+        text = raw.decode()
+        assert ('kfserving_tpu_request_total{model="m",status="200",'
+                'verb="infer"} 1') in text
+    finally:
+        await channel.close()
+        await server.stop_async()
+
+
+# --------------------------------------------- router e2e acceptance --
+def _write_sklearn_artifact(path):
+    import joblib
+    from sklearn import datasets, svm
+
+    os.makedirs(path, exist_ok=True)
+    X, y = datasets.load_iris(return_X_y=True)
+    joblib.dump(svm.SVC(gamma="scale").fit(X, y),
+                os.path.join(path, "model.joblib"))
+
+
+async def test_router_trace_propagation_and_federation(tmp_path):
+    """Acceptance: a traceparent request through the ingress router
+    yields router AND replica spans sharing the trace id, and the
+    router's /metrics federates replica series under a `replica`
+    label with at least one exemplar referencing the live trace."""
+    import aiohttp
+
+    from kfserving_tpu.control.controller import Controller
+    from kfserving_tpu.control.orchestrator import InProcessOrchestrator
+    from kfserving_tpu.control.router import IngressRouter
+    from kfserving_tpu.control.spec import (
+        InferenceService,
+        PredictorSpec,
+    )
+
+    tracer.clear()
+    artifact = str(tmp_path / "iris")
+    _write_sklearn_artifact(artifact)
+    orch = InProcessOrchestrator()
+    c = Controller(orch)
+    router = IngressRouter(c)
+    await router.start_async()
+    try:
+        isvc = InferenceService(
+            name="iris",
+            predictor=PredictorSpec(framework="sklearn",
+                                    storage_uri=f"file://{artifact}"))
+        status = await c.apply(isvc)
+        assert status.ready
+
+        base = f"http://127.0.0.1:{router.http_port}"
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f"{base}/v1/models/iris:predict",
+                    json={"instances": [[6.8, 2.8, 4.8, 1.4]]},
+                    headers={"traceparent": format_traceparent(
+                        TRACE_ID, SPAN_ID)}) as resp:
+                assert resp.status == 200
+                assert resp.headers.get("x-request-id") == TRACE_ID
+
+            # Federated trace: router and replica spans share the id.
+            async with session.get(
+                    f"{base}/debug/traces?trace_id={TRACE_ID}"
+                    f"&limit=50") as resp:
+                assert resp.status == 200
+                spans = (await resp.json())["spans"]
+            names = {s["name"] for s in spans}
+            assert "router.proxy" in names
+            assert "server.infer" in names
+            assert all(s["trace_id"] == TRACE_ID for s in spans)
+
+            # ?replica=router restricts to the router's own buffer
+            # (no replica scrape fan-out).
+            async with session.get(
+                    f"{base}/debug/traces?trace_id={TRACE_ID}"
+                    f"&replica=router") as resp:
+                router_only = (await resp.json())["spans"]
+            assert router_only
+            assert {s["replica"] for s in router_only} == {"router"}
+
+            async with session.get(f"{base}/metrics") as resp:
+                assert resp.status == 200
+                plain = await resp.text()
+            async with session.get(
+                    f"{base}/metrics",
+                    headers={"accept":
+                             "application/openmetrics-text"}) as resp:
+                assert resp.status == 200
+                assert "openmetrics-text" in \
+                    resp.headers["content-type"]
+                om = await resp.text()
+        # Router-side series...
+        assert "kfserving_tpu_router_request_ms_bucket" in plain
+        assert "kfserving_tpu_router_inflight" in plain
+        # ...replica series federated under a replica label...
+        assert 'kfserving_tpu_request_total{replica="' in plain
+        # ...each family declared exactly once in the merged output
+        # (strict parsers reject re-declared families)...
+        type_names = [ln.split()[2] for ln in plain.splitlines()
+                      if ln.startswith("# TYPE ")]
+        assert len(type_names) == len(set(type_names))
+        # ...exemplars only under the OpenMetrics content type (the
+        # classic text parser would reject the suffix), referencing
+        # the live trace, including on federated replica series.
+        assert " # {" not in plain
+        assert f'trace_id="{TRACE_ID}"' in om
+        assert om.rstrip().endswith("# EOF")
+    finally:
+        await router.stop_async()
+        await orch.shutdown()
+
+
+def test_merge_scrapes_groups_families():
+    """Shared families declare once with ALL samples contiguous (own
+    + every replica's) — the shape strict OpenMetrics parsers need."""
+    own = ["# TYPE h_ms histogram",
+           'h_ms_bucket{le="+Inf"} 1', "h_ms_sum 1", "h_ms_count 1",
+           "# TYPE c_total counter", "c_total 2"]
+    replica = ("# TYPE h_ms histogram\n"
+               'h_ms_bucket{le="+Inf"} 4\nh_ms_sum 9\nh_ms_count 4\n'
+               "# TYPE g gauge\ng 7\n")
+    lines = merge_scrapes(own, [("h1:1", replica), ("h2:2", replica)])
+    types = [ln for ln in lines if ln.startswith("# TYPE")]
+    assert len(types) == len(set(types)) == 3
+    # All h_ms samples sit in one contiguous block after its TYPE.
+    h_lines = [i for i, ln in enumerate(lines)
+               if ln.startswith("h_ms")]
+    assert h_lines == list(range(h_lines[0], h_lines[0] + 9))
+    assert 'h_ms_count{replica="h1:1"} 4' in lines
+    assert 'g{replica="h2:2"} 7' in lines
+
+
+def test_relabel_survives_weird_labels():
+    text = ('m_total{path="a} b\\"c"} 3\n'
+            "# TYPE m_total counter\n"
+            "bare_metric 1\n")
+    seen = set()
+    lines = relabel(text, {"replica": "h:1"}, seen)
+    assert 'm_total{replica="h:1",path="a} b\\"c"} 3' in lines
+    assert 'bare_metric{replica="h:1"} 1' in lines
+    # TYPE passes through once.
+    assert sum(1 for ln in lines if ln.startswith("# TYPE")) == 1
+    assert relabel("# TYPE m_total counter\n", {"replica": "h:2"},
+                   seen) == []
