@@ -23,6 +23,9 @@ import uuid
 from enum import Enum
 from typing import Optional
 
+from kfserving_tpu.observability import metrics as obs
+from kfserving_tpu.tracing import current_request_id
+
 logger = logging.getLogger("kfserving_tpu.agent.logger")
 
 CE_TYPE_REQUEST = "org.kubeflow.serving.inference.request"
@@ -106,18 +109,36 @@ class RequestLogger:
         try:
             self.queue.put_nowait((event, payload))
         except asyncio.QueueFull:
+            if self.dropped == 0:
+                # Warn ONCE: sustained overload would otherwise log a
+                # line per mirrored payload — the registry counter is
+                # the ongoing signal, this line is the page.
+                logger.warning(
+                    "payload log queue full (size %d): dropping "
+                    "events (kfserving_tpu_payload_log_total"
+                    "{outcome=\"dropped\"} counts further drops)",
+                    self.queue.maxsize)
             self.dropped += 1
+            obs.payload_log_total().labels(outcome="dropped").inc()
+        obs.payload_log_queued().set(self.queue.qsize())
 
     def attach(self, server) -> None:
         """Hook into a ModelServer: tees both directions per request with a
         shared CE id (reference pairs request/response by id,
-        logger/handler.go:85-124)."""
+        logger/handler.go:85-124).
+
+        The CE id is the request's ACTIVE trace id (the W3C/x-request-id
+        the tracing contextvar carries at hook time), so payload events
+        join the distributed trace — a drifted payload links straight to
+        its spans at /debug/traces.  A fresh uuid only when untraced."""
         def hook(name, verb, req, resp, latency_ms):
-            rid = str(uuid.uuid4())
+            rid = current_request_id.get() or str(uuid.uuid4())
+            status = resp.status if resp is not None else 200
             self.log(name, verb, "request", req.body, request_id=rid,
-                     status=resp.status)
-            self.log(name, verb, "response", resp.body, request_id=rid,
-                     status=resp.status)
+                     status=status)
+            if resp is not None:
+                self.log(name, verb, "response", resp.body,
+                         request_id=rid, status=status)
 
         server.request_hooks.append(hook)
 
@@ -128,13 +149,16 @@ class RequestLogger:
             try:
                 await self._send(event, payload)
                 self.sent += 1
+                obs.payload_log_total().labels(outcome="sent").inc()
             except asyncio.CancelledError:
                 raise
             except Exception as e:
                 self.failed += 1
+                obs.payload_log_total().labels(outcome="failed").inc()
                 logger.warning("log sink send failed: %s", e)
             finally:
                 self.queue.task_done()
+                obs.payload_log_queued().set(self.queue.qsize())
 
     async def _send(self, event: dict, payload: bytes):
         # Binary CloudEvents encoding: attributes -> ce- headers.
@@ -152,6 +176,10 @@ class RequestLogger:
                 raise RuntimeError(f"sink returned {resp.status}")
 
     def stats(self) -> dict:
+        """Instance snapshot.  The same numbers export as registry
+        series (`kfserving_tpu_payload_log_total{outcome=...}` and
+        `kfserving_tpu_payload_log_queued`) so /metrics scrapers see
+        them without holding the logger object."""
         return {"sent": self.sent, "failed": self.failed,
                 "dropped": self.dropped, "queued": self.queue.qsize()}
 

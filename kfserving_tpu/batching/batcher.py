@@ -51,6 +51,7 @@ Differences, by design (SURVEY.md §7.3):
 
 import asyncio
 import logging
+import time
 import uuid
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable, Dict, Hashable, List, Optional
@@ -62,6 +63,7 @@ from kfserving_tpu.reliability.deadline import (
     clear_deadline,
     current_deadline,
 )
+from kfserving_tpu.tracing import Span, current_request_id, tracer
 
 logger = logging.getLogger("kfserving_tpu.batcher")
 
@@ -96,6 +98,10 @@ class _Waiter:
     # wastes a batch slot.
     budget: Optional[Deadline] = None
     expiry: Optional[asyncio.TimerHandle] = None
+    # The submitting request's trace id, captured at submit like the
+    # budget: the flush records a `batcher.queue` span against it so
+    # the flight recorder's timeline shows time spent coalescing.
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -189,7 +195,7 @@ class DynamicBatcher:
         future = loop.create_future()
         waiter = _Waiter(start, len(instances), future,
                          loop.time() + self.max_latency_ms / 1000.0,
-                         budget)
+                         budget, trace_id=current_request_id.get())
         pending.waiters.append(waiter)
         if budget is not None:
             # Fail at the budget's expiry moment, not at the next
@@ -354,19 +360,30 @@ class DynamicBatcher:
             # pad slots burned).
             wait_hist = obs.batch_queue_wait_ms()
             now = loop.time()
-            for w in head.waiters:
-                wait_hist.labels(bucket=str(key)).observe(max(
-                    0.0, (now - (w.flush_at
-                                 - self.max_latency_ms / 1000.0))
-                    * 1000.0))
             n = len(head.instances)
             if self._bucket_policy is not None:
                 padded = self._bucket_policy.fit(
                     min(n, self.max_batch_size)) or n
             else:
                 padded = self.max_batch_size
-            obs.batch_fill_ratio().labels(bucket=str(key)).observe(
-                min(1.0, n / padded))
+            fill = min(1.0, n / padded)
+            for w in head.waiters:
+                wait_ms = max(
+                    0.0, (now - (w.flush_at
+                                 - self.max_latency_ms / 1000.0))
+                    * 1000.0)
+                wait_hist.labels(bucket=str(key)).observe(wait_ms)
+                if w.trace_id is not None:
+                    # One completed `batcher.queue` span per flushed
+                    # request: the flight recorder's view of time
+                    # spent coalescing, and of the batch fill its
+                    # wait bought.
+                    tracer.record(Span(
+                        w.trace_id, "batcher.queue",
+                        time.time() - wait_ms / 1000.0, wait_ms,
+                        {"bucket": str(key), "batch": n,
+                         "fill": round(fill, 4)}))
+            obs.batch_fill_ratio().labels(bucket=str(key)).observe(fill)
         self._inflight += 1
         task = asyncio.ensure_future(self._run_batch(key, head))
         self._tasks.add(task)

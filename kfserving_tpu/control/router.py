@@ -121,6 +121,12 @@ class IngressRouter:
         # without dumping every ring buffer).
         r.add("GET", "/metrics", self._metrics)
         r.add("GET", "/debug/traces", self._debug_traces)
+        # Monitoring-loop federation (ISSUE 3): fleet SLO health and
+        # flight-recorder timelines, replica-scraped like the trace
+        # view (?replica= narrows to one host).
+        r.add("GET", "/v2/health/slo", self._slo_health)
+        r.add("GET", "/debug/flightrecorder",
+              self._debug_flightrecorder)
 
     async def start_async(self, host: str = "127.0.0.1"):
         # force_close: no keep-alive pooling to upstreams.  A reused
@@ -490,6 +496,26 @@ class IngressRouter:
             logger.debug("scrape of %s%s failed", host, path)
             return None
 
+    async def _scrape_json_all(self, hosts, path: str):
+        """Concurrent JSON scrape of `path` from every host: the
+        shared fan-out of all federated debug/health views.  Yields
+        (host, parsed body) pairs; unreachable hosts and non-JSON
+        answers are skipped (a sick replica must not fail the fleet
+        view), and N sick replicas cost ONE scrape timeout, not N."""
+        if self._session is None or not hosts:
+            return []
+        texts = await asyncio.gather(
+            *[self._scrape(host, path) for host in hosts])
+        out = []
+        for host, text in zip(hosts, texts):
+            if text is None:
+                continue
+            try:
+                out.append((host, json.loads(text)))
+            except ValueError:
+                continue
+        return out
+
     async def _metrics(self, req: Request) -> Response:
         self._refresh_own_series()
         want_om = "application/openmetrics-text" in \
@@ -558,21 +584,54 @@ class IngressRouter:
             hosts = [only]
         else:
             hosts = self._replica_hosts()
-        if self._session is not None and hosts:
-            texts = await asyncio.gather(
-                *[self._scrape(host, f"/debug/traces{qs}")
-                  for host in hosts])
-            for host, text in zip(hosts, texts):
-                if text is None:
-                    continue
-                try:
-                    body = json.loads(text)
-                except ValueError:
-                    continue
-                for s in body.get("spans", []):
-                    add(s, host)
+        for host, body in await self._scrape_json_all(
+                hosts, f"/debug/traces{qs}"):
+            for s in body.get("spans", []):
+                add(s, host)
         return Response(json.dumps(
             {"spans": list(merged.values())}).encode())
+
+    async def _slo_health(self, req: Request) -> Response:
+        """Fleet SLO view: every replica's /v2/health/slo merged under
+        its host, plus the union of alerting (replica, model) pairs —
+        one scrape answers "is anything burning budget anywhere"."""
+        qs = "?refresh=1" if req.query.get("refresh") == "1" else ""
+        replicas: Dict[str, dict] = {}
+        alerting = []
+        for host, body in await self._scrape_json_all(
+                self._replica_hosts(), f"/v2/health/slo{qs}"):
+            replicas[host] = body
+            for model in body.get("alerting", []):
+                alerting.append({"replica": host, "model": model})
+        return Response(json.dumps({
+            "healthy": not alerting,
+            "alerting": alerting,
+            "replicas": replicas,
+        }).encode())
+
+    async def _debug_flightrecorder(self, req: Request) -> Response:
+        """Federated flight-recorder dump: each replica's entries and
+        pinned entries, tagged with the serving replica."""
+        try:
+            limit = int(req.query.get("limit", "100"))
+        except ValueError:
+            return Response(b'{"error": "limit must be an integer"}',
+                            status=400)
+        only = req.query.get("replica")
+        hosts = [only] if only else self._replica_hosts()
+        qs = f"?limit={limit}"
+        if req.query.get("pinned", "0") == "1":
+            qs += "&pinned=1"
+        entries: list = []
+        pinned: list = []
+        for host, body in await self._scrape_json_all(
+                hosts, f"/debug/flightrecorder{qs}"):
+            entries += [dict(e, replica=host)
+                        for e in body.get("entries", [])]
+            pinned += [dict(e, replica=host)
+                       for e in body.get("pinned", [])]
+        return Response(json.dumps(
+            {"entries": entries, "pinned": pinned}).encode())
 
     # Transport-level failover attempts per request: a crashed replica is
     # evicted and the request retries the next one (the reference leans

@@ -60,6 +60,35 @@ def ks_p_value(d: float, n: int, m: int) -> float:
     return max(0.0, min(1.0, 2.0 * total))
 
 
+def ks_drift_test(ref_sorted: np.ndarray, window: np.ndarray,
+                  reference_len: int,
+                  p_value: float) -> Dict[str, Any]:
+    """Bonferroni-corrected per-feature KS drift verdict: the shared
+    core of the served `KSDriftDetector` and the streaming
+    `observability.monitoring.DriftMonitor` — one implementation, two
+    deployment shapes.
+
+    ref_sorted: [m, d] reference, column-sorted once at load/fit.
+    window: [w, d] live sample.  Returns drift flag, per-feature
+    p-values, the max KS statistic (the exported drift score), and
+    the corrected threshold."""
+    d = ref_sorted.shape[1]
+    stats, p_values = [], []
+    for j in range(d):
+        stat = ks_statistic(ref_sorted[:, j], window[:, j],
+                            a_sorted=True)
+        stats.append(stat)
+        p_values.append(ks_p_value(stat, reference_len, len(window)))
+    threshold = p_value / d  # Bonferroni
+    return {
+        "drift": bool(min(p_values) < threshold),
+        "score": float(max(stats)),
+        "p_values": p_values,
+        "threshold": threshold,
+        "window": len(window),
+    }
+
+
 class KSDriftDetector(Model):
     """Sliding-window per-feature KS drift vs a reference sample.
 
@@ -147,23 +176,13 @@ class KSDriftDetector(Model):
                 self.last_result is not None:
             return self.last_result
         self._rows_since_test = 0
-        win = np.stack(self.window)
-        p_values = []
-        for j in range(d):
-            stat = ks_statistic(self._ref_sorted[:, j], win[:, j],
-                                a_sorted=True)
-            p_values.append(ks_p_value(stat, len(self.reference),
-                                       len(win)))
-        threshold = self.p_value / d  # Bonferroni
-        is_drift = bool(min(p_values) < threshold)
-        if is_drift:
+        result = ks_drift_test(self._ref_sorted, np.stack(self.window),
+                               len(self.reference), self.p_value)
+        if result["drift"]:
             self.drift_events += 1
-        self.last_result = {
-            "drift": is_drift,
-            "p_values": [round(p, 6) for p in p_values],
-            "threshold": threshold,
-            "window": len(win),
-        }
+        result["p_values"] = [round(p, 6) for p in result["p_values"]]
+        del result["score"]  # response-shape compatibility
+        self.last_result = result
         return self.last_result
 
     def metadata(self) -> Dict[str, Any]:

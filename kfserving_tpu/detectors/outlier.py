@@ -56,6 +56,15 @@ class MahalanobisScorer:
                                  delta))
 
 
+def fit_threshold(scorer: MahalanobisScorer, train: np.ndarray,
+                  percentile: float = 99.5) -> float:
+    """Threshold = a percentile of the train sample's own scores: the
+    shared fit used by the served `OutlierDetector` and the streaming
+    `observability.monitoring.OutlierMonitor`."""
+    return float(np.percentile(scorer.score(
+        np.asarray(train, np.float64)), percentile))
+
+
 class OutlierDetector(Model):
     """Served detector: scores request payloads against the training
     distribution; responds (and counts) per-instance verdicts.
@@ -94,9 +103,9 @@ class OutlierDetector(Model):
         if "threshold" in cfg:
             self.threshold = float(cfg["threshold"])
         else:
-            pct = float(cfg.get("threshold_percentile", 99.5))
-            self.threshold = float(np.percentile(
-                self.scorer.score(train), pct))
+            self.threshold = fit_threshold(
+                self.scorer, train,
+                float(cfg.get("threshold_percentile", 99.5)))
         self.ready = True
         return True
 

@@ -991,6 +991,23 @@ class GenerationEngine:
                 item[1].add_done_callback(
                     lambda f: f.cancelled() or f.exception())
 
+    def _record_finish_span(self, req, tokens: int,
+                            finished: str) -> None:
+        """One completed `generator.generate` span per finished
+        generation — EVERY terminal path records it (eos/length AND
+        deadline timeouts), because the timed-out request is exactly
+        the one the flight recorder pins and must find decode-phase
+        evidence for."""
+        if req.trace_id is None:
+            return
+        from kfserving_tpu.tracing import Span, tracer
+
+        duration_s = max(0.0, time.perf_counter() - req.submit_t)
+        tracer.record(Span(
+            req.trace_id, "generator.generate",
+            time.time() - duration_s, duration_s * 1000.0,
+            {"tokens": tokens, "finish_reason": finished}))
+
     def _expire_deadlines(self) -> None:
         """Between decode waves: requests whose budget ran out get a
         terminal "timeout" event and free their slot (active) or leave
@@ -1000,6 +1017,7 @@ class GenerationEngine:
             if s is not None and s.req.deadline is not None \
                     and s.req.deadline.expired:
                 s.req.out.put_nowait((None, "timeout"))
+                self._record_finish_span(s.req, s.generated, "timeout")
                 self._free_slot_state(i)
                 self.requests_finished += 1
         if any(r.deadline is not None and r.deadline.expired
@@ -1009,6 +1027,7 @@ class GenerationEngine:
                 r = self._pending.popleft()
                 if r.deadline is not None and r.deadline.expired:
                     r.out.put_nowait((None, "timeout"))
+                    self._record_finish_span(r, 0, "timeout")
                     self.requests_finished += 1
                 else:
                     keep.append(r)
@@ -1366,6 +1385,7 @@ class GenerationEngine:
                 obs.llm_tokens_per_second().observe(
                     s.generated / duration_s,
                     trace_id=s.req.trace_id)
+            self._record_finish_span(s.req, s.generated, finished)
             self._free_slot_state(slot)
             self.requests_finished += 1
         else:

@@ -18,6 +18,11 @@ metrics.  This package is the shared substrate:
 - `federation` — /metrics relabeling helpers for the ingress router's
   fleet scrape (every replica series re-emitted under a `replica`
   label).
+- `monitoring` — the loop that ACTS on the above: monitor bus (a
+  bounded, never-blocking request tee), streaming drift/outlier
+  monitors, the per-model SLO burn-rate engine behind
+  `GET /v2/health/slo`, and the flight recorder behind
+  `GET /debug/flightrecorder`.
 
 Import discipline: this package imports nothing from `server/`,
 `control/`, `engine/`, or `reliability/` — those layers import *it*,

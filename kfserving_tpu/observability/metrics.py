@@ -21,6 +21,16 @@ from kfserving_tpu.observability.registry import (
     THROUGHPUT_BUCKETS,
 )
 
+# The per-request accounting series every consumer keys on: the
+# server's Metrics feeds them, the recycling watchdog scrapes the
+# counter by literal name, and the SLO engine reads both.  They live
+# HERE (the lowest observability layer) so upper layers share one
+# constant instead of re-declaring the literal — a rename that skips
+# a consumer would silently disable request-count recycling or zero
+# every SLO burn rate.
+REQUEST_TOTAL_SERIES = "kfserving_tpu_request_total"
+REQUEST_LATENCY_SERIES = "kfserving_tpu_request_latency_ms"
+
 
 # -- batcher ------------------------------------------------------------
 def batch_queue_wait_ms():
@@ -102,6 +112,82 @@ def deadline_exceeded_total():
     return REGISTRY.counter(
         "kfserving_tpu_deadline_exceeded_total",
         "Requests shed because their latency budget ran out, by stage")
+
+
+# -- monitoring loop ----------------------------------------------------
+def monitor_events_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_monitor_events_total",
+        "Monitor-bus publish outcomes (outcome=published|sampled_out|"
+        "dropped; dropped = bounded queue full, serving never blocks)")
+
+
+def monitor_consumer_errors_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_monitor_consumer_errors_total",
+        "Monitor consumer callbacks that raised (by consumer name); "
+        "a broken monitor never breaks the bus or serving")
+
+
+def monitor_alert_state():
+    return REGISTRY.gauge(
+        "kfserving_tpu_monitor_alert_state",
+        "Per-model online monitor alert state (monitor=drift|outlier; "
+        "1 = alerting)")
+
+
+def drift_score():
+    return REGISTRY.gauge(
+        "kfserving_tpu_drift_score",
+        "Max per-feature two-sample KS statistic of the live window "
+        "vs the reference sample (0 = identical distributions)")
+
+
+def outlier_rate():
+    return REGISTRY.gauge(
+        "kfserving_tpu_outlier_rate",
+        "Fraction of the sliding window flagged as Mahalanobis "
+        "outliers against the reference distribution")
+
+
+def slo_burn_rate():
+    return REGISTRY.gauge(
+        "kfserving_tpu_slo_burn_rate",
+        "Error-budget burn rate per model/objective/window (1.0 = "
+        "spending exactly the budget; alert past the threshold)")
+
+
+def slo_alert_state():
+    return REGISTRY.gauge(
+        "kfserving_tpu_slo_alert_state",
+        "Per-model SLO alert state (1 = burn rate over threshold on "
+        "every configured window)")
+
+
+def slo_breaches_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_slo_breaches_total",
+        "SLO alert activations (0 -> 1 transitions) per model")
+
+
+def flightrecorder_pinned_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_flightrecorder_pinned_total",
+        "Flight-recorder entries pinned, by trigger reason")
+
+
+# -- payload logger -----------------------------------------------------
+def payload_log_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_payload_log_total",
+        "CloudEvents payload-logger events by outcome "
+        "(outcome=sent|failed|dropped)")
+
+
+def payload_log_queued():
+    return REGISTRY.gauge(
+        "kfserving_tpu_payload_log_queued",
+        "CloudEvents payload-logger queue depth")
 
 
 # -- ingress router -----------------------------------------------------

@@ -1,0 +1,201 @@
+"""Online model monitoring: the loop that ACTS on PR 1/2's telemetry.
+
+PR 2 made the stack observable (traces, stage metrics, federation) but
+nothing in-process consumed any of it.  This package closes the loop,
+per InferLine's continuous-evaluation discipline and
+TensorFlow-Serving's built-in (not bolted-on) model-health stance:
+
+- `bus`             — bounded, sampling, never-blocking request tee
+                      feeding async consumers (the in-process
+                      equivalent of the CloudEvents logger hop);
+- `monitors`        — streaming drift / outlier monitors wrapping the
+                      offline detectors, exporting per-model score /
+                      rate / alert-state series;
+- `slo`             — per-model latency/error objectives evaluated as
+                      multi-window burn rates over the PR-2 request
+                      series, served at `GET /v2/health/slo`;
+- `flight_recorder` — ring buffer of recent request timelines that
+                      auto-pins SLO breaches, deadline sheds, and
+                      latency outliers, at `GET /debug/flightrecorder`.
+
+`Monitoring` is the per-server facade the ModelServer owns: it wires
+the bus onto the request-hook point, runs the SLO evaluation loop as a
+server service, and assembles flight-recorder entries (stage timings +
+tracer spans) on every request completion.
+
+Import discipline (observability package contract): nothing from
+`server/`, `control/`, `engine/`, or `reliability/` — the server hands
+itself in and the monitors import detector math lazily.
+"""
+
+import asyncio
+import logging
+from typing import Any, Dict, List, Optional
+
+from kfserving_tpu.observability.monitoring.bus import MonitorBus
+from kfserving_tpu.observability.monitoring.flight_recorder import (
+    FlightRecorder,
+)
+from kfserving_tpu.observability.monitoring.knobs import env_number
+from kfserving_tpu.observability.monitoring.monitors import (
+    DriftMonitor,
+    OutlierMonitor,
+)
+from kfserving_tpu.observability.monitoring.slo import (
+    DEFAULT_EVAL_S,
+    ENV_EVAL,
+    SLOEngine,
+    SLOObjective,
+)
+
+logger = logging.getLogger("kfserving_tpu.monitoring")
+
+__all__ = [
+    "MonitorBus", "FlightRecorder", "DriftMonitor", "OutlierMonitor",
+    "SLOEngine", "SLOObjective", "Monitoring",
+]
+
+# Span names whose timings make up a request's flight-recorder
+# timeline (the cross-layer stages PR 2 instrumented).
+_TIMELINE_SPAN_PREFIXES = ("server.", "dataplane.", "batcher.",
+                           "engine.", "generator.")
+
+
+class Monitoring:
+    """Per-ModelServer monitoring loop: bus + monitors + SLO engine +
+    flight recorder.  Constructed with the server (cheap — no tasks);
+    `start()`/`stop()` run as one of the server's background
+    services."""
+
+    def __init__(self, server):
+        self.server = server
+        self.bus = MonitorBus.from_env()
+        self.bus.attach(server)
+        self.flight_recorder = FlightRecorder.from_env()
+        # The server's private request registry: both HTTP and gRPC
+        # requests land there (PR 2 routed gRPC through
+        # Metrics.observe_request), so the SLO sees every protocol.
+        self.slo = SLOEngine.from_env([server.metrics.registry])
+        self.eval_interval_s = env_number(ENV_EVAL, DEFAULT_EVAL_S)
+        self._slo_task: Optional[asyncio.Task] = None
+
+    # -- service lifecycle -------------------------------------------------
+    async def start(self) -> None:
+        await self.bus.start()
+        if self.slo.enabled and self._slo_task is None:
+            self.slo.tick()  # baseline snapshot at serving start
+            self._slo_task = asyncio.get_running_loop().create_task(
+                self._slo_loop())
+
+    async def stop(self) -> None:
+        if self._slo_task is not None:
+            self._slo_task.cancel()
+            try:
+                await self._slo_task
+            except asyncio.CancelledError:
+                pass
+            self._slo_task = None
+        await self.bus.stop()
+
+    async def _slo_loop(self) -> None:
+        while True:
+            await asyncio.sleep(max(0.05, self.eval_interval_s))
+            try:
+                self.slo.tick()
+            except Exception:  # evaluation must never kill the loop
+                logger.exception("SLO evaluation failed")
+
+    # -- monitor wiring ----------------------------------------------------
+    def add_drift_monitor(self, monitor: DriftMonitor) -> DriftMonitor:
+        self.bus.subscribe(monitor)
+        return monitor
+
+    def add_outlier_monitor(self, monitor: OutlierMonitor
+                            ) -> OutlierMonitor:
+        self.bus.subscribe(monitor)
+        return monitor
+
+    # -- flight recording --------------------------------------------------
+    def record_request(self, model: str, verb: str, status: int,
+                       latency_ms: float,
+                       trace_id: Optional[str] = None,
+                       stages: Optional[Dict[str, float]] = None
+                       ) -> None:
+        """Assemble and record one request's timeline; evaluates the
+        pin triggers.  Called from the server's completion path —
+        must never raise into it."""
+        try:
+            pin = self._pin_reason(model, status, latency_ms)
+            is_outlier = self.flight_recorder.observe_latency(
+                model, latency_ms)
+            if pin is None and is_outlier:
+                pin = "latency_outlier"
+            entry = {
+                "trace_id": trace_id,
+                "model": model,
+                "verb": verb,
+                "status": status,
+                "latency_ms": round(latency_ms, 3),
+                "stages": stages or {},
+            }
+            # Eager span capture ONLY for pinned entries: pinned
+            # evidence must not depend on the tracer ring still
+            # holding the spans at dump time, but scanning the ring
+            # for every healthy request would put an O(ring) copy +
+            # tracer-lock hit on the serving hot path.  Un-pinned
+            # ring entries resolve their timeline lazily at dump
+            # (best-effort — spans may have rotated out).
+            if pin:
+                entry["timeline"] = self._timeline(trace_id)
+            self.flight_recorder.record(entry, pin=pin)
+        except Exception:
+            logger.exception("flight-recorder capture failed")
+
+    def dump_flightrecorder(self, limit: int = 100,
+                            pinned_only: bool = False
+                            ) -> Dict[str, Any]:
+        """The /debug/flightrecorder body: recorder dump with lazy
+        timeline resolution for ring entries recorded without one (a
+        debug endpoint can afford the tracer scans the hot path
+        can't)."""
+        dump = self.flight_recorder.dump(limit=limit,
+                                         pinned_only=pinned_only)
+        # Copies, not in-place writes: dump() hands back the stored
+        # dicts, which the recording path may be appending around.
+        dump["entries"] = [
+            entry if "timeline" in entry
+            else dict(entry,
+                      timeline=self._timeline(entry.get("trace_id")))
+            for entry in dump["entries"]]
+        return dump
+
+    def _pin_reason(self, model: str, status: int,
+                    latency_ms: float) -> Optional[str]:
+        if status == 504:
+            return "deadline_shed"
+        if status == 503:
+            # Admission-queue overflow / model not ready: capacity
+            # evidence, distinct from a 5xx failure.
+            return "unavailable"
+        if status >= 500:
+            return "error"
+        objective = self.slo.objective_for(model)
+        if objective is not None and objective.latency_ms is not None \
+                and latency_ms > objective.latency_ms:
+            return ("slo_breach" if self.slo.alerting(model)
+                    else "slo_violation")
+        return None
+
+    @staticmethod
+    def _timeline(trace_id: Optional[str]) -> List[Dict[str, Any]]:
+        """The request's stage spans (batcher queue wait, engine
+        prepare/transfer/compute/fetch with batch fill, generator
+        decode, dataplane stages), captured NOW — pinned evidence must
+        not depend on the tracer ring still holding the spans at dump
+        time."""
+        if not trace_id:
+            return []
+        from kfserving_tpu.tracing import tracer
+
+        return [s for s in tracer.spans(trace_id, limit=64)
+                if s["name"].startswith(_TIMELINE_SPAN_PREFIXES)]

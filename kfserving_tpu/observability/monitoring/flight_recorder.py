@@ -1,0 +1,125 @@
+"""Flight recorder: a per-replica ring buffer of request timelines.
+
+When an SLO pages, the first question is "show me one bad request" —
+and by then the interesting requests have usually rotated out of every
+log.  The recorder keeps the last N request timelines (trace id, stage
+timings from server/dataplane/batcher/engine/generator spans, batch
+fill, outcome) in a bounded ring, and PINS entries that tripped a
+trigger into a separate bounded buffer so evidence survives the flood
+of healthy traffic that follows an incident:
+
+    slo_breach       the model's SLO alert state was active
+    slo_violation    latency exceeded the model's declared objective
+    deadline_shed    the request died of its budget (504)
+    error            5xx outcome
+    latency_outlier  latency above the rolling per-model p99
+
+Dumpable at `GET /debug/flightrecorder` (federated through the router
+like `/debug/traces`).  Knobs: `KFS_FLIGHTRECORDER_SIZE` (ring),
+`KFS_FLIGHTRECORDER_PINNED` (pin buffer),
+`KFS_FLIGHTRECORDER_LATENCY_WINDOW` (p99 sample window).
+"""
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from kfserving_tpu.observability import metrics as obs
+from kfserving_tpu.observability.monitoring.knobs import env_number
+
+DEFAULT_SIZE = 256
+DEFAULT_PINNED = 64
+DEFAULT_LATENCY_WINDOW = 256
+# Below this many samples the rolling p99 is noise, not a trigger.
+MIN_OUTLIER_SAMPLES = 32
+
+
+class FlightRecorder:
+    def __init__(self, size: int = DEFAULT_SIZE,
+                 pinned_size: int = DEFAULT_PINNED,
+                 latency_window: int = DEFAULT_LATENCY_WINDOW):
+        self.size = max(1, int(size))
+        self.pinned_size = max(1, int(pinned_size))
+        self.latency_window = max(MIN_OUTLIER_SAMPLES,
+                                  int(latency_window))
+        self._ring: deque = deque(maxlen=self.size)
+        self._pinned: deque = deque(maxlen=self.pinned_size)
+        self._latencies: Dict[str, deque] = {}
+        self._lock = threading.Lock()
+        self.recorded = 0
+        self.pinned_count = 0
+
+    @classmethod
+    def from_env(cls) -> "FlightRecorder":
+        return cls(
+            size=int(env_number("KFS_FLIGHTRECORDER_SIZE",
+                                DEFAULT_SIZE)),
+            pinned_size=int(env_number("KFS_FLIGHTRECORDER_PINNED",
+                                       DEFAULT_PINNED)),
+            latency_window=int(env_number(
+                "KFS_FLIGHTRECORDER_LATENCY_WINDOW",
+                DEFAULT_LATENCY_WINDOW)))
+
+    # -- triggers ----------------------------------------------------------
+    def observe_latency(self, model: str, latency_ms: float) -> bool:
+        """Feed the per-model rolling latency window; True when this
+        observation sits above the window's p99 (the latency-outlier
+        pin trigger).  The window is consulted BEFORE this sample
+        joins it, so one giant outlier can't raise the bar against
+        itself."""
+        with self._lock:
+            window = self._latencies.get(model)
+            if window is None:
+                window = self._latencies[model] = deque(
+                    maxlen=self.latency_window)
+            is_outlier = False
+            if len(window) >= MIN_OUTLIER_SAMPLES:
+                ordered = sorted(window)
+                p99 = ordered[min(len(ordered) - 1,
+                                  int(len(ordered) * 0.99))]
+                is_outlier = latency_ms > p99
+            window.append(latency_ms)
+            return is_outlier
+
+    # -- recording ---------------------------------------------------------
+    def record(self, entry: Dict[str, Any],
+               pin: Optional[str] = None) -> None:
+        """Append one request timeline; `pin` names the trigger that
+        also copies it into the pinned buffer."""
+        entry = dict(entry)
+        entry.setdefault("ts", time.time())
+        if pin:
+            entry["pinned"] = pin
+        with self._lock:
+            self.recorded += 1
+            self._ring.append(entry)
+            if pin:
+                self.pinned_count += 1
+                self._pinned.append(entry)
+        if pin:
+            obs.flightrecorder_pinned_total().labels(reason=pin).inc()
+
+    # -- dumping -----------------------------------------------------------
+    def dump(self, limit: int = 100,
+             pinned_only: bool = False) -> Dict[str, Any]:
+        # Clamp BEFORE slicing: [-0:] is the whole deque, and a
+        # negative limit would slice an arbitrary tail — a ?limit=0
+        # query must mean "none", not "everything".
+        limit = max(0, int(limit))
+        with self._lock:
+            pinned = list(self._pinned)[-limit:] if limit else []
+            entries = ([] if pinned_only or not limit
+                       else list(self._ring)[-limit:])
+            return {
+                "recorded": self.recorded,
+                "pinned_total": self.pinned_count,
+                "entries": entries,
+                "pinned": pinned,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._pinned.clear()
+            self._latencies.clear()

@@ -1,0 +1,20 @@
+"""Env-knob parsing shared by the monitoring family (KFS_MONITOR_*,
+KFS_SLO_*, KFS_FLIGHTRECORDER_*).  Lenient like the reliability
+knobs: a non-numeric value logs once and falls back to the default —
+a typo'd knob must degrade to defaults, never crash the server."""
+
+import logging
+import os
+
+logger = logging.getLogger("kfserving_tpu.monitoring")
+
+
+def env_number(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r", name, raw)
+        return default

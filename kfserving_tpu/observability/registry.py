@@ -211,6 +211,21 @@ class Registry:
         return self._family("histogram", name, help_text,
                             buckets or LATENCY_BUCKETS_MS)
 
+    # -- introspection ---------------------------------------------------
+    def family(self, name: str) -> Optional[_Family]:
+        """Read-only lookup of an existing family (None when absent).
+        The SLO engine and the metrics linter read families without
+        registering them — a reader must never create an empty family
+        a later writer would then re-kind against."""
+        with self._lock:
+            return self._families.get(name)
+
+    def families(self) -> Dict[str, str]:
+        """{family name: kind} snapshot for exposition linting."""
+        with self._lock:
+            return {name: fam.kind
+                    for name, fam in self._families.items()}
+
     # -- introspection (test isolation) ---------------------------------
     def sample_names(self) -> List[str]:
         """Names of families that hold at least one child sample."""

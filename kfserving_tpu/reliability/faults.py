@@ -7,6 +7,9 @@ Every retry/breaker-wrapped edge calls into the process-global
     agent.pull          Downloader.download (the agent's model pull)
     client.request      KFServingClient HTTP calls
     router.dispatch     IngressRouter upstream proxy attempts
+    dataplane.infer     DataPlane.infer, keyed by model name (inject
+                        per-model latency the SLO engine / monitors
+                        must detect)
 
 A site with no configuration costs one dict lookup (the common case).
 Configuration comes from the `KFS_FAULTS` env var (JSON object keyed

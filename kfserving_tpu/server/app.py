@@ -169,6 +169,15 @@ class ModelServer:
         # Agent-style background services (logger, watcher, puller): objects
         # with async start()/stop(), run for the server's lifetime.
         self.services = []
+        # The online monitoring loop (ISSUE 3): monitor bus tee off the
+        # request hooks, SLO burn-rate engine over this server's
+        # request series, flight recorder of recent timelines.
+        # Construction is cheap (no tasks until start_async); the
+        # SLO evaluation loop only runs when objectives are declared.
+        from kfserving_tpu.observability.monitoring import Monitoring
+
+        self.monitoring = Monitoring(self)
+        self.services.append(self.monitoring)
         # Per-replica admission control (Knative containerConcurrency,
         # reference component.go:79-82): at most `container_concurrency`
         # inference calls execute at once; up to `max_queue_depth` more
@@ -246,6 +255,11 @@ class ModelServer:
         # boots with imports/download done but the device untouched;
         # the orchestrator POSTs here once the old chip owner exits.
         r.add("POST", "/standby/activate", self._standby_activate)
+        # Online monitoring surface (ISSUE 3): SLO health the router
+        # federates, and the flight recorder's recent/pinned request
+        # timelines.
+        r.add("GET", "/v2/health/slo", self._slo_health)
+        r.add("GET", "/debug/flightrecorder", self._flightrecorder)
         # Tracing/profiling surface (SURVEY §5.1).
         r.add("GET", "/debug/traces", self._traces)
         r.add("POST", "/debug/profiler/start", self._profiler_start)
@@ -320,6 +334,11 @@ class ModelServer:
                 self.metrics.observe_request(name, verb, status,
                                              latency_ms,
                                              trace_id=rid)
+                # A shed is exactly what the flight recorder exists to
+                # keep evidence of (504 pins as deadline_shed).
+                self.monitoring.record_request(name, verb, status,
+                                               latency_ms,
+                                               trace_id=rid)
                 # Shed requests still reach the hooks: the payload logger
                 # must not go blind exactly during overload.
                 for hook in self.request_hooks:
@@ -415,6 +434,13 @@ class ModelServer:
         latency_ms = (time.perf_counter() - start) * 1000.0
         self.metrics.observe_request(name, verb, status, latency_ms,
                                      trace_id=trace_id)
+        # Flight-recorder capture AFTER the stage spans completed (the
+        # tracer ring already holds this trace's batcher/engine spans)
+        # and BEFORE the hooks, so a slow hook can't delay pin
+        # evaluation past the next request.
+        self.monitoring.record_request(name, verb, status, latency_ms,
+                                       trace_id=trace_id,
+                                       stages=stages or None)
         log_access("server", trace_id=trace_id, model=name, verb=verb,
                    status=status, latency_ms=round(latency_ms, 3),
                    stages=stages or None, tokens_out=tokens_out)
@@ -554,6 +580,12 @@ class ModelServer:
             metrics.observe_request(name, "generate_stream",
                                     state["status"], latency_ms,
                                     trace_id=rid)
+            # Streams are flight-recorded at close: their generator
+            # span (tokens, finish reason) exists only once the
+            # stream ends.
+            self.monitoring.record_request(name, "generate_stream",
+                                           state["status"],
+                                           latency_ms, trace_id=rid)
             from kfserving_tpu.observability.accesslog import (
                 log_access,
             )
@@ -562,9 +594,14 @@ class ModelServer:
                        verb="generate_stream",
                        status=state["status"],
                        latency_ms=round(latency_ms, 3))
+            # Hooks get a minimal response carrying the stream's REAL
+            # outcome: a mid-stream failure must not reach the payload
+            # logger / monitor bus stamped as a 200.  The body is
+            # empty — the token stream was never buffered.
+            stream_resp = Response(b"", status=state["status"])
             for hook in hooks:
                 try:
-                    hook(name, "generate_stream", req, None,
+                    hook(name, "generate_stream", req, stream_resp,
                          latency_ms)
                 except Exception:
                     logger.exception("request hook failed")
@@ -659,6 +696,25 @@ class ModelServer:
             ctype = "text/plain; version=0.0.4"
         return Response(body.encode("utf-8"), content_type=ctype)
 
+    async def _slo_health(self, req: Request) -> Response:
+        """The SLO engine's last evaluation.  ?refresh=1 forces a
+        fresh tick (tests / on-demand checks); the body always
+        answers 200 — a breach is a *reported* state, not an endpoint
+        failure (the router must still federate it)."""
+        if req.query.get("refresh") == "1":
+            return _json(self.monitoring.slo.tick())
+        return _json(self.monitoring.slo.report())
+
+    async def _flightrecorder(self, req: Request) -> Response:
+        try:
+            limit = int(req.query.get("limit", "100"))
+        except ValueError:
+            return _json({"error": "limit must be an integer"},
+                         status=400)
+        pinned_only = req.query.get("pinned", "0") == "1"
+        return _json(self.monitoring.dump_flightrecorder(
+            limit=limit, pinned_only=pinned_only))
+
     async def _traces(self, req: Request) -> Response:
         from kfserving_tpu.tracing import tracer
 
@@ -712,7 +768,7 @@ class ModelServer:
 
             self.grpc_server = GRPCServer(
                 self.dataplane, port=self.grpc_port, host=host,
-                metrics=self.metrics)
+                metrics=self.metrics, monitoring=self.monitoring)
             await self.grpc_server.start()
             self.grpc_port = self.grpc_server.port
         from kfserving_tpu import startup

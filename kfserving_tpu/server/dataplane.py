@@ -23,6 +23,7 @@ from kfserving_tpu.reliability.deadline import (
     check_deadline,
     deadline_scope,
 )
+from kfserving_tpu.reliability.faults import faults
 from kfserving_tpu.tracing import tracer
 
 SERVER_NAME = "kfserving-tpu"
@@ -130,6 +131,15 @@ class DataPlane:
         # slow preprocess fails 504 HERE, before the model/batcher
         # spends a slot on it.
         model = await self.get_model(name)
+        # Chaos hook (site `dataplane.infer`, `match` selects models):
+        # injected latency/errors land INSIDE the request's measured
+        # path, so the SLO engine, flight recorder, and monitors see
+        # exactly what a real model-side slowdown would produce —
+        # the knob tests/test_monitoring.py drives the alert loop
+        # with.  configured() keeps the no-faults hot path at one
+        # dict lookup.
+        if faults.configured("dataplane.infer"):
+            await faults.inject("dataplane.infer", key=name)
         check_deadline("dataplane.infer")
         with tracer.span("dataplane.preprocess", model=name):
             request = await model.preprocess(body)

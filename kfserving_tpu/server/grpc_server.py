@@ -172,11 +172,17 @@ class GRPCServer:
     """Async V2 gRPC front end over a DataPlane."""
 
     def __init__(self, dataplane: DataPlane, port: int = 0,
-                 host: str = "127.0.0.1", metrics=None):
+                 host: str = "127.0.0.1", metrics=None,
+                 monitoring=None):
         self.dataplane = dataplane
         self.port = port
         self.host = host
         self.metrics = metrics  # shared with the HTTP app
+        # The HTTP app's Monitoring loop: gRPC requests flight-record
+        # (and pin on shed/error) exactly like HTTP ones.  The monitor
+        # BUS is not teed here: bus consumers parse JSON V1 payloads,
+        # which a proto tensor request doesn't carry.
+        self.monitoring = monitoring
         self._server = None
 
     def _join_trace(self, context) -> Optional[str]:
@@ -193,12 +199,15 @@ class GRPCServer:
 
     def _observe(self, model: str, verb: str, status: int,
                  start: float, trace_id: Optional[str]) -> None:
-        if self.metrics is None:
-            return
-        self.metrics.observe_request(
-            model, verb, status,
-            (time.perf_counter() - start) * 1000.0,
-            trace_id=trace_id)
+        latency_ms = (time.perf_counter() - start) * 1000.0
+        if self.metrics is not None:
+            self.metrics.observe_request(model, verb, status,
+                                         latency_ms,
+                                         trace_id=trace_id)
+        if self.monitoring is not None:
+            self.monitoring.record_request(model, verb, status,
+                                           latency_ms,
+                                           trace_id=trace_id)
 
     # -- handlers -----------------------------------------------------------
     async def _abort(self, context, e: Exception):

@@ -24,11 +24,13 @@ from kfserving_tpu.observability.registry import (
 )
 
 # The request counter's series name, shared with every consumer that
-# scrapes it (the recycling watchdog's max_requests trigger keys on this
-# literal — a rename here without the constant would silently disable
-# request-count recycling).
-REQUEST_TOTAL_SERIES = "kfserving_tpu_request_total"
-LATENCY_SERIES = "kfserving_tpu_request_latency_ms"
+# scrapes it (the recycling watchdog's max_requests trigger keys on
+# this literal, the SLO engine reads it).  Canonical constants live in
+# observability/metrics.py — re-exported here for existing importers.
+from kfserving_tpu.observability.metrics import (  # noqa: F401
+    REQUEST_LATENCY_SERIES as LATENCY_SERIES,
+    REQUEST_TOTAL_SERIES,
+)
 
 
 class Metrics:

@@ -1,0 +1,140 @@
+"""Metrics-exposition linter: keep every exported family well-formed.
+
+PR 2/3 grew the metric surface to ~30 families fed from six layers;
+nothing enforced the conventions that make the surface scrapeable and
+greppable.  This tool lints every exported family against the house
+rules and runs in the fast test tier, so a misnamed series fails CI
+before it ships:
+
+- every family name carries the `kfserving_tpu_` prefix;
+- counters end in `_total` (and nothing else ends in `_total`);
+- time/size-valued families carry a unit suffix (`_ms`, `_seconds`,
+  `_bytes`, `_ratio`, `_per_second`) — and never a spelled-out
+  `_milliseconds`;
+- no family is declared twice in one exposition (strict OpenMetrics
+  parsers abort the whole scrape on a re-declared family);
+- no family is registered under two kinds (the registry raises, but a
+  private+global registry pair could still disagree — the lint
+  catches the merged view).
+
+Run standalone (`python -m kfserving_tpu.tools.check_metrics`) it
+boots an in-process server, serves one smoke request, and lints the
+full rendered scrape — exit 1 on any problem.
+"""
+
+import asyncio
+import re
+import sys
+from typing import Dict, List
+
+PREFIX = "kfserving_tpu_"
+UNIT_SUFFIXES = ("_ms", "_seconds", "_bytes", "_ratio", "_per_second")
+# Sample suffixes histograms append to their family name.
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def lint_families(families: Dict[str, str]) -> List[str]:
+    """Lint a {family name: kind} mapping (registry introspection)."""
+    problems: List[str] = []
+    for name, kind in sorted(families.items()):
+        if not name.startswith(PREFIX):
+            problems.append(
+                f"{name}: missing the {PREFIX!r} prefix")
+        if kind == "counter" and not name.endswith("_total"):
+            problems.append(
+                f"{name}: counters must end in _total")
+        if kind != "counter" and name.endswith("_total"):
+            problems.append(
+                f"{name}: _total suffix is reserved for counters "
+                f"(is a {kind})")
+        if "_milliseconds" in name or "_millis" in name:
+            problems.append(
+                f"{name}: spell milliseconds as _ms")
+        if kind == "histogram" and not name.endswith(UNIT_SUFFIXES):
+            problems.append(
+                f"{name}: histograms must carry a unit suffix "
+                f"({', '.join(UNIT_SUFFIXES)})")
+    return problems
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Lint a rendered scrape: duplicate family declarations, the
+    naming rules over every declared family, and prefix coverage of
+    every sample line (declared or bare)."""
+    problems: List[str] = []
+    declared: Dict[str, str] = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            if len(parts) < 4:
+                problems.append(f"malformed TYPE line: {line!r}")
+                continue
+            name, kind = parts[2], parts[3]
+            if name in declared:
+                problems.append(
+                    f"{name}: declared twice (strict parsers abort "
+                    "the whole scrape)")
+            declared[name] = kind
+            continue
+        if not line or line.startswith("#"):
+            continue
+        sample = re.split(r"[{ ]", line, maxsplit=1)[0]
+        if not sample.startswith(PREFIX):
+            problems.append(
+                f"sample {sample!r}: missing the {PREFIX!r} prefix")
+    problems += lint_families(declared)
+    return problems
+
+
+async def smoke() -> List[str]:
+    """Boot an in-process server, serve one request (populating the
+    request/batcher/engine families), and lint the merged scrape plus
+    both registries' introspection."""
+    from kfserving_tpu.model.model import Model
+    from kfserving_tpu.observability import REGISTRY
+    from kfserving_tpu.server.app import ModelServer
+    from kfserving_tpu.server.http import Request
+
+    class _Probe(Model):
+        def load(self):
+            self.ready = True
+            return True
+
+        async def predict(self, request):
+            return {"predictions": request["instances"]}
+
+    server = ModelServer(http_port=0)
+    probe = _Probe("metrics-probe")
+    probe.load()
+    server.register_model(probe)
+    req = Request(method="POST",
+                  path="/v1/models/metrics-probe:predict", query={},
+                  headers={}, body=b'{"instances": [[1.0, 2.0]]}')
+    req.path_params = {"name": "metrics-probe"}
+    resp = await server._inference(req, "predict",
+                                   server.dataplane.infer)
+    problems: List[str] = []
+    if resp.status != 200:
+        problems.append(
+            f"smoke request failed with status {resp.status}")
+    problems += lint_exposition(server.metrics.render())
+    problems += lint_families(server.metrics.registry.families())
+    problems += lint_families(REGISTRY.families())
+    # Deduplicate: a family can be flagged by both the exposition and
+    # the registry pass.
+    return sorted(set(problems))
+
+
+def main() -> int:
+    problems = asyncio.run(smoke())
+    if problems:
+        print("metrics lint FAILED:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print("metrics lint OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

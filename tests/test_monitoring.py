@@ -1,0 +1,660 @@
+"""Online monitoring tests (ISSUE 3): monitor-bus backpressure,
+streaming drift/outlier monitors, SLO burn-rate engine, flight
+recorder, payload-logger trace ids + registry series, the metrics
+linter, and the fault-driven SLO-breach acceptance path.
+
+Runs in the tier-1 fast tier (no `slow` marker)."""
+
+import asyncio
+import json
+import os
+import types
+import uuid
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.observability import REGISTRY
+from kfserving_tpu.observability.monitoring import (
+    DriftMonitor,
+    FlightRecorder,
+    MonitorBus,
+    OutlierMonitor,
+    SLOEngine,
+    SLOObjective,
+)
+from kfserving_tpu.reliability import faults
+from kfserving_tpu.tracing import current_request_id, format_traceparent
+from tests.utils import http_json, http_request, running_server
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_trace():
+    yield
+    faults.reset()
+    current_request_id.set(None)
+
+
+def _event(model="m", payload=None, **extra):
+    event = {"model": model, "verb": "predict", "status": 200,
+             "latency_ms": 1.0, "trace_id": None,
+             "payload": payload if payload is not None
+             else b'{"instances": [[1.0, 2.0]]}'}
+    event.update(extra)
+    return event
+
+
+def _instances_payload(arr):
+    return json.dumps({"instances": np.asarray(arr).tolist()}).encode()
+
+
+# ------------------------------------------------------------- the bus --
+async def test_bus_backpressure_drops_without_blocking():
+    """Satellite: a full queue drops samples (counted) without ever
+    blocking the serving path, and consumers only ever see whole
+    events — never partial or interleaved payloads."""
+    bus = MonitorBus(queue_size=2)
+    received = []
+
+    async def consumer(event):
+        received.append(event)
+
+    bus.subscribe(consumer)
+    published = [_event(payload=_instances_payload([[float(i)]]))
+                 for i in range(5)]
+    # Dispatcher not started: publish outcomes are deterministic.
+    outcomes = [bus.publish(e) for e in published]
+    assert outcomes == [True, True, False, False, False]
+    text = REGISTRY.render()
+    assert ('kfserving_tpu_monitor_events_total'
+            '{outcome="published"} 2') in text
+    assert ('kfserving_tpu_monitor_events_total'
+            '{outcome="dropped"} 3') in text
+    await bus.start()
+    await bus.drain()
+    await bus.stop()
+    # Exactly the two enqueued events, whole and in order: the bus
+    # enqueues complete immutable dicts, so a consumer can never
+    # observe a half-written or interleaved payload.
+    assert received == published[:2]
+    assert all(e["payload"] == p["payload"]
+               for e, p in zip(received, published))
+
+
+async def test_bus_no_consumers_is_free_and_sampling_counts():
+    bus = MonitorBus(queue_size=4)
+    assert bus.publish(_event()) is False  # no consumers: discarded
+    assert bus.queue.qsize() == 0
+    sampled = MonitorBus(queue_size=4, sample_rate=0.0)
+
+    async def consumer(event):  # pragma: no cover - never delivered
+        raise AssertionError("sampled-out event was delivered")
+
+    sampled.subscribe(consumer)
+    assert sampled.publish(_event()) is False
+    assert sampled.queue.qsize() == 0
+    assert ('kfserving_tpu_monitor_events_total'
+            '{outcome="sampled_out"} 1') in REGISTRY.render()
+
+
+async def test_bus_consumer_error_never_kills_dispatch():
+    bus = MonitorBus(queue_size=8)
+    seen = []
+
+    async def broken(event):
+        raise RuntimeError("monitor bug")
+
+    async def healthy(event):
+        seen.append(event)
+
+    broken.name = "broken"
+    bus.subscribe(broken)
+    bus.subscribe(healthy)
+    bus.publish(_event())
+    bus.publish(_event())
+    await bus.start()
+    await bus.drain()
+    await bus.stop()
+    assert len(seen) == 2  # healthy consumer saw everything
+    assert ('kfserving_tpu_monitor_consumer_errors_total'
+            '{consumer="broken"} 2') in REGISTRY.render()
+
+
+# ------------------------------------------------------ online monitors --
+async def test_drift_monitor_streams_to_alert():
+    rng = np.random.default_rng(0)
+    reference = rng.normal(size=(256, 3))
+    monitor = DriftMonitor("m", reference, window=64, p_value=0.05,
+                           test_stride=16)
+    for _ in range(4):  # fill the window in-distribution
+        await monitor(_event(payload=_instances_payload(
+            rng.normal(size=(16, 3)))))
+    assert monitor.last_result is not None
+    assert monitor.alerting is False
+    for _ in range(4):  # shifted traffic replaces the window
+        await monitor(_event(payload=_instances_payload(
+            rng.normal(size=(16, 3)) + 3.0)))
+    assert monitor.alerting is True
+    text = REGISTRY.render()
+    assert 'kfserving_tpu_drift_score{model="m"}' in text
+    assert ('kfserving_tpu_monitor_alert_state'
+            '{model="m",monitor="drift"} 1') in text
+    # Traffic for other models / non-numeric payloads is skipped.
+    before = monitor.last_result
+    await monitor(_event(model="other"))
+    await monitor(_event(payload=b'{"prompt": "hi"}'))
+    assert monitor.last_result is before
+
+
+async def test_outlier_monitor_rate_and_alert():
+    rng = np.random.default_rng(1)
+    reference = rng.normal(size=(256, 4))
+    monitor = OutlierMonitor("m", reference, window=32,
+                             alert_rate=0.25)
+    await monitor(_event(payload=_instances_payload(
+        rng.normal(size=(16, 4)))))
+    assert monitor.alerting is False
+    await monitor(_event(payload=_instances_payload(
+        rng.normal(size=(16, 4)) + 8.0)))
+    assert monitor.alerting is True
+    text = REGISTRY.render()
+    assert 'kfserving_tpu_outlier_rate{model="m"}' in text
+    assert ('kfserving_tpu_monitor_alert_state'
+            '{model="m",monitor="outlier"} 1') in text
+
+
+def test_monitor_from_detector_wrappers(tmp_path):
+    """The online monitors reuse a loaded offline detector's reference
+    stats (no second download/fit)."""
+    from kfserving_tpu.detectors.drift import KSDriftDetector
+    from kfserving_tpu.detectors.outlier import OutlierDetector
+
+    rng = np.random.default_rng(2)
+    train = rng.normal(size=(128, 2))
+    art = tmp_path / "det"
+    art.mkdir()
+    np.save(art / "train.npy", train)
+    drift = KSDriftDetector("d", f"file://{art}")
+    drift.load()
+    outlier = OutlierDetector("o", f"file://{art}")
+    outlier.load()
+    dm = DriftMonitor.from_detector(drift)
+    om = OutlierMonitor.from_detector(outlier)
+    assert dm.model == "d" and dm.dim == 2
+    assert om.model == "o" and om.threshold == outlier.threshold
+
+
+# ------------------------------------------------------------ SLO engine --
+def _metrics_with_traffic(model="m", good=90, bad=10, status=200,
+                          bad_ms=300.0):
+    from kfserving_tpu.server.metrics import Metrics
+
+    m = Metrics()
+    for _ in range(good):
+        m.observe_request(model, "predict", 200, 10.0)
+    for _ in range(bad):
+        m.observe_request(model, "predict", status, bad_ms)
+    return m
+
+
+def test_slo_latency_burn_rate_alerts():
+    from kfserving_tpu.server.metrics import Metrics
+
+    metrics = Metrics()
+    eng = SLOEngine(
+        [metrics.registry],
+        {"m": SLOObjective("m", latency_ms=25.0, target=0.99)},
+        windows_s=(60, 300), burn_alert=2.0)
+    eng.tick(now=0.0)  # empty baseline
+    for _ in range(90):
+        metrics.observe_request("m", "predict", 200, 10.0)
+    for _ in range(10):
+        metrics.observe_request("m", "predict", 200, 300.0)
+    report = eng.tick(now=10.0)
+    burn = report["models"]["m"]["burn_rates"]["latency"]
+    # 10% of requests over 25ms against a 1% budget: burn rate 10 on
+    # both windows (history shorter than the window evaluates over
+    # the replica's whole life).
+    assert burn["60"] == pytest.approx(10.0, rel=1e-3)
+    assert burn["300"] == pytest.approx(10.0, rel=1e-3)
+    assert report["models"]["m"]["alerting"] is True
+    assert report["alerting"] == ["m"]
+    assert eng.alerting("m") is True
+    text = REGISTRY.render()
+    assert ('kfserving_tpu_slo_burn_rate{model="m",'
+            'objective="latency",window="60"} 10') in text
+    assert 'kfserving_tpu_slo_alert_state{model="m"} 1' in text
+    assert 'kfserving_tpu_slo_breaches_total{model="m"} 1' in text
+
+
+def test_slo_error_objective_and_healthy_traffic():
+    metrics = _metrics_with_traffic(good=995, bad=5, status=500,
+                                    bad_ms=10.0)
+    eng = SLOEngine(
+        [metrics.registry],
+        {"m": SLOObjective("m", error_target=0.999)},
+        windows_s=(60,), burn_alert=2.0)
+    report = eng.tick(now=0.0)
+    # 0.5% errors against a 0.1% budget: burn 5 > 2 -> alert.
+    assert report["models"]["m"]["burn_rates"]["errors"]["60"] == \
+        pytest.approx(5.0, rel=1e-3)
+    assert report["models"]["m"]["alerting"] is True
+    # Healthy follow-up window: burn decays to 0 once the errors stop.
+    for _ in range(1000):
+        metrics.observe_request("m", "predict", 200, 10.0)
+    report = eng.tick(now=30.0)
+    assert report["models"]["m"]["burn_rates"]["errors"]["60"] < 2.0
+    assert report["models"]["m"]["alerting"] is False
+    assert report["healthy"] is True
+
+
+def test_slo_latency_objective_counts_fast_errors_as_bad():
+    """A hard-down model failing in 1ms must not report a healthy
+    latency SLO: the SLI is SUCCESSFUL requests under the bound."""
+    metrics = _metrics_with_traffic(good=90, bad=10, status=500,
+                                    bad_ms=1.0)
+    eng = SLOEngine(
+        [metrics.registry],
+        {"m": SLOObjective("m", latency_ms=25.0, target=0.9)},
+        windows_s=(60,), burn_alert=2.0)
+    report = eng.tick(now=0.0)
+    # 10 fast 500s out of 100 against a 10% budget: burn exactly 1.0
+    # (they'd read as 0.0 if errors counted as good latency).
+    assert report["models"]["m"]["burn_rates"]["latency"]["60"] == \
+        pytest.approx(1.0, rel=1e-3)
+
+
+def test_slo_window_labels_preserve_fractions():
+    from kfserving_tpu.observability.monitoring.slo import (
+        _window_label,
+    )
+
+    assert _window_label(60.0) == "60"
+    assert _window_label(0.5) == "0.5"
+    assert _window_label(0.9) == "0.9"  # no collision with 0.5
+
+
+def test_slo_wildcard_objective_covers_every_model():
+    metrics = _metrics_with_traffic(model="anything", good=0, bad=10,
+                                    bad_ms=500.0)
+    eng = SLOEngine(
+        [metrics.registry],
+        {"*": SLOObjective("*", latency_ms=100.0, target=0.9)},
+        windows_s=(60,), burn_alert=2.0)
+    report = eng.tick(now=0.0)
+    assert report["models"]["anything"]["alerting"] is True
+
+
+def test_slo_objectives_from_env(monkeypatch):
+    from kfserving_tpu.observability.monitoring.slo import (
+        objectives_from_env,
+    )
+
+    monkeypatch.setenv("KFS_SLO_OBJECTIVES", json.dumps(
+        {"m": {"latency_ms": 50, "target": 0.95,
+               "error_target": 0.999}}))
+    monkeypatch.setenv("KFS_SLO_DEFAULT_LATENCY_MS", "250")
+    objectives = objectives_from_env()
+    assert objectives["m"].latency_ms == 50.0
+    assert objectives["m"].target == 0.95
+    assert objectives["m"].error_target == 0.999
+    assert objectives["*"].latency_ms == 250.0
+    # Malformed JSON degrades to the default-only set, never raises.
+    monkeypatch.setenv("KFS_SLO_OBJECTIVES", "{not json")
+    objectives = objectives_from_env()
+    assert "m" not in objectives and "*" in objectives
+    # Out-of-range targets clamp instead of dividing by zero.
+    assert SLOObjective("x", target=1.0).target < 1.0
+
+
+# -------------------------------------------------------- flight recorder --
+def test_flight_recorder_ring_pinning_and_outliers():
+    rec = FlightRecorder(size=4, pinned_size=8, latency_window=64)
+    for i in range(6):
+        rec.record({"trace_id": f"t{i}", "model": "m", "status": 200})
+    dump = rec.dump()
+    assert [e["trace_id"] for e in dump["entries"]] == \
+        ["t2", "t3", "t4", "t5"]  # ring kept the newest 4
+    assert dump["pinned"] == []
+    rec.record({"trace_id": "bad", "model": "m", "status": 500},
+               pin="error")
+    for i in range(10):  # pinned evidence survives ring churn
+        rec.record({"trace_id": f"later{i}", "model": "m",
+                    "status": 200})
+    dump = rec.dump()
+    assert [e["trace_id"] for e in dump["pinned"]] == ["bad"]
+    assert dump["pinned"][0]["pinned"] == "error"
+    assert "bad" not in [e["trace_id"] for e in dump["entries"]]
+    assert ('kfserving_tpu_flightrecorder_pinned_total'
+            '{reason="error"} 1') in REGISTRY.render()
+    # p99 outlier trigger: needs a filled window, never self-raises.
+    for _ in range(32):
+        assert rec.observe_latency("m", 10.0) is False
+    assert rec.observe_latency("m", 500.0) is True
+    assert rec.observe_latency("m", 10.0) is False
+    # limit<=0 means "none", not "everything" ([-0:] would be all).
+    empty = rec.dump(limit=0)
+    assert empty["entries"] == [] and empty["pinned"] == []
+    assert rec.dump(limit=-3)["entries"] == []
+
+
+def test_slo_snapshot_history_is_bounded():
+    """?refresh=1 lets an unauthenticated poller force ticks; history
+    must stay capped no matter the poll rate."""
+    from kfserving_tpu.observability.monitoring.slo import (
+        MAX_SNAPSHOTS,
+    )
+    from kfserving_tpu.server.metrics import Metrics
+
+    eng = SLOEngine([Metrics().registry],
+                    {"m": SLOObjective("m", latency_ms=25.0)},
+                    windows_s=(1e6,))  # nothing ages out by time
+    for i in range(MAX_SNAPSHOTS + 50):
+        eng.tick(now=float(i))
+    assert len(eng._snapshots) <= MAX_SNAPSHOTS
+
+
+# ------------------------------------------------ payload logger satellites --
+async def test_payload_logger_joins_trace_and_exports_series():
+    """Satellites: CE ids reuse the active trace id (payload events
+    join distributed traces), and sent/failed/dropped/queued export
+    as kfserving_tpu_payload_log_* registry series."""
+    from kfserving_tpu.agent.logger import RequestLogger
+
+    lg = RequestLogger("http://sink.invalid/", queue_size=2)
+    stub = types.SimpleNamespace(request_hooks=[])
+    lg.attach(stub)
+    hook = stub.request_hooks[0]
+    req = types.SimpleNamespace(body=b'{"instances": [1]}')
+    resp = types.SimpleNamespace(status=200,
+                                 body=b'{"predictions": [1]}')
+    current_request_id.set("trace-ce-1")
+    hook("m", "predict", req, resp, 1.2)
+    current_request_id.set(None)
+    events = []
+    while not lg.queue.empty():
+        events.append(lg.queue.get_nowait()[0])
+    # Both directions carry the ACTIVE trace id as the CE id.
+    assert [e["id"] for e in events] == ["trace-ce-1", "trace-ce-1"]
+    # Untraced hook calls still mint a shared fresh id.
+    hook("m", "predict", req, resp, 1.2)
+    events = []
+    while not lg.queue.empty():
+        events.append(lg.queue.get_nowait()[0])
+    assert len({e["id"] for e in events}) == 1
+    assert events[0]["id"] != "trace-ce-1"
+    # Overflow: drops are counted once-warned registry series.
+    for _ in range(3):
+        lg.log("m", "predict", "request", b"x")
+    assert lg.dropped == 1
+    text = REGISTRY.render()
+    assert ('kfserving_tpu_payload_log_total'
+            '{outcome="dropped"} 1') in text
+    assert "kfserving_tpu_payload_log_queued 2" in text
+    assert lg.stats()["dropped"] == 1
+
+
+# ------------------------------------------------------- metrics linter --
+def test_check_metrics_lint_rules():
+    from kfserving_tpu.tools import check_metrics
+
+    bad = check_metrics.lint_families({
+        "unprefixed_total": "counter",
+        "kfserving_tpu_requests": "counter",          # counter sans _total
+        "kfserving_tpu_bad_total": "gauge",           # reserved suffix
+        "kfserving_tpu_slow_milliseconds": "histogram",
+        "kfserving_tpu_ok_total": "counter",
+        "kfserving_tpu_ok_ms": "histogram",
+    })
+    assert len(bad) >= 4
+    assert not any("kfserving_tpu_ok" in p for p in bad)
+    dup = check_metrics.lint_exposition(
+        "# TYPE kfserving_tpu_x_total counter\n"
+        "kfserving_tpu_x_total 1\n"
+        "# TYPE kfserving_tpu_x_total counter\n"
+        "kfserving_tpu_x_total 2\n")
+    assert any("declared twice" in p for p in dup)
+
+
+async def test_check_metrics_smoke_passes():
+    """Satellite: the linter runs green over the real exported
+    surface after a smoke request (fast-tier CI gate)."""
+    from kfserving_tpu.tools import check_metrics
+
+    problems = await check_metrics.smoke()
+    assert problems == []
+
+
+# ----------------------------------------------------------- acceptance --
+def _write_mlp_dir(tmp_path, name="m"):
+    from flax import serialization
+
+    from kfserving_tpu.models import create_model, init_params
+
+    model_dir = os.path.join(str(tmp_path), name)
+    os.makedirs(model_dir, exist_ok=True)
+    ak = {"input_dim": 4, "features": [8], "num_classes": 3}
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump({"architecture": "mlp", "arch_kwargs": ak,
+                   "max_latency_ms": 5, "warmup": False}, f)
+    spec = create_model("mlp", **ak)
+    with open(os.path.join(model_dir, "checkpoint.msgpack"), "wb") as f:
+        f.write(serialization.to_bytes(init_params(spec, seed=0)))
+    return model_dir
+
+
+async def test_slo_breach_pins_flight_recorder_acceptance(
+        tmp_path, monkeypatch):
+    """Acceptance: KFS_FAULTS latency on one model drives its SLO
+    burn-rate gauge over the alert threshold, /v2/health/slo reports
+    the breach, and /debug/flightrecorder returns a pinned entry
+    whose stage timeline carries the request's trace id — no TPU."""
+    from kfserving_tpu.predictors.jax_model import JaxModel
+
+    monkeypatch.setenv("KFS_SLO_OBJECTIVES", json.dumps(
+        {"slow": {"latency_ms": 25, "target": 0.9}}))
+    faults.configure(
+        {"dataplane.infer": {"latency_ms": 60.0, "match": "slow"}})
+    model = JaxModel("slow", _write_mlp_dir(tmp_path, "slow"))
+    model.load()
+    trace_ids = []
+    async with running_server([model]) as server:
+        port = server.http_port
+        for _ in range(6):
+            trace_id = uuid.uuid4().hex
+            span_id = uuid.uuid4().hex[:16]
+            status, _, _ = await http_request(
+                port, "POST", "/v1/models/slow:predict",
+                json.dumps({"instances":
+                            np.ones((1, 4)).tolist()}).encode(),
+                headers={"traceparent":
+                         format_traceparent(trace_id, span_id)})
+            assert status == 200
+            trace_ids.append(trace_id)
+
+        # The burn-rate gauge crosses the alert threshold: every
+        # request blew the 25ms objective, so the 10% budget burns
+        # 10x.  ?refresh=1 forces an evaluation tick (the background
+        # loop runs at KFS_SLO_EVAL_S).
+        status, report = await http_json(
+            port, "GET", "/v2/health/slo?refresh=1")
+        assert status == 200
+        assert report["healthy"] is False
+        assert report["alerting"] == ["slow"]
+        model_report = report["models"]["slow"]
+        assert model_report["alerting"] is True
+        assert all(rate > report["burn_alert_threshold"]
+                   for rate in
+                   model_report["burn_rates"]["latency"].values())
+        burn_lines = [
+            ln for ln in REGISTRY.render().splitlines()
+            if ln.startswith('kfserving_tpu_slo_burn_rate{model="slow"')]
+        assert burn_lines
+        assert all(float(ln.rsplit(" ", 1)[1]) > 2.0
+                   for ln in burn_lines)
+        assert ('kfserving_tpu_slo_alert_state{model="slow"} 1'
+                in REGISTRY.render())
+
+        # One more request while the alert is ACTIVE pins as a full
+        # slo_breach (earlier ones pinned as slo_violation).
+        trace_id = uuid.uuid4().hex
+        await http_request(
+            port, "POST", "/v1/models/slow:predict",
+            json.dumps({"instances": np.ones((1, 4)).tolist()}).encode(),
+            headers={"traceparent": format_traceparent(
+                trace_id, uuid.uuid4().hex[:16])})
+        trace_ids.append(trace_id)
+
+        status, dump = await http_json(
+            port, "GET", "/debug/flightrecorder?pinned=1&limit=50")
+        assert status == 200
+        pinned = dump["pinned"]
+        assert pinned, "SLO-violating requests were not pinned"
+        reasons = {e["pinned"] for e in pinned}
+        assert "slo_violation" in reasons
+        assert "slo_breach" in reasons
+        for entry in pinned:
+            # The stage timeline carries the request's trace id end
+            # to end: server stages, dataplane stages, batcher queue
+            # wait (with batch fill), and the engine execution.
+            assert entry["trace_id"] in trace_ids
+            names = {s["name"] for s in entry["timeline"]}
+            assert "server.infer" in names
+            assert "dataplane.predict" in names
+            assert "engine.execute" in names
+            assert "batcher.queue" in names
+            assert all(s["trace_id"] == entry["trace_id"]
+                       for s in entry["timeline"])
+        fill_spans = [s for e in pinned for s in e["timeline"]
+                      if s["name"] == "batcher.queue"]
+        assert all("fill" in s["attrs"] for s in fill_spans)
+        # The full dump also holds the ring (non-pinned view).
+        status, full = await http_json(port, "GET",
+                                       "/debug/flightrecorder")
+        assert status == 200
+        assert len(full["entries"]) >= len(pinned)
+        assert ('kfserving_tpu_flightrecorder_pinned_total'
+                '{reason="slo_violation"}') in REGISTRY.render()
+
+
+async def test_deadline_shed_pins_flight_recorder(tmp_path):
+    """A request that dies of its budget (504) pins as deadline_shed
+    even though it never reached the model."""
+    faults.configure(
+        {"dataplane.infer": {"latency_ms": 80.0, "match": "slow"}})
+    from kfserving_tpu.predictors.jax_model import JaxModel
+
+    model = JaxModel("slow", _write_mlp_dir(tmp_path, "slow"))
+    model.load()
+    async with running_server([model]) as server:
+        status, _, _ = await http_request(
+            server.http_port, "POST", "/v1/models/slow:predict",
+            json.dumps({"instances": np.ones((1, 4)).tolist()}).encode(),
+            headers={"x-request-timeout-ms": "30"})
+        assert status == 504
+        dump = server.monitoring.flight_recorder.dump(pinned_only=True)
+        assert dump["pinned"]
+        assert dump["pinned"][0]["pinned"] == "deadline_shed"
+        assert dump["pinned"][0]["status"] == 504
+
+
+async def test_grpc_requests_reach_flight_recorder(tmp_path):
+    """gRPC traffic flight-records like HTTP: a gRPC-only deployment
+    must not leave /debug/flightrecorder empty."""
+    grpc = pytest.importorskip("grpc")
+
+    from kfserving_tpu.predictors.jax_model import JaxModel
+    from kfserving_tpu.protocol.grpc import pb2
+    from kfserving_tpu.server.app import ModelServer
+
+    model = JaxModel("slow", _write_mlp_dir(tmp_path, "slow"))
+    model.load()
+    server = ModelServer(http_port=0, grpc_port=0)
+    await server.start_async([model], host="127.0.0.1")
+    channel = grpc.aio.insecure_channel(
+        f"127.0.0.1:{server.grpc_port}")
+    try:
+        req = pb2.ModelInferRequest(model_name="slow")
+        tensor = req.inputs.add()
+        tensor.name = "input_0"
+        tensor.datatype = "FP32"
+        tensor.shape.extend([1, 4])
+        tensor.contents.fp32_contents.extend([1.0] * 4)
+        infer = channel.unary_unary(
+            "/inference.GRPCInferenceService/ModelInfer",
+            request_serializer=pb2.ModelInferRequest.SerializeToString,
+            response_deserializer=pb2.ModelInferResponse.FromString)
+        await infer(req)
+        dump = server.monitoring.flight_recorder.dump()
+        assert dump["recorded"] == 1
+        assert dump["entries"][0]["model"] == "slow"
+        assert dump["entries"][0]["verb"] == "infer"
+    finally:
+        await channel.close()
+        await server.stop_async()
+
+
+# ------------------------------------------------------ router federation --
+def _write_sklearn_artifact(path):
+    import joblib
+    from sklearn import datasets, svm
+
+    os.makedirs(path, exist_ok=True)
+    X, y = datasets.load_iris(return_X_y=True)
+    joblib.dump(svm.SVC(gamma="scale").fit(X, y),
+                os.path.join(path, "model.joblib"))
+
+
+async def test_router_federates_slo_and_flightrecorder(tmp_path):
+    """The router exposes fleet views of both new endpoints, replica
+    scrapes tagged by host — like /metrics and /debug/traces."""
+    import aiohttp
+
+    from kfserving_tpu.control.controller import Controller
+    from kfserving_tpu.control.orchestrator import InProcessOrchestrator
+    from kfserving_tpu.control.router import IngressRouter
+    from kfserving_tpu.control.spec import (
+        InferenceService,
+        PredictorSpec,
+    )
+
+    artifact = str(tmp_path / "iris")
+    _write_sklearn_artifact(artifact)
+    orch = InProcessOrchestrator()
+    c = Controller(orch)
+    router = IngressRouter(c)
+    await router.start_async()
+    try:
+        isvc = InferenceService(
+            name="iris",
+            predictor=PredictorSpec(framework="sklearn",
+                                    storage_uri=f"file://{artifact}"))
+        status = await c.apply(isvc)
+        assert status.ready
+
+        base = f"http://127.0.0.1:{router.http_port}"
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f"{base}/v1/models/iris:predict",
+                    json={"instances": [[6.8, 2.8, 4.8, 1.4]]}) as resp:
+                assert resp.status == 200
+            async with session.get(f"{base}/v2/health/slo") as resp:
+                assert resp.status == 200
+                slo = await resp.json()
+            # No objectives declared on the replicas: fleet healthy,
+            # but every replica answered and is present by host.
+            assert slo["healthy"] is True
+            assert slo["replicas"]
+            for body in slo["replicas"].values():
+                assert body["alerting"] == []
+            async with session.get(
+                    f"{base}/debug/flightrecorder?limit=10") as resp:
+                assert resp.status == 200
+                fleet = await resp.json()
+            assert fleet["entries"], "replica entries not federated"
+            hosts = {e["replica"] for e in fleet["entries"]}
+            assert hosts <= set(slo["replicas"])
+            assert all(e["model"] == "iris" for e in fleet["entries"])
+    finally:
+        await router.stop_async()
+        await orch.shutdown()
