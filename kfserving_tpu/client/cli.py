@@ -7,6 +7,7 @@
     python -m kfserving_tpu.client predict NAME -d '{"instances": [[...]]}'
     python -m kfserving_tpu.client canary NAME --percent 20
     python -m kfserving_tpu.client promote NAME
+    python -m kfserving_tpu.client rollouts
 
 The reference splits this between kubectl (CRDs) and the SDK; the TPU
 build ships one client for both planes.
@@ -58,6 +59,10 @@ p_canary.add_argument("--percent", type=int, required=True)
 
 p_promote = sub.add_parser("promote", help="promote canary to 100%%")
 p_promote.add_argument("name")
+
+sub.add_parser("rollouts",
+               help="progressive-delivery status (active rollouts, "
+                    "rollbacks with evidence, quarantine)")
 
 p_creds = sub.add_parser(
     "credentials",
@@ -122,6 +127,8 @@ async def _run(args) -> dict:
             return await c.rollout_canary(args.name, args.percent, ns)
         if args.command == "promote":
             return await c.promote(args.name, ns)
+        if args.command == "rollouts":
+            return await c.rollouts()
         if args.command == "credentials":
             if args.creds_command == "set-gcs":
                 name = await c.set_gcs_credentials(

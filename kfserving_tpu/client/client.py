@@ -171,6 +171,13 @@ class KFServingClient:
             name, {"predictor": {"canary_traffic_percent": None}},
             namespace)
 
+    async def rollouts(self) -> Dict[str, Any]:
+        """Progressive-delivery status from the ingress router:
+        active rollouts, recent promotions/rollbacks (with pinned
+        evidence), and the quarantine ledger."""
+        return await self._request("GET",
+                                   f"{self._ingress()}/v2/rollouts")
+
     # -- readiness (reference wait_isvc_ready, kf_serving_client.py:232+) ---
     async def wait_isvc_ready(self, name: str, namespace: str = "default",
                               timeout_seconds: float = 120.0,

@@ -25,6 +25,11 @@ layering with explicit, swappable backends:
                   (reference ingress_reconciler.go:164-236).
 - autoscaler.py:  concurrency-based replica autoscaling with
                   scale-to-zero (Knative KPA equivalent).
+- rollout.py:     SLO-gated progressive delivery: RolloutPolicy-driven
+                  canary stepping with warmup gating, per-revision
+                  health gates, and auto-rollback with quarantine
+                  (no reference counterpart — its canary split is
+                  operator-stepped).
 """
 
 from kfserving_tpu.control.spec import (  # noqa: F401
@@ -34,5 +39,6 @@ from kfserving_tpu.control.spec import (  # noqa: F401
     LoggerSpec,
     ParallelismSpec,
     PredictorSpec,
+    RolloutPolicy,
     TrainedModel,
 )

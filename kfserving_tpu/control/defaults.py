@@ -32,6 +32,14 @@ def apply_defaults(isvc: InferenceService) -> InferenceService:
                 b.max_batch_size = DEFAULT_MAX_BATCH_SIZE
             if b.max_latency_ms <= 0:
                 b.max_latency_ms = DEFAULT_MAX_LATENCY_MS
+        if component.rollout is not None and \
+                component.canary_traffic_percent is None:
+            # Progressive delivery: the rollout manager owns the split.
+            # Start at 0% so a brand-new revision's replicas warm up
+            # (ready + warmup probes) before the first step grants any
+            # traffic.  On a first-ever apply (no previous revision)
+            # the reconciler still routes 100% to the only revision.
+            component.canary_traffic_percent = 0
     pred = isvc.predictor
     if pred.parallelism is None:
         pred.parallelism = ParallelismSpec()

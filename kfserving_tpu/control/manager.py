@@ -26,6 +26,7 @@ from kfserving_tpu.control.autoscaler import Autoscaler
 from kfserving_tpu.control.clusterconfig import ClusterConfig
 from kfserving_tpu.control.controller import Controller
 from kfserving_tpu.control.orchestrator import InProcessOrchestrator
+from kfserving_tpu.control.rollout import RolloutManager
 from kfserving_tpu.control.router import IngressRouter
 from kfserving_tpu.control.spec import InferenceService
 from kfserving_tpu.control.subprocess_orchestrator import (
@@ -74,6 +75,10 @@ class ServingManager:
             target_concurrency=(
                 self.cluster_config.autoscaler.target_concurrency),
             tick_seconds=self.cluster_config.autoscaler.tick_seconds)
+        # Progressive delivery: steps canaries up their RolloutPolicy
+        # schedule and auto-rolls back failed revisions (no-op for
+        # specs without a rollout policy).
+        self.rollouts = RolloutManager(self.controller)
         self.api = ControlAPI(
             self.controller, http_port=control_port,
             credentials=credentials,
@@ -90,11 +95,13 @@ class ServingManager:
         await self.router.start_async(self.host)
         await self.api.start_async(self.host)
         await self.autoscaler.start()
+        await self.rollouts.start()
         logger.info("control API on %s:%d, ingress on %s:%d",
                     self.host, self.api.http_port,
                     self.host, self.router.http_port)
 
     async def stop_async(self) -> None:
+        await self.rollouts.stop()
         await self.autoscaler.stop()
         await self.api.stop_async()
         await self.router.stop_async()

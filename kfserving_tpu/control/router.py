@@ -47,6 +47,11 @@ logger = logging.getLogger("kfserving_tpu.control.router")
 
 ACTIVATOR_TIMEOUT_S = 60.0
 
+# Every proxied response is tagged with the revision that served it:
+# clients (and tests) can attribute an answer to canary vs stable
+# without scraping metrics.
+REVISION_HEADER = "x-kfs-revision"
+
 
 class IngressRouter:
     def __init__(self, controller, http_port: int = 0, seed: int = 0,
@@ -127,6 +132,10 @@ class IngressRouter:
         r.add("GET", "/v2/health/slo", self._slo_health)
         r.add("GET", "/debug/flightrecorder",
               self._debug_flightrecorder)
+        # Progressive-delivery status (ISSUE 4): active rollouts,
+        # recent promotions/rollbacks with pinned evidence, and the
+        # quarantine ledger.
+        r.add("GET", "/v2/rollouts", self._rollouts)
 
     async def start_async(self, host: str = "127.0.0.1"):
         # force_close: no keep-alive pooling to upstreams.  A reused
@@ -364,20 +373,23 @@ class IngressRouter:
                        component: Optional[str] = None,
                        exclude=(), deadline: Optional[Deadline] = None
                        ) -> Tuple[Optional[str], Optional[str],
-                                  Optional[str]]:
-        """Returns (host, component_name, error)."""
+                                  Optional[str], Optional[str]]:
+        """Returns (host, component_name, revision, error)."""
         isvc = self.controller.get(name)
         if isvc is None:
-            return None, None, f"inference service {name} not found"
+            return None, None, None, \
+                f"inference service {name} not found"
         cname = component or self._entry_component(isvc, verb)
         key = f"{isvc.namespace}/{isvc.name}"
         status = self.controller.reconciler.status.get(key)
         cstatus = status.components.get(cname) if status else None
         if cstatus is None:
-            return None, cname, f"component {cname} of {name} not reconciled"
+            return None, cname, None, \
+                f"component {cname} of {name} not reconciled"
         revision = self._pick_revision(cstatus)
         if revision is None:
-            return None, cname, f"no traffic targets for {name}/{cname}"
+            return None, cname, None, \
+                f"no traffic targets for {name}/{cname}"
         cid = self.controller.reconciler.component_id(isvc, cname)
         host = self._pick_replica(cid, revision, exclude=exclude)
         if host is None:
@@ -389,13 +401,15 @@ class IngressRouter:
             # breaker exists to prevent.  Shed fast instead; the
             # reprobe (or the reconciler) restores capacity.
             if self._eligible(cid, revision, exclude):
-                return None, cname, (f"no healthy replicas for "
-                                     f"{name}/{cname} (circuit open)")
+                return None, cname, revision, (
+                    f"no healthy replicas for {name}/{cname} "
+                    f"(circuit open)")
             host = await self._activate(isvc, cname, cid, revision,
                                         deadline=deadline)
             if host is None:
-                return None, cname, f"no replicas for {name}/{cname}"
-        return host, cname, None
+                return None, cname, revision, \
+                    f"no replicas for {name}/{cname}"
+        return host, cname, revision, None
 
     async def _activate(self, isvc, cname: str, cid: str,
                         revision: str,
@@ -609,6 +623,21 @@ class IngressRouter:
             "replicas": replicas,
         }).encode())
 
+    async def _rollouts(self, req: Request) -> Response:
+        """Progressive-delivery status: the rollout manager's active
+        and recent records (with pinned rollback evidence) plus the
+        reconciler's quarantine ledger.  Answers even when no manager
+        is wired (quarantine still reported) — observability must not
+        depend on the optional control loop."""
+        manager = getattr(self.controller, "rollout_manager", None)
+        if manager is not None:
+            body = manager.report()
+        else:
+            body = {"active": [], "history": [],
+                    "quarantine":
+                        self.controller.reconciler.quarantine_report()}
+        return Response(json.dumps(body).encode())
+
     async def _debug_flightrecorder(self, req: Request) -> Response:
         """Federated flight-recorder dump: each replica's entries and
         pinned entries, tagged with the serving replica."""
@@ -638,6 +667,23 @@ class IngressRouter:
     # on kubelet restart + readiness gates; a single-host fabric must
     # handle the dead-process window itself).
     MAX_UPSTREAM_ATTEMPTS = 3
+
+    @staticmethod
+    def _observe_attempt(name: str, revision: Optional[str],
+                         status: int, started: float) -> None:
+        """Per-revision request accounting, recorded PER ATTEMPT: a
+        canary whose dispatches fail is charged those failures even
+        when failover lands the request on the stable revision —
+        otherwise an error-storming canary whose traffic always fails
+        over would show a spotless per-revision series and never trip
+        a rollout gate."""
+        if revision is None:
+            return
+        elapsed_ms = (time.perf_counter() - started) * 1000.0
+        obs.revision_requests_total().labels(
+            model=name, revision=revision, status=str(status)).inc()
+        obs.revision_request_ms().labels(
+            model=name, revision=revision).observe(elapsed_ms)
 
     def _stream_through(self, upstream, gauge_cid: str) -> Response:
         """Chunk-by-chunk SSE pass-through: no body buffering (the
@@ -714,6 +760,9 @@ class IngressRouter:
         # Echo the trace id even on router-local answers (404/503
         # sheds never reach a replica's echo path).
         resp.headers.setdefault(REQUEST_ID_HEADER, ctx.trace_id)
+        # Revision attribution: which side of a canary split answered.
+        if info.get("revision"):
+            resp.headers.setdefault(REVISION_HEADER, info["revision"])
         return resp
 
     async def _proxy_inner(self, req: Request, verb: str,
@@ -753,9 +802,10 @@ class IngressRouter:
                         body=b'{"error": "request deadline exceeded '
                              b'(router)"}',
                         status=504)
-                host, cname, err = await self._resolve(
+                host, cname, revision, err = await self._resolve(
                     name, verb, component, exclude=failed,
                     deadline=deadline)
+                info["revision"] = revision
                 if err is not None:
                     # Unknown service/component is a true 404; replica
                     # exhaustion (e.g. after evicting a crashed one) is
@@ -795,6 +845,7 @@ class IngressRouter:
                         self.request_count.get(gauge_cid, 0) + 1
                 url = f"http://{host}{path}"
                 info["upstream"] = host
+                attempt_started = time.perf_counter()
                 request_kwargs = {}
                 if stream_ok:
                     request_kwargs["timeout"] = aiohttp.ClientTimeout(
@@ -811,9 +862,16 @@ class IngressRouter:
                     # stall aiohttp's own timeout cannot see.  The
                     # configured() guard keeps the no-faults hot path
                     # at one dict lookup (no Task/timer allocation).
+                    # The fault key carries the serving revision
+                    # (`revision:<hash>`), so `match=` selectors can
+                    # scope chaos to one side of a canary split — the
+                    # hardware-free way to drive the rollout manager's
+                    # rollback path.
                     if faults.configured("router.dispatch"):
                         await asyncio.wait_for(
-                            faults.inject("router.dispatch", key=url),
+                            faults.inject(
+                                "router.dispatch",
+                                key=f"{url} revision:{revision}"),
                             timeout=self.upstream_timeout_s)
                     # Forwarded budget computed AFTER the fault sleep:
                     # injected (or real) pre-dispatch latency must
@@ -832,12 +890,23 @@ class IngressRouter:
                     if stream_ok and upstream.headers.get(
                             "content-type", "").startswith(
                                 "text/event-stream"):
+                        self._observe_attempt(name, revision,
+                                              upstream.status,
+                                              attempt_started)
                         resp = self._stream_through(upstream,
                                                     gauge_cid)
                         gauge_cid = None  # gauge now owned by stream
                         return resp
                     try:
                         body = await upstream.read()
+                        # Observed AFTER the body read: a replica that
+                        # crashes mid-response raises into the
+                        # ClientError branch below, and one physical
+                        # attempt must land exactly ONE sample in the
+                        # per-revision series the rollout gates on.
+                        self._observe_attempt(name, revision,
+                                              upstream.status,
+                                              attempt_started)
                         resp_headers = {
                             k: v for k, v in upstream.headers.items()
                             if k.lower() in (
@@ -862,6 +931,8 @@ class IngressRouter:
                     # feeding every request into a 60s timeout.
                     logger.warning("proxy to %s timed out", url)
                     self._record_failure(host)
+                    self._observe_attempt(name, revision, 504,
+                                          attempt_started)
                     return Response(
                         body=b'{"error": "upstream timeout"}',
                         status=504)
@@ -875,6 +946,8 @@ class IngressRouter:
                     logger.warning("proxy to %s failed (attempt %d): %s",
                                    url, attempt + 1, e)
                     self._record_failure(host)
+                    self._observe_attempt(name, revision, 503,
+                                          attempt_started)
                     await self._mark_failed_and_evict(
                         name, cname, host, failed)
                 except aiohttp.ClientError as e:
@@ -900,6 +973,8 @@ class IngressRouter:
                     logger.warning("proxy to %s failed mid-request: %s",
                                    url, e)
                     self._record_failure(host)
+                    self._observe_attempt(name, revision, 502,
+                                          attempt_started)
                     if await self._replica_alive(host):
                         return Response(
                             body=b'{"error": "upstream connection '

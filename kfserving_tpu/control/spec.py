@@ -57,6 +57,42 @@ class BatcherSpec:
 
 
 @dataclass
+class RolloutPolicy:
+    """Self-driving canary schedule (TPU-native; the reference keeps the
+    two-revision traffic split but leaves stepping to the operator,
+    ksvc_reconciler.go:84-118).  When set, the control plane owns
+    `canary_traffic_percent`: a new revision starts at 0% (warmup-gated
+    until `/v2/health/ready` plus `warmup_probes` probes pass), then
+    climbs `steps`, holding `hold_s` at each while the rollout analyzer
+    compares the canary's per-revision error rate and latency
+    percentile against the stable revision.  A failed gate rolls
+    traffic back to stable in one reconcile and quarantines the
+    revision's content hash."""
+
+    steps: List[int] = field(default_factory=lambda: [5, 25, 50, 100])
+    hold_s: float = 60.0
+    # Analysis delay per step: samples observed in the first settle_s
+    # seconds after a traffic change are excluded from the gates — a
+    # canary's first requests pay cold-start costs (lazy imports,
+    # first-predict compile) that must not read as a latency
+    # regression against a warmed stable.
+    settle_s: float = 1.0
+    # Canary 5xx ratio may exceed stable's by at most this much.
+    max_error_ratio: float = 0.02
+    # Canary p95 may be at most this multiple of stable p95.
+    max_latency_regression: float = 1.5
+    # Canary requests observed at a step before its gate can pass
+    # (0 = a zero-traffic service still promotes on hold_s alone).
+    min_requests: int = 0
+    # Consecutive ready-probe successes per replica before first traffic.
+    warmup_probes: int = 1
+    # A revision that never warms is a failed revision, not a pending
+    # one: past this budget the rollout rolls back and quarantines
+    # like any other failed gate (0 = wait forever).
+    warmup_timeout_s: float = 300.0
+
+
+@dataclass
 class ParallelismSpec:
     """Within-replica mesh (TPU-native; reference has no counterpart)."""
 
@@ -80,6 +116,9 @@ class ComponentSpec:
     canary_traffic_percent: Optional[int] = None
     logger: Optional[LoggerSpec] = None
     batcher: Optional[BatcherSpec] = None
+    # Progressive delivery: when set, canary_traffic_percent is managed
+    # by the rollout state machine (control/rollout.py), not operators.
+    rollout: Optional[RolloutPolicy] = None
     # Credentials are resolved per service account at replica build
     # (reference pod ServiceAccountName + pkg/credentials builder).
     service_account_name: str = "default"
@@ -137,11 +176,7 @@ class InferenceService:
         pred = d.get("predictor") or {}
         if "parallelism" in pred and isinstance(pred["parallelism"], dict):
             pred["parallelism"] = ParallelismSpec(**pred["parallelism"])
-        for key in ("logger", "batcher"):
-            if pred.get(key) and isinstance(pred[key], dict):
-                pred[key] = (LoggerSpec if key == "logger"
-                             else BatcherSpec)(**pred[key])
-        d["predictor"] = PredictorSpec(**pred)
+        d["predictor"] = PredictorSpec(**_coerce_component(pred))
         if d.get("transformer") and isinstance(d["transformer"], dict):
             d["transformer"] = TransformerSpec(**_coerce_component(
                 d["transformer"]))
@@ -159,12 +194,15 @@ class InferenceService:
         return out
 
 
+_COMPONENT_SUBSPECS = {"logger": LoggerSpec, "batcher": BatcherSpec,
+                       "rollout": RolloutPolicy}
+
+
 def _coerce_component(d: Dict[str, Any]) -> Dict[str, Any]:
     d = dict(d)
-    for key in ("logger", "batcher"):
+    for key, cls in _COMPONENT_SUBSPECS.items():
         if d.get(key) and isinstance(d[key], dict):
-            d[key] = (LoggerSpec if key == "logger"
-                      else BatcherSpec)(**d[key])
+            d[key] = cls(**d[key])
     return d
 
 

@@ -22,6 +22,38 @@ class ValidationError(ValueError):
     pass
 
 
+def _rollout_errors(policy) -> List[str]:
+    """RolloutPolicy admission checks: the step schedule must be a
+    strictly climbing ladder ending at full traffic, and every gate
+    threshold must be meaningful (a zero-regression bound would fail
+    every canary on noise)."""
+    errors: List[str] = []
+    steps = list(policy.steps or [])
+    if not steps:
+        errors.append("steps must be non-empty")
+    elif not all(isinstance(s, int) and 0 < s <= 100 for s in steps):
+        errors.append(f"steps must be integers in (0, 100], got {steps}")
+    elif any(b <= a for a, b in zip(steps, steps[1:])):
+        errors.append(f"steps must be strictly increasing, got {steps}")
+    elif steps[-1] != 100:
+        errors.append(f"steps must end at 100, got {steps}")
+    if policy.hold_s < 0:
+        errors.append("hold_s must be >= 0")
+    if policy.settle_s < 0:
+        errors.append("settle_s must be >= 0")
+    if not 0.0 <= policy.max_error_ratio <= 1.0:
+        errors.append("max_error_ratio must be in [0, 1]")
+    if policy.max_latency_regression < 1.0:
+        errors.append("max_latency_regression must be >= 1.0")
+    if policy.min_requests < 0:
+        errors.append("min_requests must be >= 0")
+    if policy.warmup_probes < 0:
+        errors.append("warmup_probes must be >= 0")
+    if policy.warmup_timeout_s < 0:
+        errors.append("warmup_timeout_s must be >= 0")
+    return errors
+
+
 def validate(isvc: InferenceService) -> None:
     errors: List[str] = []
     if not NAME_REGEX.match(isvc.name or ""):
@@ -78,6 +110,9 @@ def validate(isvc: InferenceService) -> None:
                 errors.append(f"{cname}.batcher.max_batch_size must be > 0")
             if comp.batcher.max_latency_ms <= 0:
                 errors.append(f"{cname}.batcher.max_latency_ms must be > 0")
+        if comp.rollout is not None:
+            errors.extend(f"{cname}.rollout.{e}"
+                          for e in _rollout_errors(comp.rollout))
     if isvc.explainer is not None:
         # Admission-time type check (the reference's validating webhook
         # catches bad specs at apply, not replica actuation).

@@ -31,6 +31,12 @@ from kfserving_tpu.observability.registry import (
 REQUEST_TOTAL_SERIES = "kfserving_tpu_request_total"
 REQUEST_LATENCY_SERIES = "kfserving_tpu_request_latency_ms"
 
+# Per-revision request series the router feeds and the rollout
+# analyzer (control/rollout.py) gates on — shared constants for the
+# same skipped-consumer reason as above.
+REVISION_REQUESTS_SERIES = "kfserving_tpu_revision_requests_total"
+REVISION_LATENCY_SERIES = "kfserving_tpu_revision_request_ms"
+
 
 # -- batcher ------------------------------------------------------------
 def batch_queue_wait_ms():
@@ -220,3 +226,47 @@ def router_request_ms():
         "kfserving_tpu_router_request_ms",
         "Router-observed request latency (proxy hop included)",
         buckets=LATENCY_BUCKETS_MS)
+
+
+# -- progressive rollout ------------------------------------------------
+def revision_requests_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_revision_requests_total",
+        "Router upstream attempts per served revision (labels: model, "
+        "revision, status; transport failures count as 5xx) — the "
+        "per-revision series the rollout analyzer gates on")
+
+
+def revision_request_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_revision_request_ms",
+        "Router-observed upstream attempt latency per served revision",
+        buckets=LATENCY_BUCKETS_MS)
+
+
+def rollout_state():
+    return REGISTRY.gauge(
+        "kfserving_tpu_rollout_state",
+        "Rollout state machine phase per component/revision "
+        "(0=warming, 1=progressing, 2=promoted, 3=rolled_back)")
+
+
+def rollout_step_percent():
+    return REGISTRY.gauge(
+        "kfserving_tpu_rollout_step_percent",
+        "Current canary traffic percent the rollout manager has "
+        "granted the component's latest revision")
+
+
+def rollout_transitions_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_rollout_transitions_total",
+        "Rollout state-machine transitions by event (step|promoted|"
+        "rolled_back)")
+
+
+def rollout_quarantined():
+    return REGISTRY.gauge(
+        "kfserving_tpu_rollout_quarantined",
+        "Quarantined (rolled-back) revision hashes currently "
+        "remembered per component")

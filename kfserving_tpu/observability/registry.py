@@ -176,6 +176,20 @@ class _Family:
         for key, child in items:
             yield dict(key), child
 
+    def prune(self, **labels: str) -> int:
+        """Drop every child whose labels contain all given pairs —
+        series hygiene for label values with bounded lifetimes (a
+        GC'd revision's per-revision series must not grow /metrics
+        and every scan over the family forever).  Returns the number
+        of children removed."""
+        match = {(k, str(v)) for k, v in labels.items()}
+        with self._lock:
+            gone = [key for key in self._children
+                    if match <= set(key)]
+            for key in gone:
+                del self._children[key]
+            return len(gone)
+
 
 class Registry:
     def __init__(self):

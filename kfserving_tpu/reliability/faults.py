@@ -29,8 +29,13 @@ Per-site knobs:
                  timeout-wrapped edges like the router convert it
                  into the same TimeoutError a real hang produces,
                  so it feeds breakers, not silent stalls)
-    match        substring that must appear in the call's `key`
-                 (e.g. a replica host:port) for the fault to apply
+    match        selector over the call's `key`: whitespace-separated
+                 terms that must ALL appear as substrings (e.g. a
+                 replica host:port).  Sites embed structured scopes
+                 into their keys — the router's dispatch key carries
+                 `revision:<hash>`, so `"match": "revision:ab12cd34"`
+                 injects canary-only faults that drive the rollout
+                 manager's auto-rollback path without hardware
     seed         RNG seed for error_rate draws (default 0)
 
 `FaultInjected` subclasses ConnectionError on purpose: every wrapped
@@ -162,7 +167,12 @@ class FaultInjector:
         spec = self._sites.get(site)
         if spec is None:
             return None
-        if spec.match and spec.match not in key:
+        # Every whitespace-separated term must match (conjunction):
+        # "revision:ab12 :9001" scopes a fault to one revision ON one
+        # replica.  A single term without spaces behaves exactly as
+        # the original substring match.
+        if spec.match and any(term not in key
+                              for term in spec.match.split()):
             return None
         return spec
 

@@ -23,6 +23,14 @@ env knobs; markers make replays idempotent — the TensorFlow-Serving
 retried-model-load discipline), and the `storage.download` fault site
 lets chaos tests inject failures exactly where a flaky object store
 would produce them.
+
+Content integrity: when the pulled artifact ships digests — per-file
+`<name>.sha256` siblings or a `SHA256SUMS`/`checksums.sha256`
+manifest — every covered file's sha256 is verified after the pull.
+A mismatch deletes the corrupt file and raises a connection-class
+error, so the retry policy re-pulls instead of the marker trusting a
+corrupt payload forever (the marker keys only on the URI and is
+written strictly after verification).
 """
 
 import glob
@@ -54,9 +62,108 @@ _HTTP_PREFIX = ("http://", "https://")
 _ARCHIVE_SUFFIXES = (".tar", ".tgz", ".tar.gz", ".zip", ".gz")
 
 
+_MANIFEST_NAMES = ("SHA256SUMS", "checksums.sha256")
+
+
 def _success_marker(uri: str, out_dir: str) -> str:
     digest = hashlib.sha256(uri.encode("utf-8")).hexdigest()
     return os.path.join(out_dir, f"SUCCESS.{digest}")
+
+
+class StorageIntegrityError(ConnectionError):
+    """Artifact content failed its shipped digest.  Subclasses
+    ConnectionError on purpose: the retry policy classifies it
+    transient, so a corrupted transfer re-pulls with backoff (the
+    corrupt file is already deleted) instead of failing the replica
+    terminally on one flipped bit."""
+
+
+def _file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _digest_expectations(out_dir: str):
+    """(file path, expected hex digest) pairs declared by the artifact:
+    per-file `<name>.sha256` siblings (first hex token of the file —
+    both bare-digest and coreutils `digest  name` layouts parse), and
+    manifest files with `digest  relative/path` lines."""
+    expectations = []
+    # Coreutils manifest line: digest, separator, name (binary-mode
+    # names lead with '*'; names may contain spaces).
+    manifest_line = re.compile(r"^([0-9a-fA-F]{64})[ \t]+\*?(.+)$")
+    # followlinks=False: a symlinked artifact dir (local passthrough)
+    # is never verified here, and a payload shipping a self-referential
+    # link must not walk the verifier into a cycle.
+    for root, _, files in os.walk(out_dir):
+        for fname in files:
+            path = os.path.join(root, fname)
+            if fname in _MANIFEST_NAMES:
+                try:
+                    with open(path) as f:
+                        for line in f:
+                            m = manifest_line.match(line.rstrip("\n"))
+                            if m is None:
+                                continue
+                            target = os.path.normpath(
+                                os.path.join(root, m.group(2)))
+                            # Containment: a hostile manifest naming
+                            # '../../etc/x' or an absolute path must
+                            # not make the verifier hash — or on
+                            # mismatch DELETE — anything outside the
+                            # artifact dir.
+                            if os.path.isabs(m.group(2)) or \
+                                    os.path.commonpath(
+                                        [os.path.abspath(target),
+                                         os.path.abspath(out_dir)]) != \
+                                    os.path.abspath(out_dir):
+                                logger.warning(
+                                    "ignoring digest for %r: escapes "
+                                    "the artifact dir", m.group(2))
+                                continue
+                            expectations.append((target,
+                                                 m.group(1).lower()))
+                except OSError:
+                    continue
+            elif fname.endswith(".sha256"):
+                try:
+                    with open(path) as f:
+                        head = f.read(1024).split()
+                except OSError:
+                    continue
+                if head and re.fullmatch(r"[0-9a-fA-F]{64}", head[0]):
+                    expectations.append((path[:-len(".sha256")],
+                                         head[0].lower()))
+    return expectations
+
+
+def verify_integrity(out_dir: str) -> int:
+    """Verify every digest the artifact ships; returns how many files
+    were checked.  On mismatch the corrupt file is DELETED (so the
+    retried pull rewrites it from the source instead of the bad bytes
+    surviving a partial re-pull) and StorageIntegrityError raises.  A
+    declared-but-missing file is the same condition: the payload is
+    incomplete."""
+    checked = 0
+    for path, expected in _digest_expectations(out_dir):
+        if not os.path.exists(path):
+            raise StorageIntegrityError(
+                f"artifact file {path} is declared in a digest "
+                f"manifest but missing from the payload")
+        actual = _file_sha256(path)
+        if actual != expected:
+            try:
+                os.remove(path)
+            except OSError:
+                logger.exception("could not delete corrupt %s", path)
+            raise StorageIntegrityError(
+                f"sha256 mismatch for {path}: expected {expected}, "
+                f"got {actual}; corrupt file deleted for re-pull")
+        checked += 1
+    return checked
 
 
 class Storage:
@@ -112,6 +219,11 @@ class Storage:
                     "available storage type." % (
                         _GCS_PREFIX, _S3_PREFIX, _LOCAL_PREFIX,
                         "https://"))
+            # Inside the retried pull, BEFORE the marker: a payload
+            # failing its shipped digests deletes the corrupt file and
+            # replays the download — never trusted forever by a
+            # URI-keyed marker.
+            verify_integrity(out_dir)
 
         RetryPolicy.from_env("KFS_STORAGE").call(pull)
         with open(marker, "w") as f:

@@ -461,12 +461,19 @@ async def test_router_buffer_deadline_sheds_503(tmp_path):
         await controller.apply(isvc)
         # Remove every replica and break the spec so activation cannot
         # succeed — the request must shed at ~deadline, not at 60s.
+        # Scale-up creates replicas from the per-revision spec
+        # snapshot (revisions are immutable content), so the snapshot
+        # must be broken along with the live spec.
         cid = "default/shed/predictor"
         for r in list(orch.replicas(cid)):
             await orch.delete_replica(r)
         orch.state[cid].replicas.clear()
         spec = controller.specs["default/shed"].predictor
         spec.storage_uri = str(tmp_path / "nonexistent")
+        cstatus = controller.reconciler.status["default/shed"] \
+            .components["predictor"]
+        for snap in cstatus.specs.values():
+            snap.storage_uri = spec.storage_uri
         t0 = _time.perf_counter()
         async with aiohttp.ClientSession() as session:
             async with session.post(

@@ -1,0 +1,714 @@
+"""Progressive-rollout tests: policy schema/validation, the rollout
+state machine against the fake orchestrator (step/hold/promote, warmup
+gating, gate-driven auto-rollback with quarantine, re-apply-after-
+rollback semantics), and in-process end-to-end acceptance — a failing
+canary is rolled back with zero operator input and pinned evidence, a
+healthy canary auto-promotes through every step (ISSUE 4)."""
+
+import asyncio
+
+import pytest
+
+from kfserving_tpu.control.controller import Controller
+from kfserving_tpu.control.orchestrator import (
+    FakeOrchestrator,
+    InProcessOrchestrator,
+)
+from kfserving_tpu.control.reconciler import revision_of
+from kfserving_tpu.control.rollout import RolloutManager, _p95_ms
+from kfserving_tpu.control.router import IngressRouter
+from kfserving_tpu.control.spec import (
+    InferenceService,
+    PredictorSpec,
+    RolloutPolicy,
+)
+from kfserving_tpu.control.validation import ValidationError, validate
+from kfserving_tpu.observability import REGISTRY
+from kfserving_tpu.observability import metrics as obs
+
+
+def _isvc(uri, name="svc", policy=None, **pred_kwargs):
+    pred_kwargs.setdefault("framework", "sklearn")
+    return InferenceService(
+        name=name,
+        predictor=PredictorSpec(storage_uri=uri,
+                                rollout=policy or _policy(),
+                                **pred_kwargs))
+
+
+def _policy(**kwargs):
+    kwargs.setdefault("steps", [50, 100])
+    kwargs.setdefault("hold_s", 0.0)
+    # Tests drive synthetic traffic with no cold start; the analysis
+    # delay is covered by its own test below.
+    kwargs.setdefault("settle_s", 0.0)
+    kwargs.setdefault("warmup_probes", 1)
+    return RolloutPolicy(**kwargs)
+
+
+def _feed(model, revision, status="200", n=1, latency_ms=None):
+    """Synthesize the router's per-revision series directly."""
+    for _ in range(n):
+        obs.revision_requests_total().labels(
+            model=model, revision=revision, status=status).inc()
+        obs.revision_request_ms().labels(
+            model=model, revision=revision).observe(
+                latency_ms if latency_ms is not None else 1.0)
+
+
+# ------------------------------------------------------------- schema --
+def test_rollout_policy_roundtrip():
+    isvc = _isvc("file:///m", policy=RolloutPolicy(
+        steps=[10, 100], hold_s=5.0, max_error_ratio=0.1,
+        warmup_probes=3))
+    back = InferenceService.from_dict(isvc.to_dict())
+    assert back == isvc
+    assert isinstance(back.predictor.rollout, RolloutPolicy)
+    assert back.predictor.rollout.steps == [10, 100]
+
+
+def test_revision_hash_ignores_rollout_policy():
+    a = PredictorSpec(framework="sklearn", storage_uri="file:///m")
+    b = PredictorSpec(framework="sklearn", storage_uri="file:///m",
+                      rollout=RolloutPolicy(steps=[1, 100]))
+    assert revision_of(a) == revision_of(b)
+    c = PredictorSpec(framework="sklearn", storage_uri="file:///m2",
+                      rollout=RolloutPolicy(steps=[1, 100]))
+    assert revision_of(b) != revision_of(c)
+
+
+@pytest.mark.parametrize("kwargs,match", [
+    ({"steps": []}, "non-empty"),
+    ({"steps": [0, 100]}, r"\(0, 100\]"),
+    ({"steps": [50, 25, 100]}, "strictly increasing"),
+    ({"steps": [25, 50]}, "end at 100"),
+    ({"hold_s": -1}, "hold_s"),
+    ({"max_error_ratio": 1.5}, "max_error_ratio"),
+    ({"max_latency_regression": 0.5}, "max_latency_regression"),
+    ({"warmup_probes": -1}, "warmup_probes"),
+])
+def test_rollout_policy_validation_rejects(kwargs, match):
+    isvc = _isvc("file:///m", policy=RolloutPolicy(**kwargs))
+    with pytest.raises(ValidationError, match=match):
+        validate(isvc)
+
+
+def test_rollout_policy_validation_accepts_default():
+    validate(_isvc("file:///m", policy=RolloutPolicy()))
+
+
+def test_p95_from_bucket_counts():
+    assert _p95_ms({"buckets": [1, 10, 100],
+                    "counts": [95, 5, 0, 0]}) == 1.0
+    assert _p95_ms({"buckets": [1, 10, 100],
+                    "counts": [50, 0, 45, 5]}) == 100.0
+    assert _p95_ms({"buckets": [1, 10, 100],
+                    "counts": [0, 0, 0, 10]}) == float("inf")
+    assert _p95_ms({"buckets": None, "counts": None}) is None
+
+
+async def test_adjacent_bucket_p95_is_not_a_regression():
+    """Bucket quantization guard: p95s one bucket apart (2x bound
+    ratio from near-identical latencies) must not trip the latency
+    gate — only a >1-bucket separation is a measurable regression."""
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True)
+    await c.apply(_isvc("file:///m"))
+    rev1 = revision_of(c.get("svc").predictor)
+    isvc2 = _isvc("file:///m2", policy=_policy(
+        min_requests=3, max_latency_regression=1.5))
+    await c.apply(isvc2)
+    rev2 = revision_of(isvc2.predictor)
+    await mgr.tick()  # -> step 0
+    # stable ~4ms (<=5 bucket), canary ~8ms (<=10 bucket): adjacent.
+    _feed("svc", rev1, "200", n=10, latency_ms=4.0)
+    _feed("svc", rev2, "200", n=10, latency_ms=8.0)
+    await mgr.tick()
+    rec = mgr.records["default/svc/predictor"]
+    assert rec.step_idx == 1  # advanced, not rolled back
+
+
+# ---------------------------------------------------- state machine ----
+async def test_healthy_canary_steps_and_promotes():
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True)
+    await c.apply(_isvc("file:///m"))
+    rev1 = revision_of(c.get("svc").predictor)
+    isvc2 = _isvc("file:///m2", policy=_policy(steps=[5, 25, 100]))
+    await c.apply(isvc2)
+    rev2 = revision_of(isvc2.predictor)
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    # Managed canary starts warmup-gated at 0%.
+    assert {t.revision: t.percent for t in cstatus.traffic} == \
+        {rev2: 0, rev1: 100}
+
+    await mgr.tick()  # warmed -> step 0 (5%)
+    assert {t.revision: t.percent for t in cstatus.traffic} == \
+        {rev2: 5, rev1: 95}
+    await mgr.tick()  # hold 0s -> step 1 (25%)
+    assert {t.revision: t.percent for t in cstatus.traffic} == \
+        {rev2: 25, rev1: 75}
+    await mgr.tick()  # -> step 2 (100%)
+    await mgr.tick()  # final gate -> promoted
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    assert cstatus.traffic == [t for t in cstatus.traffic
+                               if t.revision == rev2]
+    assert cstatus.traffic[0].percent == 100
+    # previous revision GC'd
+    assert {r.revision for r in orch.replicas("default/svc/predictor")} \
+        == {rev2}
+    history = mgr.report()["history"]
+    assert [h["phase"] for h in history] == ["promoted"]
+    events = [e["event"] for e in history[0]["events"]]
+    assert events.count("step") == 3 and "warmed" in events
+
+
+async def test_warmup_gates_first_step():
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    ready = {"ok": False}
+    mgr = RolloutManager(c, probe=lambda host: ready["ok"])
+    await c.apply(_isvc("file:///m"))
+    isvc2 = _isvc("file:///m2", policy=_policy(warmup_probes=2))
+    await c.apply(isvc2)
+    rev2 = revision_of(isvc2.predictor)
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+
+    for _ in range(4):  # failing probes: no traffic, no step
+        await mgr.tick()
+    assert {t.revision: t.percent for t in cstatus.traffic}[rev2] == 0
+    assert mgr.records["default/svc/predictor"].phase == "warming"
+
+    ready["ok"] = True
+    await mgr.tick()  # probe pass 1/2 — still gated
+    assert {t.revision: t.percent for t in cstatus.traffic}[rev2] == 0
+    await mgr.tick()  # probe pass 2/2 -> step 0
+    assert {t.revision: t.percent for t in cstatus.traffic}[rev2] == 50
+
+
+async def test_warmup_timeout_rolls_back_and_quarantines():
+    """A revision that never becomes ready must not park the rollout
+    (and its replicas) in 'warming' forever: past warmup_timeout_s it
+    rolls back and quarantines like any failed gate."""
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: False)  # never ready
+    await c.apply(_isvc("file:///m"))
+    rev1 = revision_of(c.get("svc").predictor)
+    wedged = _isvc("file:///wedged", policy=_policy(
+        warmup_probes=1, warmup_timeout_s=0.1))
+    await c.apply(wedged)
+    rev2 = revision_of(wedged.predictor)
+    await mgr.tick()
+    assert mgr.records["default/svc/predictor"].phase == "warming"
+    await asyncio.sleep(0.15)
+    await mgr.tick()  # deadline passed -> rollback
+    cid = "default/svc/predictor"
+    assert rev2 in c.reconciler.quarantine[cid]
+    assert mgr.report()["history"][-1]["reason"] == "warmup_timeout"
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    assert {t.revision: t.percent for t in cstatus.traffic} == \
+        {rev1: 100}
+    assert {r.revision for r in orch.replicas(cid)} == {rev1}
+
+
+async def test_finished_rollouts_prune_dead_revision_series():
+    """Series hygiene: a promoted rollout retires the GC'd stable
+    revision's per-revision children and keeps at most one
+    rollout_state child per component."""
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True)
+    await c.apply(_isvc("file:///m"))
+    rev1 = revision_of(c.get("svc").predictor)
+    isvc2 = _isvc("file:///m2")
+    await c.apply(isvc2)
+    rev2 = revision_of(isvc2.predictor)
+    _feed("svc", rev1, "200", n=3)
+    _feed("svc", rev2, "200", n=3)
+    for _ in range(4):
+        await mgr.tick()
+    assert mgr.report()["history"][-1]["phase"] == "promoted"
+    revs_with_samples = {
+        labels["revision"] for labels, _ in
+        obs.revision_requests_total().samples()}
+    assert rev1 not in revs_with_samples  # GC'd stable retired
+    assert rev2 in revs_with_samples      # live revision kept
+    state_children = list(obs.rollout_state().samples())
+    assert len(state_children) == 1
+    assert state_children[0][0]["revision"] == rev2
+
+
+async def test_hold_requires_min_requests():
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True)
+    await c.apply(_isvc("file:///m"))
+    isvc2 = _isvc("file:///m2", policy=_policy(min_requests=5))
+    await c.apply(isvc2)
+    rev2 = revision_of(isvc2.predictor)
+    await mgr.tick()  # -> step 0
+    for _ in range(3):  # hold_s elapsed but no canary traffic yet
+        await mgr.tick()
+    rec = mgr.records["default/svc/predictor"]
+    assert rec.phase == "progressing" and rec.step_idx == 0
+    _feed("svc", rev2, "200", n=5)
+    await mgr.tick()  # evidence arrived -> advance
+    assert rec.step_idx == 1
+
+
+async def test_error_ratio_gate_rolls_back_and_quarantines():
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True)
+    await c.apply(_isvc("file:///m"))
+    rev1 = revision_of(c.get("svc").predictor)
+    bad = _isvc("file:///bad", policy=_policy(min_requests=3,
+                                              max_error_ratio=0.1))
+    await c.apply(bad)
+    rev2 = revision_of(bad.predictor)
+    await mgr.tick()  # -> step 0 (baselines snapshotted)
+    _feed("svc", rev2, "500", n=4)
+    _feed("svc", rev1, "200", n=10)
+    await mgr.tick()  # gate fails -> rollback
+    cid = "default/svc/predictor"
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    assert cstatus.traffic == [t for t in cstatus.traffic
+                               if t.revision == rev1]
+    assert cstatus.traffic[0].percent == 100
+    assert {r.revision for r in orch.replicas(cid)} == {rev1}
+    assert rev2 in c.reconciler.quarantine[cid]
+    report = mgr.report()
+    assert report["history"][-1]["phase"] == "rolled_back"
+    assert report["history"][-1]["reason"] == "error_ratio"
+    assert rev2 in report["quarantine"][cid]
+
+    # Re-applying the identical spec must NOT re-roll the quarantined
+    # revision: traffic stays on stable, no canary replicas come back.
+    await c.apply(_isvc("file:///bad", policy=_policy(
+        min_requests=3, max_error_ratio=0.1)))
+    await mgr.tick()
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    assert cstatus.quarantined_revision == rev2
+    assert [(t.revision, t.percent) for t in cstatus.traffic] == \
+        [(rev1, 100)]
+    assert {r.revision for r in orch.replicas(cid)} == {rev1}
+    assert mgr.records == {}  # no rollout restarted
+
+    # A genuinely fixed spec (new content hash) rolls out normally.
+    fixed = _isvc("file:///fixed", policy=_policy())
+    await c.apply(fixed)
+    rev3 = revision_of(fixed.predictor)
+    await mgr.tick()
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    assert {t.revision: t.percent for t in cstatus.traffic} == \
+        {rev3: 50, rev1: 50}
+
+
+async def test_settle_excludes_cold_start_samples_from_gates():
+    """Analysis delay: samples in a step's first settle_s seconds
+    (cold-start latency, first-request failures) must not trip a
+    gate — the live-fire verify drive showed a warmed stable vs
+    cold canary reads as a 5x p95 'regression' without this."""
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True)
+    await c.apply(_isvc("file:///m"))
+    rev1 = revision_of(c.get("svc").predictor)
+    isvc2 = _isvc("file:///m2", policy=_policy(
+        settle_s=0.2, min_requests=2, max_error_ratio=0.05))
+    await c.apply(isvc2)
+    rev2 = revision_of(isvc2.predictor)
+    await mgr.tick()  # -> step 0, settling
+    # Cold-start garbage inside the settle window: all 5xx, huge p95.
+    _feed("svc", rev2, "500", n=6, latency_ms=900.0)
+    _feed("svc", rev1, "200", n=6, latency_ms=1.0)
+    await mgr.tick()  # still settling: no gate, no rollback
+    rec = mgr.records["default/svc/predictor"]
+    assert rec.phase == "progressing" and not rec.settled
+    await asyncio.sleep(0.25)
+    await mgr.tick()  # settle over: re-baseline, cold samples excluded
+    assert rec.settled and rec.phase == "progressing"
+    # Healthy post-settle traffic advances the step.
+    _feed("svc", rev2, "200", n=4, latency_ms=1.0)
+    _feed("svc", rev1, "200", n=4, latency_ms=1.0)
+    await mgr.tick()
+    assert rec.step_idx == 1
+
+
+async def test_reapply_mid_rollout_reasserts_step_percent():
+    """An external re-apply of the unchanged spec resets the managed
+    split to 0 (defaulting); the manager must re-assert the current
+    step or a min_requests gate would starve forever."""
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True)
+    await c.apply(_isvc("file:///m"))
+    isvc2 = _isvc("file:///m2", policy=_policy(min_requests=5))
+    await c.apply(isvc2)
+    rev2 = revision_of(isvc2.predictor)
+    await mgr.tick()  # -> step 0 (50%)
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    assert {t.revision: t.percent for t in cstatus.traffic}[rev2] == 50
+    # CI re-applies the identical YAML: split resets to the managed 0.
+    await c.apply(_isvc("file:///m2", policy=_policy(min_requests=5)))
+    assert {t.revision: t.percent for t in cstatus.traffic}[rev2] == 0
+    await mgr.tick()  # manager restores the step's percent
+    assert {t.revision: t.percent for t in cstatus.traffic}[rev2] == 50
+    assert mgr.records["default/svc/predictor"].step_idx == 0
+
+
+async def test_quarantine_outlives_stable_snapshot_gc():
+    """Rollback B->A, then promote a fixed C (A's snapshot GC'd):
+    re-applying quarantined B must still NOT re-roll — it substitutes
+    whatever is live now."""
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True)
+    await c.apply(_isvc("file:///a"))
+    rev_a = revision_of(c.get("svc").predictor)
+    bad = _isvc("file:///b", policy=_policy(min_requests=1,
+                                            max_error_ratio=0.05))
+    await c.apply(bad)
+    rev_b = revision_of(bad.predictor)
+    await mgr.tick()
+    _feed("svc", rev_b, "500", n=3)
+    await mgr.tick()  # B rolled back, quarantined
+    cid = "default/svc/predictor"
+    assert rev_b in c.reconciler.quarantine[cid]
+    # Fixed revision C rolls out and promotes; A's snapshot is GC'd.
+    fixed = _isvc("file:///c", policy=_policy())
+    await c.apply(fixed)
+    rev_c = revision_of(fixed.predictor)
+    for _ in range(4):
+        await mgr.tick()
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    assert {t.revision: t.percent for t in cstatus.traffic} == \
+        {rev_c: 100}
+    assert rev_a not in cstatus.specs
+    # Re-apply the quarantined B: substituted with live C, never B.
+    await c.apply(_isvc("file:///b", policy=_policy(
+        min_requests=1, max_error_ratio=0.05)))
+    await mgr.tick()
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    assert {t.revision: t.percent for t in cstatus.traffic} == \
+        {rev_c: 100}
+    assert cstatus.quarantined_revision == rev_b
+    assert {r.revision for r in orch.replicas(cid)} == {rev_c}
+
+
+async def test_autoscaler_scale_keeps_stable_floor_at_final_step():
+    """At the 100% step the stable side carries 0%% traffic but IS the
+    rollback target: the autoscaler's scale() must keep its replica
+    floor (a last-gate rollback must not cold-start)."""
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True)
+    await c.apply(_isvc("file:///m"))
+    rev1 = revision_of(c.get("svc").predictor)
+    isvc2 = _isvc("file:///m2", policy=_policy(steps=[100]))
+    isvc2.predictor.max_replicas = 4
+    await c.apply(isvc2)
+    rev2 = revision_of(isvc2.predictor)
+    await mgr.tick()  # -> the single step: 100% canary / 0% stable
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    assert {t.revision: t.percent for t in cstatus.traffic} == \
+        {rev2: 100, rev1: 0}
+    await c.reconciler.scale(isvc2, "predictor", 3)
+    cid = "default/svc/predictor"
+    revs = {}
+    for r in orch.replicas(cid):
+        revs[r.revision] = revs.get(r.revision, 0) + 1
+    assert revs[rev2] == 3       # latest scaled
+    assert revs.get(rev1, 0) >= 1  # stable floor survives
+
+
+async def test_latency_regression_gate_rolls_back():
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True)
+    await c.apply(_isvc("file:///m"))
+    rev1 = revision_of(c.get("svc").predictor)
+    slow = _isvc("file:///slow", policy=_policy(
+        min_requests=3, max_latency_regression=2.0))
+    await c.apply(slow)
+    rev2 = revision_of(slow.predictor)
+    await mgr.tick()  # -> step 0
+    _feed("svc", rev1, "200", n=10, latency_ms=1.0)    # stable p95 ~1ms
+    _feed("svc", rev2, "200", n=10, latency_ms=400.0)  # canary p95 ~500ms
+    await mgr.tick()
+    assert mgr.report()["history"][-1]["reason"] == "latency_regression"
+    cstatus = c.reconciler.status["default/svc"].components["predictor"]
+    assert {t.revision: t.percent for t in cstatus.traffic} == \
+        {rev1: 100}
+
+
+async def test_slo_breach_attributed_to_canary_rolls_back():
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    mgr = RolloutManager(c, probe=lambda host: True,
+                         slo_check=lambda model, hosts: True)
+    await c.apply(_isvc("file:///m"))
+    await c.apply(_isvc("file:///m2"))
+    await mgr.tick()  # -> step 0
+    await mgr.tick()  # SLO breach -> rollback
+    assert mgr.report()["history"][-1]["reason"] == "slo_breach"
+
+
+# ------------------------------------------------------- end-to-end ----
+def _model_factory(component_id, spec):
+    from kfserving_tpu import Model
+
+    class OkModel(Model):
+        def load(self):
+            self.ready = True
+            return True
+
+        async def predict(self, request):
+            return {"predictions": [1]}
+
+    class BoomModel(OkModel):
+        async def predict(self, request):
+            raise RuntimeError("canary artifact is broken")
+
+    name = component_id.split("/")[1]
+    cls = BoomModel if "bad" in (spec.storage_uri or "") else OkModel
+    return cls(name)
+
+
+async def _drive(router, name, n):
+    """Fire n predicts through the router; returns status counts."""
+    import aiohttp
+
+    statuses = []
+    async with aiohttp.ClientSession() as session:
+        for _ in range(n):
+            async with session.post(
+                    f"http://127.0.0.1:{router.http_port}"
+                    f"/v1/models/{name}:predict",
+                    json={"instances": [[1.0]]}) as resp:
+                statuses.append(resp.status)
+                await resp.read()
+    return statuses
+
+
+def _e2e_isvc(uri, policy):
+    return InferenceService(
+        name="roll", predictor=PredictorSpec(
+            framework="custom", command=["unused"], storage_uri=uri,
+            rollout=policy))
+
+
+async def test_e2e_failing_canary_auto_rollback_with_evidence():
+    """Acceptance: a canary whose replicas 5xx is rolled back with
+    ZERO operator input; the rollback pins the canary's flight-
+    recorder evidence and GET /v2/rollouts records it; the quarantined
+    revision does not re-roll on spec re-apply."""
+    import aiohttp
+
+    orch = InProcessOrchestrator(model_factory=_model_factory)
+    c = Controller(orch)
+    router = IngressRouter(c, seed=3)
+    mgr = RolloutManager(c)
+    mgr._session = aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=2.0))
+    await router.start_async()
+    try:
+        policy = _policy(steps=[50, 100], min_requests=3,
+                         max_error_ratio=0.1, warmup_probes=1)
+        await c.apply(_e2e_isvc("file:///good", policy))
+        stable_rev = revision_of(c.get("roll").predictor)
+        bad = _e2e_isvc("file:///bad-v2", policy)
+        await c.apply(bad)
+        bad_rev = revision_of(bad.predictor)
+        await mgr.tick()  # real ready probes pass -> step 0 (50%)
+        rec = mgr.records["default/roll/predictor"]
+        assert rec.phase == "progressing" and rec.percent == 50
+
+        statuses = await _drive(router, "roll", 24)
+        assert 500 in statuses  # canary slice answered 5xx
+        await mgr.tick()  # error-ratio gate -> auto-rollback
+
+        cid = "default/roll/predictor"
+        cstatus = c.reconciler.status["default/roll"] \
+            .components["predictor"]
+        assert {t.revision: t.percent for t in cstatus.traffic} == \
+            {stable_rev: 100}
+        assert bad_rev in c.reconciler.quarantine[cid]
+        # After rollback every request succeeds on stable.
+        assert set(await _drive(router, "roll", 6)) == {200}
+
+        # /v2/rollouts federates the record, evidence included.
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f"http://127.0.0.1:{router.http_port}"
+                    f"/v2/rollouts") as resp:
+                assert resp.status == 200
+                body = await resp.json()
+        record = body["history"][-1]
+        assert record["phase"] == "rolled_back"
+        assert record["reason"] == "error_ratio"
+        assert record["revision"] == bad_rev
+        assert record["evidence"], "rollback must pin evidence"
+        assert any(e.get("pinned") == "error"
+                   for e in record["evidence"])
+        assert bad_rev in body["quarantine"][cid]
+
+        # Re-apply of the identical bad spec: no re-roll.
+        await c.apply(_e2e_isvc("file:///bad-v2", policy))
+        await mgr.tick()
+        cstatus = c.reconciler.status["default/roll"] \
+            .components["predictor"]
+        assert {t.revision: t.percent for t in cstatus.traffic} == \
+            {stable_rev: 100}
+        assert set(await _drive(router, "roll", 4)) == {200}
+    finally:
+        await mgr._session.close()
+        await router.stop_async()
+        await orch.shutdown()
+
+
+async def test_e2e_healthy_canary_auto_promotes():
+    """Acceptance: a healthy canary climbs every step to 100% without
+    operator input; the old revision is GC'd and answers carry the
+    new revision's tag."""
+    import aiohttp
+
+    orch = InProcessOrchestrator(model_factory=_model_factory)
+    c = Controller(orch)
+    router = IngressRouter(c)
+    mgr = RolloutManager(c)
+    mgr._session = aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=2.0))
+    await router.start_async()
+    try:
+        policy = _policy(steps=[25, 100], min_requests=2,
+                         warmup_probes=1)
+        await c.apply(_e2e_isvc("file:///good", policy))
+        v2 = _e2e_isvc("file:///good-v2", policy)
+        await c.apply(v2)
+        rev2 = revision_of(v2.predictor)
+        cid = "default/roll/predictor"
+        for _ in range(8):
+            await mgr.tick()
+            await _drive(router, "roll", 8)
+            cstatus = c.reconciler.status["default/roll"] \
+                .components["predictor"]
+            if {t.revision for t in cstatus.traffic} == {rev2}:
+                break
+        cstatus = c.reconciler.status["default/roll"] \
+            .components["predictor"]
+        assert {t.revision: t.percent for t in cstatus.traffic} == \
+            {rev2: 100}
+        assert {r.revision for r in orch.replicas(cid)} == {rev2}
+        assert mgr.report()["history"][-1]["phase"] == "promoted"
+        # Responses are revision-tagged.
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                    f"http://127.0.0.1:{router.http_port}"
+                    f"/v1/models/roll:predict",
+                    json={"instances": [[1.0]]}) as resp:
+                assert resp.status == 200
+                assert resp.headers.get("x-kfs-revision") == rev2
+    finally:
+        await mgr._session.close()
+        await router.stop_async()
+        await orch.shutdown()
+
+
+@pytest.mark.chaos
+async def test_revision_matched_fault_drives_rollback():
+    """Satellite: `match=revision:<hash>` scopes router.dispatch
+    faults to the canary side of the split, driving the auto-rollback
+    loop without hardware (the KFS_FAULTS env shape)."""
+    import aiohttp
+
+    from kfserving_tpu.reliability import faults
+
+    orch = InProcessOrchestrator(model_factory=_model_factory)
+    c = Controller(orch)
+    router = IngressRouter(c, seed=1)
+    mgr = RolloutManager(c)
+    mgr._session = aiohttp.ClientSession(
+        timeout=aiohttp.ClientTimeout(total=2.0))
+    await router.start_async()
+    try:
+        policy = _policy(steps=[50, 100], min_requests=2,
+                         max_error_ratio=0.1, warmup_probes=0)
+        await c.apply(_e2e_isvc("file:///good", policy))
+        stable_rev = revision_of(c.get("roll").predictor)
+        v2 = _e2e_isvc("file:///good-v2", policy)
+        await c.apply(v2)
+        rev2 = revision_of(v2.predictor)
+        faults.configure({"router.dispatch": {
+            "error_rate": 1.0, "match": f"revision:{rev2}"}})
+        await mgr.tick()  # -> step 0 (50%)
+        await _drive(router, "roll", 16)
+        await mgr.tick()  # canary-only injected 5xx -> rollback
+        cstatus = c.reconciler.status["default/roll"] \
+            .components["predictor"]
+        assert {t.revision: t.percent for t in cstatus.traffic} == \
+            {stable_rev: 100}
+        assert mgr.report()["history"][-1]["phase"] == "rolled_back"
+        faults.reset()
+        assert set(await _drive(router, "roll", 4)) == {200}
+    finally:
+        faults.reset()
+        await mgr._session.close()
+        await router.stop_async()
+        await orch.shutdown()
+
+
+# ---------------------------------------------------------- metrics ----
+def test_rollout_metric_families_pass_lint():
+    """Satellite: the new rollout/revision families obey the house
+    exposition rules (tools/check_metrics)."""
+    from kfserving_tpu.tools.check_metrics import (
+        lint_exposition,
+        lint_families,
+    )
+
+    obs.revision_requests_total().labels(
+        model="m", revision="ab12", status="200").inc()
+    obs.revision_request_ms().labels(model="m", revision="ab12") \
+        .observe(3.0)
+    obs.rollout_state().labels(component="c", revision="ab12").set(1)
+    obs.rollout_step_percent().labels(component="c").set(25)
+    obs.rollout_transitions_total().labels(
+        component="c", event="step").inc()
+    obs.rollout_quarantined().labels(component="c").set(0)
+    assert lint_families(REGISTRY.families()) == []
+    assert lint_exposition(REGISTRY.render(exemplars=False)) == []
+
+
+def test_revision_label_values_escape_in_federation():
+    """Satellite: adversarial revision-label values (quotes,
+    backslashes, newlines) must render escaped and survive the
+    router's federation relabeler unbroken."""
+    from kfserving_tpu.observability.federation import (
+        relabel,
+        split_sample,
+    )
+
+    evil = 'rev"with\\quotes\nand-newline'
+    obs.revision_requests_total().labels(
+        model="m", revision=evil, status="200").inc()
+    text = REGISTRY.render(exemplars=False)
+    line = next(l for l in text.splitlines()
+                if l.startswith("kfserving_tpu_revision_requests_total{"))
+    assert '\\"' in line and "\\\\" in line and "\\n" in line
+    assert "\n" not in line  # the raw newline never splits the sample
+    parsed = split_sample(line)
+    assert parsed is not None
+    name, inner, rest = parsed
+    assert rest == "1"
+    # The federation relabeler keeps the escaped value intact while
+    # injecting the replica label.
+    relabeled = relabel(text, {"replica": "10.0.0.1:9000"})
+    rline = next(l for l in relabeled
+                 if l.startswith("kfserving_tpu_revision_requests_total{"))
+    assert 'replica="10.0.0.1:9000"' in rline
+    assert split_sample(rline) is not None
+    assert split_sample(rline)[2] == "1"

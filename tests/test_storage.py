@@ -147,3 +147,154 @@ def test_idempotent_success_marker(tmp_path, monkeypatch):
     assert len(calls) == 1
     markers = [f for f in os.listdir(out_dir) if f.startswith("SUCCESS.")]
     assert len(markers) == 1
+
+
+# ------------------------------------------------- content integrity --
+class _ZipResponse:
+    """FakeResponse serving a zip built from a {name: bytes} dict."""
+
+    status = 200
+
+    def __init__(self, files):
+        import io
+
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w") as zf:
+            for name, data in files.items():
+                zf.writestr(name, data)
+        buf.seek(0)
+        self._buf = buf
+
+    def read(self, *a):
+        return self._buf.read(*a)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        pass
+
+
+def _sha256(data: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(data).hexdigest()
+
+
+def test_shipped_sha256_verified_ok(tmp_path, monkeypatch):
+    """An artifact shipping per-file digests downloads and verifies."""
+    from kfserving_tpu.storage import storage as storage_mod
+
+    payload = b"GOODBYTES"
+    monkeypatch.setattr(
+        storage_mod, "urlopen",
+        lambda req: _ZipResponse({"weights.bin": payload,
+                                  "weights.bin.sha256":
+                                      _sha256(payload)}))
+    out_dir = tmp_path / "out"
+    Storage.download("http://example.com/model.zip", str(out_dir))
+    assert (out_dir / "weights.bin").read_bytes() == payload
+    assert [f for f in os.listdir(out_dir) if f.startswith("SUCCESS.")]
+
+
+def test_sha256_mismatch_deletes_and_repulls(tmp_path, monkeypatch):
+    """A corrupt payload fails its digest: the corrupt file is
+    deleted, NO success marker is written, and the retry policy
+    re-pulls (today's URI-keyed marker would trust it forever)."""
+    from kfserving_tpu.storage import storage as storage_mod
+    from kfserving_tpu.storage.storage import StorageIntegrityError
+
+    monkeypatch.setenv("KFS_STORAGE_RETRY_MAX_ATTEMPTS", "2")
+    monkeypatch.setenv("KFS_STORAGE_RETRY_BASE_MS", "1")
+    calls = []
+
+    def opener(req):
+        calls.append(1)
+        return _ZipResponse({"weights.bin": b"CORRUPTED",
+                             "weights.bin.sha256": _sha256(b"GOOD")})
+
+    monkeypatch.setattr(storage_mod, "urlopen", opener)
+    out_dir = tmp_path / "out"
+    with pytest.raises(StorageIntegrityError, match="sha256 mismatch"):
+        Storage.download("http://example.com/model.zip", str(out_dir))
+    assert len(calls) == 2  # the retry replayed the pull
+    assert not (out_dir / "weights.bin").exists()  # corrupt file gone
+    assert not [f for f in os.listdir(out_dir)
+                if f.startswith("SUCCESS.")]
+
+
+def test_corruption_heals_on_retry(tmp_path, monkeypatch):
+    """First pull corrupt, second clean: the retry converges and the
+    marker is written only after verification passes."""
+    from kfserving_tpu.storage import storage as storage_mod
+
+    monkeypatch.setenv("KFS_STORAGE_RETRY_BASE_MS", "1")
+    good = b"GOOD"
+    responses = [
+        _ZipResponse({"weights.bin": b"FLIPPEDBIT",
+                      "weights.bin.sha256": _sha256(good)}),
+        _ZipResponse({"weights.bin": good,
+                      "weights.bin.sha256": _sha256(good)}),
+    ]
+    monkeypatch.setattr(storage_mod, "urlopen",
+                        lambda req: responses.pop(0))
+    out_dir = tmp_path / "out"
+    Storage.download("http://example.com/model.zip", str(out_dir))
+    assert (out_dir / "weights.bin").read_bytes() == good
+    assert [f for f in os.listdir(out_dir) if f.startswith("SUCCESS.")]
+
+
+def test_manifest_sha256sums_verification(tmp_path):
+    """SHA256SUMS manifests verify every covered file; a missing
+    declared file is an integrity failure too."""
+    from kfserving_tpu.storage.storage import (
+        StorageIntegrityError,
+        verify_integrity,
+    )
+
+    (tmp_path / "a.bin").write_bytes(b"AAA")
+    (tmp_path / "b.bin").write_bytes(b"BBB")
+    (tmp_path / "SHA256SUMS").write_text(
+        f"{_sha256(b'AAA')}  a.bin\n{_sha256(b'BBB')}  b.bin\n")
+    assert verify_integrity(str(tmp_path)) == 2
+
+    (tmp_path / "b.bin").write_bytes(b"EVIL")
+    with pytest.raises(StorageIntegrityError, match="sha256 mismatch"):
+        verify_integrity(str(tmp_path))
+    assert not (tmp_path / "b.bin").exists()
+
+    (tmp_path / "SHA256SUMS").write_text(
+        f"{_sha256(b'AAA')}  a.bin\n{_sha256(b'X')}  gone.bin\n")
+    with pytest.raises(StorageIntegrityError, match="missing"):
+        verify_integrity(str(tmp_path))
+
+
+def test_manifest_names_with_spaces(tmp_path):
+    """Coreutils manifests may name files containing spaces; the
+    parser must keep the whole name (a valid artifact must not fail
+    verification forever)."""
+    from kfserving_tpu.storage.storage import verify_integrity
+
+    (tmp_path / "my model.bin").write_bytes(b"DATA")
+    (tmp_path / "SHA256SUMS").write_text(
+        f"{_sha256(b'DATA')}  my model.bin\n")
+    assert verify_integrity(str(tmp_path)) == 1
+
+
+def test_manifest_path_escape_is_rejected(tmp_path):
+    """A hostile manifest naming files outside the artifact dir must
+    be ignored: the verifier must never hash — or on mismatch
+    delete — anything beyond out_dir."""
+    from kfserving_tpu.storage.storage import verify_integrity
+
+    outside = tmp_path / "outside.bin"
+    outside.write_bytes(b"PRECIOUS")
+    art = tmp_path / "artifact"
+    art.mkdir()
+    (art / "a.bin").write_bytes(b"AAA")
+    (art / "SHA256SUMS").write_text(
+        f"{_sha256(b'AAA')}  a.bin\n"
+        f"{_sha256(b'X')}  ../outside.bin\n"
+        f"{_sha256(b'X')}  /etc/hostname\n")
+    assert verify_integrity(str(art)) == 1  # only the contained file
+    assert outside.read_bytes() == b"PRECIOUS"
