@@ -140,6 +140,15 @@ def _compact_configs(results: dict) -> dict:
         elif name == "generate_4k":
             c.update(pick(r, "tokens_per_s", "ttft_p50_ms",
                           "prefix_hit_rate", "hbm_vs_dense"))
+        elif name == "generate_cold4k":
+            c.update(pick(r, "gap_p99_ms", "gap_p99_ms_monolithic",
+                          "gap_p99_chunked_over_monolithic"))
+        elif name == "generate_stream_wire":
+            c["grpc_over_sse"] = r.get("grpc_over_sse")
+            c["grpc_tokens_per_s"] = (r.get("grpc") or {}).get(
+                "tokens_per_s")
+            c["sse_tokens_per_s"] = (r.get("sse") or {}).get(
+                "tokens_per_s")
         elif name == "multimodel":
             c.update(pick(r, "load_all_s", "swap_cycle_ms",
                           "round_robin_req_per_s"))
@@ -172,6 +181,8 @@ def main():
         "generate": C.bench_generate,
         "generate_poisson": C.bench_generate_poisson,
         "generate_4k": C.bench_generate_4k,
+        "generate_cold4k": C.bench_generate_cold4k,
+        "generate_stream_wire": C.bench_generate_stream_wire,
     }
     results = {}
     for name, fn in matrix.items():

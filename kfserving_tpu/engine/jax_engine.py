@@ -426,6 +426,7 @@ class JaxEngine:
             out = {
                 "execute_count": n,
                 "compile_count": self.compile_count,
+                "pipeline_depth": self.pipeline_depth,
                 "last_execute_ms": self.last_execute_ms,
                 "avg_pad_waste": (self.padded_waste_total / n
                                   if n else 0.0),

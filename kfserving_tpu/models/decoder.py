@@ -85,38 +85,57 @@ class DecoderBlock(nn.Module):
         q = proj("query")(x)
         k = proj("key")(x)
         v = proj("value")(x)
+        lq = q.shape[1]
         new_cache = None
         if cache is not None and len(cache) == 3:
-            # Paged decode: cache = (pool_k, pool_v, block_table) —
+            # Paged cache: cache = (pool_k, pool_v, block_table) —
             # shared block pools [NB, BS, H, D] plus this batch's
             # [B, MB] table (engine/generator.py paged mode; the
             # static 3-vs-2 tuple arity picks the branch at trace
             # time).  The table flows in per dispatch and is not
-            # returned — only the written pools are.
+            # returned — only the written pools are.  Lq == 1 is the
+            # decode step; Lq > 1 is a CHUNK PREFILL: the chunk's
+            # tokens write through the table, then attend over the
+            # pool with per-query causal masking (earlier chunks are
+            # already resident — cross-chunk attention comes from the
+            # pool, exactly like decode).
             from kfserving_tpu.ops.paged_attention import (
                 paged_attention,
+                paged_prefill_attention_xla,
                 paged_write,
             )
 
             pool_k, pool_v, table = cache
-            pool_k, pool_v = paged_write(pool_k, pool_v, k[:, 0],
-                                         v[:, 0], table, positions)
-            new_cache = (pool_k, pool_v)
-            out = paged_attention(q, pool_k, pool_v, table,
-                                  positions + 1)
+            if lq == 1:
+                pool_k, pool_v = paged_write(pool_k, pool_v, k[:, 0],
+                                             v[:, 0], table,
+                                             positions[:, 0])
+                new_cache = (pool_k, pool_v)
+                out = paged_attention(q, pool_k, pool_v, table,
+                                      positions[:, 0] + 1)
+            else:
+                pool_k, pool_v = paged_write(pool_k, pool_v, k, v,
+                                             table, positions)
+                new_cache = (pool_k, pool_v)
+                out = paged_prefill_attention_xla(q, pool_k, pool_v,
+                                                  table, positions)
         elif cache is not None:
             k_cache, v_cache = cache
             b = k_cache.shape[0]
-            rows = jnp.arange(b)
+            rows = jnp.arange(b)[:, None]
+            # mode="drop": positions carry an out-of-range sentinel
+            # for rows the engine parked (freed / mid-prefill slots) —
+            # a clamped write would corrupt the row's last position.
             k_cache = k_cache.at[rows, positions].set(
-                k[:, 0].astype(k_cache.dtype))
+                k.astype(k_cache.dtype), mode="drop")
             v_cache = v_cache.at[rows, positions].set(
-                v[:, 0].astype(v_cache.dtype))
+                v.astype(v_cache.dtype), mode="drop")
             new_cache = (k_cache, v_cache)
-            # Valid keys are exactly positions <= current position.
+            # Valid keys are exactly positions <= the query's own
+            # position (per query — Lq > 1 is a chunk prefill).
             max_seq = k_cache.shape[1]
-            attn_mask = (jnp.arange(max_seq)[None, :]
-                         <= positions[:, None])[:, None, None, :]
+            attn_mask = (jnp.arange(max_seq)[None, None, :]
+                         <= positions[:, :, None])[:, None]
             out = dot_product_attention(q, k_cache, v_cache,
                                         mask=attn_mask)
         elif cfg.attn_fn is not None:
@@ -161,6 +180,17 @@ class DecoderLM(nn.Module):
     decode: input_ids [B, 1] + kv_cache (list of per-layer (k, v)
         [B, max_seq, H, D]) + positions [B].  Returns logits [B, 1, V]
         and the updated caches.
+    chunk prefill: input_ids [B, L>1] + kv_cache + positions [B, L] —
+        the chunk's tokens write into the cache at their absolute
+        positions and attend per-query-causally over the cache
+        (earlier chunks included), so a long prompt lands in
+        block-aligned pieces between decode waves.
+    logit_positions: optional [B] int32 — compute logits ONLY at that
+        position per row (hidden gathered before the final norm +
+        LM head).  The sampled-token path never needs the [B, L, V]
+        logits cube; skipping it drops the LM-head matmul from
+        O(L·H·V) to O(H·V) per row, the dominant prefill FLOP at
+        long L.  Returns logits [B, 1, V].
     """
 
     config: DecoderConfig
@@ -169,7 +199,8 @@ class DecoderLM(nn.Module):
     def __call__(self, input_ids, positions: Optional[Any] = None,
                  kv_cache: Optional[Any] = None,
                  kv_lengths: Optional[Any] = None,
-                 return_cache: bool = False):
+                 return_cache: bool = False,
+                 logit_positions: Optional[Any] = None):
         cfg = self.config
         b, l = input_ids.shape
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
@@ -179,17 +210,28 @@ class DecoderLM(nn.Module):
         else:
             pos = positions.reshape(b, -1)
         hidden = embed(input_ids)
+        # Clamp for the position table: cache-mode callers park
+        # padding/sentinel rows on max_seq (their cache writes drop;
+        # an unclamped index would still be gather-clamped inside jit,
+        # this just makes the contract explicit).
         hidden += nn.Embed(cfg.max_seq, cfg.hidden_size, dtype=cfg.dtype,
-                           name="wpe")(pos)
+                           name="wpe")(jnp.minimum(pos, cfg.max_seq - 1))
         caches = []
         for i in range(cfg.num_layers):
             layer_cache = None if kv_cache is None else kv_cache[i]
             layer_pos = (None if kv_cache is None
-                         else pos.reshape(b))
+                         else pos.reshape(b, -1))
             hidden, new_cache = DecoderBlock(cfg, name=f"layer_{i}")(
                 hidden, kv_lengths=kv_lengths, cache=layer_cache,
                 positions=layer_pos)
             caches.append(new_cache)
+        if logit_positions is not None:
+            # Per-row gather BEFORE the norm + LM head: LayerNorm and
+            # the tied-embedding matmul are per-position, so the
+            # sliced path is numerically identical to slicing the
+            # full logits cube at the same index.
+            hidden = jnp.take_along_axis(
+                hidden, logit_positions.reshape(b, 1, 1), axis=1)
         hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                               name="final_norm")(hidden)
         logits = embed.attend(hidden.astype(embed.embedding.dtype))

@@ -94,6 +94,38 @@ def llm_tokens_total():
         "Prompt and generated tokens by direction (direction=in|out)")
 
 
+def generator_prefill_chunks_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_generator_prefill_chunks_total",
+        "Chunked-prefill chunks by outcome (outcome=dispatched — one "
+        "device call riding the decode FIFO; skipped_shared — every "
+        "block was a prefix-cache hit, no compute dispatched)")
+
+
+def generator_prefill_chunk_stall_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_generator_prefill_chunk_stall_ms",
+        "Device-busy time one prefill chunk inserted between decode "
+        "fetches — the stall a cold prompt adds to live streams per "
+        "chunk (the monolithic-prefill stall divided by chunk count)")
+
+
+def generator_pipeline_depth():
+    return REGISTRY.gauge(
+        "kfserving_tpu_generator_pipeline_depth",
+        "Effective decode pipeline depth after the adaptive governor "
+        "(configured depth when streams extend past the in-flight "
+        "horizon; 1 when speculative waves could only decode garbage)")
+
+
+def generator_suppressed_waves_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_generator_suppressed_waves_total",
+        "Speculative decode waves the adaptive-depth governor did not "
+        "enqueue because every active stream provably finishes within "
+        "the waves already in flight")
+
+
 # -- reliability --------------------------------------------------------
 def breaker_state():
     return REGISTRY.gauge(

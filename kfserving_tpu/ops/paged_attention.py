@@ -209,22 +209,68 @@ def paged_attention_xla(q, pool_k, pool_v, block_table, lengths):
 
 def paged_write(pool_k, pool_v, k_step, v_step, block_table,
                 positions):
-    """Scatter one decode step's k/v ([B, H, D] each) into the pools
-    at each slot's current position.  Unallocated targets (-1 in the
-    table) drop via OOB sentinel."""
+    """Scatter a step's k/v into the pools at each slot's positions.
+
+    Two call shapes, distinguished at trace time:
+      decode:        k/v [B, H, D],    positions [B]
+      chunk prefill: k/v [B, L, H, D], positions [B, L]
+    Unallocated targets (-1 in the table) AND positions past the
+    table's coverage (the engine parks mid-prefill slots on an
+    out-of-range feed-position sentinel so speculative decode waves
+    cannot corrupt chunks already written) drop via OOB sentinel —
+    never clamp: a clamped OOB write would land inside another
+    position's block."""
     bs = pool_k.shape[1]
+    mb = block_table.shape[1]
+    chunked = positions.ndim == 2
     block_idx = positions // bs
     offs = positions % bs
     rows = jnp.arange(block_table.shape[0])
-    blocks = block_table[rows, jnp.minimum(block_idx,
-                                           block_table.shape[1] - 1)]
-    # -1 -> OOB sentinel so mode="drop" discards the write.
-    blocks = jnp.where(blocks < 0, pool_k.shape[0], blocks)
+    if chunked:
+        rows = rows[:, None]
+    blocks = block_table[rows, jnp.minimum(block_idx, mb - 1)]
+    # -1 (unallocated) or past-the-table positions -> OOB sentinel so
+    # mode="drop" discards the write.
+    blocks = jnp.where((blocks < 0) | (block_idx >= mb),
+                       pool_k.shape[0], blocks)
     pool_k = pool_k.at[blocks, offs].set(
         k_step.astype(pool_k.dtype), mode="drop")
     pool_v = pool_v.at[blocks, offs].set(
         v_step.astype(pool_v.dtype), mode="drop")
     return pool_k, pool_v
+
+
+def paged_prefill_attention_xla(q, pool_k, pool_v, block_table,
+                                q_positions):
+    """Chunk-prefill attention: multi-token queries over the paged
+    pool with PER-QUERY causal masking (query at absolute position p
+    attends keys at positions <= p).  The single-length mask of
+    `paged_attention_xla` cannot express this — a chunk's later
+    queries see more of the pool than its earlier ones.
+
+    q           [B, L, H, D]   the chunk's queries (L > 1)
+    q_positions [B, L] int32   absolute position per query; the
+                               engine parks padding queries of a
+                               partial final chunk on an out-of-range
+                               sentinel (their output is discarded,
+                               the mask keeps them finite)
+    Returns [B, L, H, D]."""
+    b, lq, h, d = q.shape
+    nb, bs, _, _ = pool_k.shape
+    mb = block_table.shape[1]
+    table = jnp.maximum(block_table, 0)
+    k = pool_k[table].reshape(b, mb * bs, h, d)
+    v = pool_v[table].reshape(b, mb * bs, h, d)
+    key_pos = jnp.arange(mb * bs)[None, None, :]          # [1, 1, K]
+    mask = (key_pos <= q_positions[:, :, None])[:, None]  # [B,1,L,K]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights,
+                     v.astype(jnp.float32))
+    return out.astype(q.dtype)
 
 
 def paged_insert(pool_k, pool_v, k_new, v_new, dest_blocks, lengths):

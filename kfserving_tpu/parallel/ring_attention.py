@@ -33,7 +33,12 @@ def _ring_attention_local(q, k, v, kv_mask, axis_name: str, causal: bool):
     The sp axis index orders blocks: device i holds positions
     [i*L_local, (i+1)*L_local).
     """
-    sp = jax.lax.axis_size(axis_name)
+    # jax.lax.axis_size arrived after 0.4.x; psum of a literal 1 is
+    # the historical spelling and is constant-folded to the same
+    # static axis size, so either works as a loop bound.
+    sp = (jax.lax.axis_size(axis_name)
+          if hasattr(jax.lax, "axis_size")
+          else jax.lax.psum(1, axis_name))
     my_idx = jax.lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     scale = 1.0 / D ** 0.5

@@ -34,6 +34,20 @@ config.json schema:
                                    #   correctly but fall back to the
                                    #   slower XLA gather path (logged
                                    #   once at load)
+      "prefill_chunk_tokens": 512, # chunked prefill (paged only):
+                                   #   a COLD prompt longer than this
+                                   #   lands in block-aligned chunks
+                                   #   interleaved with decode waves,
+                                   #   so live streams stall one
+                                   #   chunk's device time instead of
+                                   #   the whole prompt's.  Size it so
+                                   #   one chunk's device time ~ one
+                                   #   decode wave (steps_per_call
+                                   #   decode steps).  Must be a
+                                   #   multiple of block_size.
+      "adaptive_depth": true,      # drop to depth-1 when every live
+                                   #   stream finishes within the
+                                   #   waves already in flight
       "mesh": {"tp": 2}            # within-replica tensor parallelism
     }
 
@@ -342,6 +356,8 @@ class GenerativeConfig:
                  logprob_topk: int = 5,
                  block_size: Optional[int] = None,
                  cache_blocks: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 adaptive_depth: bool = True,
                  mesh: Optional[Dict[str, int]] = None,
                  **_ignored):
         self.architecture = architecture
@@ -367,6 +383,12 @@ class GenerativeConfig:
         self.block_size = int(block_size) if block_size else None
         self.cache_blocks = (int(cache_blocks) if cache_blocks
                              else None)
+        # Chunked prefill (paged only): cold prompts longer than this
+        # land chunk-by-chunk between decode waves; adaptive depth
+        # stops speculative waves that could only decode garbage.
+        self.prefill_chunk_tokens = (int(prefill_chunk_tokens)
+                                     if prefill_chunk_tokens else None)
+        self.adaptive_depth = bool(adaptive_depth)
         self.mesh = mesh or {}
 
     @classmethod
@@ -455,6 +477,8 @@ class GenerativeModel(Model):
             logprob_topk=cfg.logprob_topk,
             block_size=cfg.block_size,
             cache_blocks=cfg.cache_blocks,
+            prefill_chunk_tokens=cfg.prefill_chunk_tokens,
+            adaptive_depth=cfg.adaptive_depth,
             mesh=mesh, name=self.name)
         if self.hbm is not None:
             # Generation residency = params + the slot cache pool.

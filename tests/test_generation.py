@@ -335,8 +335,10 @@ async def test_decode_failure_fails_all_inflight(tiny):
 
         eng._fetch_wave = boom
         with pytest.raises(InferenceError, match="generation failed"):
+            # Generous bound: this is a hang guard, not the assertion —
+            # first-call compiles under full-suite load have blown 10s.
             await asyncio.wait_for(
-                eng.complete([1, 2, 3], max_new_tokens=8), timeout=10)
+                eng.complete([1, 2, 3], max_new_tokens=8), timeout=60)
         # The engine recovers for new work once the fault clears.
         eng._fetch_wave = orig
         tokens, reason = await asyncio.wait_for(
@@ -784,6 +786,10 @@ async def test_pipeline_decode_wait_tracked(tiny):
         await eng.complete([1, 2], max_new_tokens=4)
         stats = eng.stats()
         assert stats["decode_wait_s"] >= 0.0
-        assert stats["decode_steps"] >= 4
+        # Budget 4 = 1 prefill token + 3 decode steps.  The adaptive
+        # governor suppresses the old 4th (speculative, provably
+        # garbage) dispatch — exactly 3 useful steps remain.
+        assert stats["decode_steps"] >= 3
+        assert stats["suppressed_waves"] >= 1
     finally:
         await eng.close()
