@@ -48,6 +48,38 @@ async def _serve(models, **server_kwargs):
     return server
 
 
+def _reset_timeline() -> None:
+    """Each generate config summarizes ITS OWN device timeline: the
+    engine event ring is process-wide, and a previous config's waves
+    leaking into this config's dispatch-gap stats would corrupt the
+    committed summary."""
+    from kfserving_tpu.observability.profiling import TIMELINE
+
+    TIMELINE.clear()
+
+
+def _timeline_summary() -> Dict[str, Any]:
+    """Device-timeline summary for the committed bench record
+    (dispatch-gap p50/p99, HOLD time, suppressed-wave ratio) — the
+    same events `GET /debug/profile` renders, so the BENCH JSON and
+    the Perfetto view can never disagree.  Scope: the WHOLE config run
+    since its `_reset_timeline()` (warmup and every interleaved A/B
+    arm included) — per-arm comparisons stay with the bench's own gap
+    measurements.  `ring_truncated` flags a wrapped ring: the counts
+    then cover only the newest `ring_capacity` events, and the record
+    says so instead of presenting a silent cap as full coverage."""
+    from kfserving_tpu.observability.profiling import (
+        TIMELINE,
+        summarize,
+    )
+
+    out = summarize(TIMELINE.snapshot())
+    out["events_recorded"] = TIMELINE.recorded
+    out["ring_capacity"] = TIMELINE.capacity
+    out["ring_truncated"] = TIMELINE.recorded > TIMELINE.capacity
+    return out
+
+
 async def _sse_measure(session, url, body, gaps, ttfts,
                        stop_after_first=False):
     """POST a generate_stream and fold per-event arrival times into
@@ -811,6 +843,7 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
         m.load()
         load_s[label] = round(time.perf_counter() - t0, 1)
         models[label] = m
+    _reset_timeline()
     server = await _serve(list(models.values()))
     base = f"http://127.0.0.1:{server.http_port}"
     prompt = ("the quick brown fox jumps over the lazy dog "
@@ -939,6 +972,7 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
             variants[2]]["slot_occupancy"]
         out["cache_bytes"] = models["k1"].engine_stats().get(
             "cache_bytes")
+        out["timeline"] = _timeline_summary()
         return out
     finally:
         await server.stop_async()
@@ -1033,6 +1067,7 @@ async def bench_generate_poisson(smoke: bool) -> Dict[str, Any]:
         n_req, max_tokens = 48, 48
         short_len, long_len = 30, 380  # 64-bucket vs 512-bucket
     arch_kwargs = cfg.pop("arch_kwargs")
+    _reset_timeline()
     model_dir = _write_jax_model_dir(
         "decoder_tiny" if smoke else "decoder", arch_kwargs, **cfg)
     model = GenerativeModel("gen", model_dir)
@@ -1157,6 +1192,7 @@ async def bench_generate_poisson(smoke: bool) -> Dict[str, Any]:
         p99 = med("chunk_gap_p99_ms")
         return {
             "requests": n_req, "max_tokens": max_tokens,
+            "timeline": _timeline_summary(),
             "arrival_rate_req_s": round(rate, 3),
             "repetitions": n_reps,
             "wall_s": round(sum(r["wall_s"] for r in rep_records), 2),
@@ -1224,6 +1260,7 @@ async def bench_generate_4k(smoke: bool) -> Dict[str, Any]:
     t0 = time.perf_counter()
     model.load()
     load_s = round(time.perf_counter() - t0, 1)
+    _reset_timeline()
     server = await _serve([model])
     base = f"http://127.0.0.1:{server.http_port}"
     system = "the quick brown fox jumps over the lazy dog. " * 80
@@ -1282,6 +1319,7 @@ async def bench_generate_4k(smoke: bool) -> Dict[str, Any]:
                        * (2 if not smoke else 4))
         return {
             "requests": n_req, "concurrency": conc,
+            "timeline": _timeline_summary(),
             "context": cfg["max_seq"],
             "block_size": cfg["block_size"],
             "pool_blocks": cfg["cache_blocks"],
@@ -1372,6 +1410,7 @@ async def bench_generate_cold4k(smoke: bool) -> Dict[str, Any]:
         m = GenerativeModel(f"cold-{label}", d)
         m.load()
         models[label] = m
+    _reset_timeline()
     server = await _serve(list(models.values()))
     base = f"http://127.0.0.1:{server.http_port}"
     rng = _random.Random(11)
@@ -1493,6 +1532,7 @@ async def bench_generate_cold4k(smoke: bool) -> Dict[str, Any]:
                 c["gap_p99_ms"] / mo["gap_p99_ms"], 3)
         out["gap_p99_ms"] = c["gap_p99_ms"]
         out["gap_p99_ms_monolithic"] = mo["gap_p99_ms"]
+        out["timeline"] = _timeline_summary()
         return out
     finally:
         await server.stop_async()
@@ -1541,6 +1581,7 @@ async def bench_generate_stream_wire(smoke: bool) -> Dict[str, Any]:
         "decoder_tiny" if smoke else "decoder", arch_kwargs, **cfg)
     model = GenerativeModel("wire", model_dir)
     model.load()
+    _reset_timeline()
     server = await _serve([model])
     server.grpc_server = GRPCServer(server.dataplane, port=0)
     await server.grpc_server.start()
@@ -1624,6 +1665,7 @@ async def bench_generate_stream_wire(smoke: bool) -> Dict[str, Any]:
             out["grpc_over_sse"] = round(
                 out["grpc"]["tokens_per_s"]
                 / out["sse"]["tokens_per_s"], 3)
+        out["timeline"] = _timeline_summary()
         return out
     finally:
         try:

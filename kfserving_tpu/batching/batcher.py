@@ -384,6 +384,14 @@ class DynamicBatcher:
                         {"bucket": str(key), "batch": n,
                          "fill": round(fill, 4)}))
             obs.batch_fill_ratio().labels(bucket=str(key)).observe(fill)
+            # Host-track timeline event: one marker per flushed batch
+            # (size, bucket, fill) — the batcher-fill lane of the
+            # /debug/profile trace.
+            from kfserving_tpu.observability.profiling import TIMELINE
+
+            TIMELINE.record("host", "batch.flush",
+                            attrs={"bucket": str(key), "batch": n,
+                                   "fill": round(fill, 4)})
         self._inflight += 1
         task = asyncio.ensure_future(self._run_batch(key, head))
         self._tasks.add(task)

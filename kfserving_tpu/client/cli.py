@@ -8,6 +8,7 @@
     python -m kfserving_tpu.client canary NAME --percent 20
     python -m kfserving_tpu.client promote NAME
     python -m kfserving_tpu.client rollouts
+    python -m kfserving_tpu.client profile --window 60 -o trace.json
 
 The reference splits this between kubectl (CRDs) and the SDK; the TPU
 build ships one client for both planes.
@@ -63,6 +64,19 @@ p_promote.add_argument("name")
 sub.add_parser("rollouts",
                help="progressive-delivery status (active rollouts, "
                     "rollbacks with evidence, quarantine)")
+
+p_profile = sub.add_parser(
+    "profile",
+    help="fetch the fleet device-time profile (engine event timeline "
+         "as Chrome-trace JSON) and save it for Perfetto")
+p_profile.add_argument("--window", type=float, default=None,
+                       help="trailing window in seconds (default: "
+                            "the whole event ring)")
+p_profile.add_argument("--replica", default=None,
+                       help="narrow to one replica host:port")
+p_profile.add_argument("-o", "--output", default="trace.json",
+                       help="file to write the trace to (load it at "
+                            "ui.perfetto.dev)")
 
 p_creds = sub.add_parser(
     "credentials",
@@ -129,6 +143,13 @@ async def _run(args) -> dict:
             return await c.promote(args.name, ns)
         if args.command == "rollouts":
             return await c.rollouts()
+        if args.command == "profile":
+            trace = await c.profile(window_s=args.window,
+                                    replica=args.replica)
+            with open(args.output, "w") as f:
+                json.dump(trace, f)
+            return {"saved": args.output,
+                    "events": len(trace.get("traceEvents", []))}
         if args.command == "credentials":
             if args.creds_command == "set-gcs":
                 name = await c.set_gcs_credentials(

@@ -178,6 +178,23 @@ class KFServingClient:
         return await self._request("GET",
                                    f"{self._ingress()}/v2/rollouts")
 
+    async def profile(self, window_s: Optional[float] = None,
+                      replica: Optional[str] = None,
+                      fmt: str = "trace_json") -> Dict[str, Any]:
+        """Fetch the fleet's device-time profile from the ingress
+        router: the engine event timeline (decode waves, prefill
+        chunks, preemptions, HOLD windows) as Chrome-trace JSON ready
+        for Perfetto (fmt="events" returns raw per-replica event
+        lists instead)."""
+        params = [f"format={fmt}"]
+        if window_s is not None:
+            params.append(f"window_s={float(window_s)}")
+        if replica:
+            params.append(f"replica={replica}")
+        qs = "&".join(params)
+        return await self._request(
+            "GET", f"{self._ingress()}/debug/profile?{qs}")
+
     # -- readiness (reference wait_isvc_ready, kf_serving_client.py:232+) ---
     async def wait_isvc_ready(self, name: str, namespace: str = "default",
                               timeout_seconds: float = 120.0,

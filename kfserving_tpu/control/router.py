@@ -132,6 +132,11 @@ class IngressRouter:
         r.add("GET", "/v2/health/slo", self._slo_health)
         r.add("GET", "/debug/flightrecorder",
               self._debug_flightrecorder)
+        # Device-time profiling federation (ISSUE 6): every replica's
+        # engine event timeline merged into ONE Chrome trace, each
+        # replica its own Perfetto process group (?replica= narrows to
+        # one host; window_s/format pass through).
+        r.add("GET", "/debug/profile", self._debug_profile)
         # Progressive-delivery status (ISSUE 4): active rollouts,
         # recent promotions/rollbacks with pinned evidence, and the
         # quarantine ledger.
@@ -637,6 +642,38 @@ class IngressRouter:
                     "quarantine":
                         self.controller.reconciler.quarantine_report()}
         return Response(json.dumps(body).encode())
+
+    async def _debug_profile(self, req: Request) -> Response:
+        """Fleet device-time profile: per-replica engine timelines as
+        one merged Chrome trace (each replica re-pid'd into its own
+        Perfetto process group), or per-replica raw event lists under
+        ?format=events."""
+        from kfserving_tpu.observability.profiling import merge_traces
+
+        window = req.query.get("window_s")
+        try:
+            float(window) if window else None
+        except ValueError:
+            return Response(
+                b'{"error": "window_s must be a number"}', status=400)
+        fmt = req.query.get("format", "trace_json")
+        if fmt not in ("trace_json", "events"):
+            return Response(
+                b'{"error": "format must be trace_json or events"}',
+                status=400)
+        only = req.query.get("replica")
+        hosts = [only] if only else self._replica_hosts()
+        qs = f"?format={fmt}"
+        if window:
+            qs += f"&window_s={window}"
+        scraped = await self._scrape_json_all(hosts,
+                                              f"/debug/profile{qs}")
+        if fmt == "events":
+            return Response(json.dumps({
+                "replicas": {host: body for host, body in scraped},
+            }).encode())
+        return Response(json.dumps(merge_traces(
+            [(host, body) for host, body in scraped])).encode())
 
     async def _debug_flightrecorder(self, req: Request) -> Response:
         """Federated flight-recorder dump: each replica's entries and

@@ -41,6 +41,12 @@ def enable(cache_dir: Optional[str] = None,
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       min_compile_time_secs)
     _active_dir = cache_dir
+    # Marker on the engine event timeline: compile-miss slices after
+    # this point are persistent-cache loads, not fresh XLA compiles.
+    from kfserving_tpu.observability.profiling import TIMELINE
+
+    TIMELINE.record("host", "compile_cache.enabled",
+                    attrs={"dir": cache_dir})
     from kfserving_tpu.observability import REGISTRY
 
     REGISTRY.gauge(

@@ -69,6 +69,7 @@ from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 import numpy as np
 
 from kfserving_tpu.observability import metrics as obs
+from kfserving_tpu.observability.profiling import TIMELINE
 from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
 
 logger = logging.getLogger("kfserving_tpu.engine.generator")
@@ -615,6 +616,40 @@ class GenerationEngine:
         self._decode_wait_s = 0.0     # host blocked in decode fetches
         self._prefill_wait_s = 0.0    # host blocked in prefill fetches
         self._prefill_device_s = 0.0
+        # -- roofline accounting (promoted to registry gauges by
+        # observability/profiling/roofline.py at /metrics scrape) ------
+        # Analytic FLOP model: 2*P matmul FLOPs per token plus
+        # attention's 4*layers*heads*head_dim per resident context
+        # position (QK^T and AV, 2 FLOPs per MAC each).  Counted over
+        # LIVE slots only — garbage waves burn device time without
+        # adding useful FLOPs, so decode_mfu is a goodput-weighted
+        # floor on chip utilization, matching ROOFLINE.md's framing.
+        self._n_params = int(sum(
+            int(np.prod(x.shape))
+            for x in self._jax.tree.leaves(variables)))
+        self._param_read_bytes = self.param_bytes()
+        self._flops_matmul_per_token = 2.0 * self._n_params
+        self._attn_flops_coeff = (4.0 * n_layers * cfg.num_heads
+                                  * cfg.head_dim)
+        self._kv_bytes_per_token = (2 * n_layers * cfg.num_heads
+                                    * cfg.head_dim
+                                    * np.dtype(cache_dtype).itemsize)
+        from kfserving_tpu.engine.jax_engine import device_peak_flops
+        from kfserving_tpu.observability.profiling.roofline import (
+            device_peak_hbm_bw,
+        )
+
+        self._peak_flops = device_peak_flops()
+        self._peak_hbm_bw = device_peak_hbm_bw()
+        self._decode_flops = 0.0
+        self._prefill_flops = 0.0
+        self._decode_hbm_bytes = 0.0  # params + resident KV reads
+        # Per-prefill-bucket token padding: {bucket: [real, padded]}
+        # (updated on the enqueue thread, read by stats(); plain dict
+        # ops under the GIL).
+        self._prefill_bucket_tokens: Dict[int, List[float]] = {}
+        # Growth-HOLD window tracking for the event timeline.
+        self._hold_since: Optional[float] = None
 
     # -- public API --------------------------------------------------------
     def cache_bytes(self) -> int:
@@ -826,6 +861,40 @@ class GenerationEngine:
             "prefill_wait_s": round(self._prefill_wait_s, 4),
             "prefill_device_s": round(self._prefill_device_s, 4),
         }
+        # -- roofline block (promoted to registry gauges by
+        # observability/profiling/roofline.py; keys must stay in sync
+        # with its consumed-key tables) --------------------------------
+        if self._decode_flops > 0 and self._decode_device_s > 0:
+            achieved = self._decode_flops / self._decode_device_s
+            out["achieved_decode_tflops"] = round(achieved / 1e12, 6)
+            if self._peak_flops:
+                out["decode_mfu"] = round(
+                    achieved / self._peak_flops, 6)
+        if self._prefill_flops > 0 and self._prefill_device_s > 0:
+            achieved = self._prefill_flops / self._prefill_device_s
+            out["achieved_prefill_tflops"] = round(achieved / 1e12, 6)
+            if self._peak_flops:
+                out["prefill_mfu"] = round(
+                    achieved / self._peak_flops, 6)
+        if self.tokens_generated + self._wasted_token_steps > 0:
+            out["goodput_ratio"] = round(
+                self.tokens_generated
+                / (self.tokens_generated + self._wasted_token_steps),
+                4)
+        if self._decode_hbm_bytes > 0 and self._decode_device_s > 0:
+            rate = self._decode_hbm_bytes / self._decode_device_s
+            out["decode_hbm_gb_s"] = round(rate / 1e9, 3)
+            if self._peak_hbm_bw:
+                out["hbm_bw_util"] = round(
+                    min(1.0, rate / self._peak_hbm_bw), 6)
+        if self._prefill_bucket_tokens:
+            # .copy() is atomic under the GIL; iterating the live dict
+            # could race an enqueue-thread insert of a new bucket.
+            out["prefill_bucket_pad_waste"] = {
+                f"s{b}": round(1.0 - real / padded, 4)
+                for b, (real, padded)
+                in sorted(self._prefill_bucket_tokens.copy().items())
+                if padded > 0}
         if self.block_size is not None:
             with self._block_lock:
                 out["paged"] = {
@@ -1095,6 +1164,21 @@ class GenerationEngine:
             snap = self._tables.copy()
         return jnp.asarray(snap)
 
+    def _record_pool_sample(self) -> None:
+        """Occupancy counter sample for the event timeline (rendered
+        as Chrome counter tracks).  Lock-free reads: len() under the
+        GIL is atomic and a stale-by-one sample is fine for a
+        telemetry series."""
+        values = {
+            "active_slots": sum(1 for s in self._slots
+                                if s is not None),
+            "pending": len(self._pending),
+        }
+        if self.block_size is not None:
+            values["free_blocks"] = len(self._free_blocks)
+            values["reclaimable_blocks"] = len(self._reclaimable)
+        TIMELINE.counter("pool", values)
+
     # -- scheduler ---------------------------------------------------------
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
@@ -1125,6 +1209,21 @@ class GenerationEngine:
 
     def _bucket_for(self, n: int) -> int:
         return next(b for b in self.prefill_buckets if b >= n)
+
+    def _set_hold(self, held: bool) -> None:
+        """Track growth-starvation HOLD transitions: the window from
+        the first held iteration to the release is one host-track
+        timeline span — the stall a pinned p99 outlier (or a bench
+        summary) can attribute instead of inferring."""
+        self._growth_starved = held
+        if held:
+            if self._hold_since is None:
+                self._hold_since = time.time()
+        elif self._hold_since is not None:
+            now = time.time()
+            TIMELINE.record("host", "hold", dur_s=now - self._hold_since,
+                            t_end=now)
+            self._hold_since = None
 
     def _take_prefill_group(self):
         """Pop the front run of pending requests that share a prefill
@@ -1296,6 +1395,13 @@ class GenerationEngine:
         start = idx * C
         end = min(start + C, n)
         width = end - start
+        # Roofline accounting: this chunk's queries attend the whole
+        # resident prefix (positions start..end-1 attend up to their
+        # own index) — the same triangular term the monolithic path
+        # accrues, sliced per chunk.
+        self._prefill_flops += (
+            self._flops_matmul_per_token * width
+            + self._attn_flops_coeff * width * (start + end) / 2.0)
         ids = np.zeros((1, C), np.int32)
         ids[0, :width] = req.prompt_ids[start:end]
         # Padding queries of a partial final chunk park on the same
@@ -1498,7 +1604,7 @@ class GenerationEngine:
                 # the only other reset — an await-free spin that
                 # starves the event loop with the preempted request
                 # parked in pending forever.
-                self._growth_starved = False
+                self._set_hold(False)
                 if not self._pending:
                     self._wakeup.clear()
                     if admitted:
@@ -1567,6 +1673,10 @@ class GenerationEngine:
                         self._pending.appendleft(s.req)
                         self.preemptions += 1
                         preempted_prefill = True
+                        TIMELINE.record(
+                            "host", "preempt",
+                            trace_id=s.req.trace_id, slot=i,
+                            attrs={"phase": "prefill"})
                 if preempted_prefill or self._deferred_frees:
                     # Blocks are already on their way back (a yield
                     # above, or frees maturing through the zombie-
@@ -1578,7 +1688,7 @@ class GenerationEngine:
                     # first cut of this path had).
                     held = True
                     failed = []
-            self._growth_starved = held
+            self._set_hold(held)
             for i in failed:
                 s = self._slots[i]
                 if s is None:
@@ -1605,6 +1715,9 @@ class GenerationEngine:
                 # before new arrivals take its blocks.
                 self._pending.appendleft(s.req)
                 self.preemptions += 1
+                TIMELINE.record("host", "preempt",
+                                trace_id=s.req.trace_id, slot=i,
+                                attrs={"phase": "decode"})
             # Keep the device pipeline_depth decode waves deep: wave
             # N+1's feed tokens are wave N's device outputs — no host
             # round trip sits between waves, so the fetch of wave N
@@ -1630,6 +1743,7 @@ class GenerationEngine:
                     # gets the full configured depth.
                     self.suppressed_waves += 1
                     obs.generator_suppressed_waves_total().inc()
+                    TIMELINE.record("host", "wave.suppressed")
                     break
                 kind_, toks_h, lp_h, snap, t0_ = \
                     await loop.run_in_executor(
@@ -1686,9 +1800,28 @@ class GenerationEngine:
             now = time.perf_counter()
             busy = now - max(t0, self._last_fetch_done)
             self._last_fetch_done = now
+            # Device-path timeline: one device-track slice per fetched
+            # dispatch (the dispatch->fetch busy interval — the same
+            # overlap-corrected span the device_s stats accumulate, so
+            # the Perfetto view and the committed stats agree), plus
+            # per-slot slices carrying each stream's trace id and a
+            # pool-occupancy counter sample.
+            wall = time.time()
+            dev_dur = max(0.0, busy)
             if kind == "decode":
                 self._decode_device_s += busy
                 self._decode_wait_s += wait_s
+                TIMELINE.record(
+                    "device", "decode.wave", dur_s=dev_dur, t_end=wall,
+                    attrs={"steps": self.steps_per_call,
+                           "wait_ms": round(wait_s * 1000.0, 3)})
+                for slot_i, s in enumerate(meta):
+                    if s is not None and self._slots[slot_i] is s:
+                        TIMELINE.record("slot", "decode",
+                                        dur_s=dev_dur, t_end=wall,
+                                        trace_id=s.req.trace_id,
+                                        slot=slot_i)
+                self._record_pool_sample()
                 self._distribute(fetched, lp, meta)
             elif kind == "chunk":
                 self._prefill_device_s += busy
@@ -1699,6 +1832,14 @@ class GenerationEngine:
                 obs.generator_prefill_chunk_stall_ms().observe(
                     busy * 1000.0)
                 slot, act, _idx, final = meta
+                TIMELINE.record(
+                    "device", "prefill.chunk", dur_s=dev_dur,
+                    t_end=wall, trace_id=act.req.trace_id, slot=slot,
+                    attrs={"chunk": _idx, "final": final})
+                TIMELINE.record("slot", "prefill.chunk",
+                                dur_s=dev_dur, t_end=wall,
+                                trace_id=act.req.trace_id, slot=slot,
+                                attrs={"chunk": _idx})
                 act.chunks_inflight -= 1
                 if final and self._slots[slot] is act:
                     # The final chunk carries the stream's first
@@ -1716,6 +1857,15 @@ class GenerationEngine:
             else:
                 self._prefill_device_s += busy
                 self._prefill_wait_s += wait_s
+                TIMELINE.record(
+                    "device", "prefill.bucket", dur_s=dev_dur,
+                    t_end=wall, attrs={"batch": len(meta)})
+                for slot_i, act in meta:
+                    if act is not None and self._slots[slot_i] is act:
+                        TIMELINE.record("slot", "prefill",
+                                        dur_s=dev_dur, t_end=wall,
+                                        trace_id=act.req.trace_id,
+                                        slot=slot_i)
                 self._finish_prefill(fetched, lp, meta)
             self._process_deferred_frees()
 
@@ -1810,6 +1960,19 @@ class GenerationEngine:
             seeds[i] = req.seed
             slot_arr[i] = slot
             want_lp = want_lp or req.logprobs > 0
+        # Roofline accounting: real-token FLOPs (2P matmul + causal
+        # attention's triangular sum) and the bucket's token padding —
+        # padded rows/positions burn device time without FLOPs that
+        # count, which is exactly what the padding-waste gauge shows.
+        for req in group:
+            n = int(req.prompt_ids.size)
+            self._prefill_flops += (
+                self._flops_matmul_per_token * n
+                + self._attn_flops_coeff * n * (n + 1) / 2.0)
+        rec = self._prefill_bucket_tokens.setdefault(bucket,
+                                                     [0.0, 0.0])
+        rec[0] += sum(int(r.prompt_ids.size) for r in group)
+        rec[1] += b_bucket * bucket
         firsts, new_caches, chosen_lp, top_ids, top_lps = \
             self._prefill(
                 self.variables, jnp.asarray(ids), jnp.asarray(lengths),
@@ -1925,6 +2088,7 @@ class GenerationEngine:
         K-1 steps of waste."""
         k = tokens.shape[1]
         self._token_steps += k
+        resident_tokens = 0
         for i, s in enumerate(snapshot):
             if s is None:
                 continue
@@ -1934,6 +2098,14 @@ class GenerationEngine:
                 self._wasted_token_steps += k
                 continue
             self._occupied_slot_steps += k
+            # Roofline accounting over LIVE rows: matmul FLOPs per fed
+            # token plus attention over the slot's resident context
+            # (length at wave start — within a K-step wave the drift
+            # is < K positions, noise against the ±10% stats bar).
+            self._decode_flops += k * (self._flops_matmul_per_token
+                                       + self._attn_flops_coeff
+                                       * s.length)
+            resident_tokens += s.length
             n_lp = s.req.logprobs
             for j in range(k):
                 if self._slots[i] is not s:
@@ -1950,6 +2122,13 @@ class GenerationEngine:
                             zip(lp[1][i, j][:n_lp],
                                 lp[2][i, j][:n_lp])])
                 self._emit(i, int(tokens[i, j]), rec)
+        if resident_tokens:
+            # Decode reads every live slot's resident KV plus the full
+            # parameter set once per token step — the bandwidth-bound
+            # working set the HBM-utilization gauge divides by peak.
+            self._decode_hbm_bytes += k * (
+                self._param_read_bytes
+                + resident_tokens * self._kv_bytes_per_token)
 
 
 def _pow2_buckets(max_seq: int) -> List[int]:

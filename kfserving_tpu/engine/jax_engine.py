@@ -28,6 +28,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from kfserving_tpu.engine.buckets import BucketPolicy
+from kfserving_tpu.observability.profiling import TIMELINE
 
 logger = logging.getLogger("kfserving_tpu.engine")
 
@@ -269,11 +270,21 @@ class JaxEngine:
                               ("fetch", (t3 - t2) * 1e3)):
                 stage_hist.labels(stage=stage).observe(
                     ms, trace_id=trace_id)
+            # Device-dispatch slice on the engine event timeline: the
+            # dispatch -> host-visible-result span (pure device time
+            # only under blocking_stats; otherwise it includes the
+            # runtime round trip — same caveat as device_ms).
+            TIMELINE.record("device", "engine.execute",
+                            dur_s=t3 - t1, trace_id=trace_id,
+                            attrs={"bucket": int(bucket), "batch": n})
             with self._stats_lock:
                 if flops_key not in self._compiled_shapes:
                     self._compiled_shapes.add(flops_key)
                     obs.compile_cache_events().labels(
                         outcome="miss").inc()
+                    TIMELINE.record(
+                        "host", "compile.miss", trace_id=trace_id,
+                        attrs={"shape": str(flops_key)})
                 else:
                     obs.compile_cache_events().labels(
                         outcome="hit").inc()

@@ -23,6 +23,12 @@ metrics.  This package is the shared substrate:
   monitors, the per-model SLO burn-rate engine behind
   `GET /v2/health/slo`, and the flight recorder behind
   `GET /debug/flightrecorder`.
+- `profiling` — the *device*-path counterpart of the request-path
+  spans: the engine event timeline ring (waves, chunks, preemptions,
+  HOLD windows, device dispatch spans), its Chrome-trace/Perfetto
+  export behind `GET /debug/profile`, and the live roofline gauges
+  (`kfserving_tpu_engine_mfu`, padding-waste / goodput /
+  HBM-bandwidth ratios).
 
 Import discipline: this package imports nothing from `server/`,
 `control/`, `engine/`, or `reliability/` — those layers import *it*,

@@ -126,6 +126,50 @@ def generator_suppressed_waves_total():
         "the waves already in flight")
 
 
+# -- engine roofline (fed by observability/profiling/roofline.py at
+# /metrics scrape time from the engines' stats dicts) -------------------
+def engine_mfu():
+    return REGISTRY.gauge(
+        "kfserving_tpu_engine_mfu",
+        "Model FLOP utilization: achieved FLOP/s over the chip's "
+        "peak, per phase (phase=infer — the bucketed JaxEngine path; "
+        "decode|prefill — the generator's device spans).  A floor on "
+        "true utilization: device seconds include the runtime round "
+        "trip in non-blocking mode")
+
+
+def engine_achieved_tflops():
+    return REGISTRY.gauge(
+        "kfserving_tpu_engine_achieved_tflops",
+        "Achieved dense-compute TFLOP/s per engine phase (the MFU "
+        "numerator, absolute)")
+
+
+def engine_padding_waste_ratio():
+    return REGISTRY.gauge(
+        "kfserving_tpu_engine_padding_waste_ratio",
+        "Fraction of dispatched batch/sequence slots that were "
+        "bucket padding, per compiled bucket (0 = every slot carried "
+        "a real token/row)")
+
+
+def engine_goodput_ratio():
+    return REGISTRY.gauge(
+        "kfserving_tpu_engine_goodput_ratio",
+        "Useful emitted tokens over useful + garbage token steps "
+        "(speculative-wave decode past a finish/cancel) — the decode "
+        "pipeline's goodput split")
+
+
+def engine_hbm_bw_util_ratio():
+    return REGISTRY.gauge(
+        "kfserving_tpu_engine_hbm_bw_util_ratio",
+        "Decode HBM read-bandwidth utilization estimated from the "
+        "params + resident KV-cache working set per token step over "
+        "the chip's peak HBM bandwidth (decode is bandwidth-bound: "
+        "this is its roofline axis)")
+
+
 # -- reliability --------------------------------------------------------
 def breaker_state():
     return REGISTRY.gauge(

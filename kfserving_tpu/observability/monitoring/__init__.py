@@ -147,6 +147,13 @@ class Monitoring:
             # (best-effort — spans may have rotated out).
             if pin:
                 entry["timeline"] = self._timeline(trace_id)
+                # Device-path evidence (ISSUE 6): the engine events
+                # overlapping this request's span — a p99-outlier pin
+                # shows WHICH decode wave / prefill chunk / preemption
+                # / HOLD window produced the tail, not just that the
+                # engine stage was slow.
+                entry["engine_events"] = self._engine_events(
+                    latency_ms)
             self.flight_recorder.record(entry, pin=pin)
         except Exception:
             logger.exception("flight-recorder capture failed")
@@ -185,6 +192,22 @@ class Monitoring:
             return ("slo_breach" if self.slo.alerting(model)
                     else "slo_violation")
         return None
+
+    @staticmethod
+    def _engine_events(latency_ms: float,
+                       limit: int = 64) -> List[Dict[str, Any]]:
+        """Engine timeline events overlapping the just-finished
+        request's wall-clock span (+50 ms of slack on the open end:
+        the pin evaluates microseconds after the request closed, and
+        the wave that delivered its last token may be stamped a hair
+        later)."""
+        import time as _time
+
+        from kfserving_tpu.observability.profiling import TIMELINE
+
+        now = _time.time()
+        return TIMELINE.window(now - latency_ms / 1000.0 - 0.05,
+                               now + 0.05, limit=limit)
 
     @staticmethod
     def _timeline(trace_id: Optional[str]) -> List[Dict[str, Any]]:

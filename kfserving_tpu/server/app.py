@@ -264,6 +264,13 @@ class ModelServer:
         r.add("GET", "/debug/traces", self._traces)
         r.add("POST", "/debug/profiler/start", self._profiler_start)
         r.add("POST", "/debug/profiler/stop", self._profiler_stop)
+        # Device-time observability (ISSUE 6): the engine event
+        # timeline as a Chrome-trace/Perfetto download, and a bounded
+        # on-demand jax.profiler capture window for TPU-level
+        # drill-down (start/sleep/stop in one call — the manual
+        # start/stop pair above stays for long captures).
+        r.add("GET", "/debug/profile", self._profile)
+        r.add("POST", "/debug/profile/capture", self._profile_capture)
 
     # -- handlers ----------------------------------------------------------
     async def _live(self, req: Request) -> Response:
@@ -659,12 +666,24 @@ class ModelServer:
 
     async def _metrics(self, req: Request) -> Response:
         # Engine gauges (device/host breakdown, MFU) refresh at scrape.
+        from kfserving_tpu.observability.profiling import roofline
+
         for model in self.repository.get_models():
             engine_stats = getattr(model, "engine_stats", None)
             if engine_stats is None:
                 continue
             try:
-                for key, value in engine_stats().items():
+                stats = engine_stats()
+                # Roofline families (MFU, padding-waste, goodput, HBM
+                # bandwidth) publish into the process registry, where
+                # the router federates them under a `replica` label;
+                # consumed keys skip the generic per-key export below
+                # so the merged exposition declares each family
+                # exactly once.
+                consumed = roofline.publish_gauges(model.name, stats)
+                for key, value in stats.items():
+                    if key in consumed:
+                        continue
                     if isinstance(value, dict):
                         # Per-bucket stats (bucket_hits/..._pad_waste)
                         # export as labeled series.
@@ -725,6 +744,69 @@ class ModelServer:
             return _json({"error": "limit must be an integer"},
                          status=400)
         return _json({"spans": tracer.spans(trace_id, limit)})
+
+    async def _profile(self, req: Request) -> Response:
+        """The engine event timeline (decode waves, prefill chunks,
+        preemptions, HOLD windows, device dispatch spans) rendered as
+        Chrome-trace JSON — loadable directly in Perfetto.
+        ?window_s= trims to the trailing window; ?format=events
+        returns the raw event dicts instead."""
+        from kfserving_tpu.observability.profiling import (
+            TIMELINE,
+            to_chrome_trace,
+        )
+
+        window = req.query.get("window_s")
+        try:
+            window_s = float(window) if window else None
+        except ValueError:
+            return _json({"error": "window_s must be a number"},
+                         status=400)
+        fmt = req.query.get("format", "trace_json")
+        if fmt not in ("trace_json", "events"):
+            return _json(
+                {"error": "format must be trace_json or events"},
+                status=400)
+        events = TIMELINE.snapshot(window_s)
+        if fmt == "events":
+            return _json({
+                "events": [TIMELINE.event_dict(e) for e in events],
+                "recorded": TIMELINE.recorded,
+            })
+        return _json(to_chrome_trace(events))
+
+    async def _profile_capture(self, req: Request) -> Response:
+        """Bounded on-demand jax.profiler capture: start a TPU-level
+        trace, hold it for duration_s (clamped to 60 s), stop, return
+        the log dir.  409 while another capture (or a manual
+        /debug/profiler/start) is active."""
+        from kfserving_tpu.tracing import profiler
+
+        try:
+            body = json.loads(req.body) if req.body else {}
+        except ValueError:
+            body = {}
+        try:
+            duration_s = float(body.get("duration_s", 2.0))
+        except (TypeError, ValueError):
+            return _json({"error": "duration_s must be a number"},
+                         status=400)
+        duration_s = max(0.1, min(duration_s, 60.0))
+        log_dir = body.get("log_dir", "/tmp/kfs-profile")
+        try:
+            started = profiler.start(log_dir)
+        except Exception as e:
+            return _json({"error": f"profiler start failed: {e}"},
+                         status=500)
+        if not started:
+            return _json({"error": "profiler already active",
+                          "log_dir": profiler.active_dir}, status=409)
+        try:
+            await asyncio.sleep(duration_s)
+        finally:
+            profiler.stop()
+        return _json({"captured": True, "log_dir": log_dir,
+                      "duration_s": duration_s})
 
     async def _profiler_start(self, req: Request) -> Response:
         from kfserving_tpu.tracing import profiler

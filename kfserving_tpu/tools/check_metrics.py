@@ -11,6 +11,10 @@ before it ships:
 - time/size-valued families carry a unit suffix (`_ms`, `_seconds`,
   `_bytes`, `_ratio`, `_per_second`) — and never a spelled-out
   `_milliseconds`;
+- `_ratio`-suffixed gauges are bounded: every exported sample must sit
+  in [0, 1] (a padding-waste or goodput "ratio" above 1 means the
+  accounting is broken, and downstream alert math silently trusts the
+  unit the suffix declares);
 - no family is declared twice in one exposition (strict OpenMetrics
   parsers abort the whole scrape on a re-declared family);
 - no family is registered under two kinds (the registry raises, but a
@@ -82,6 +86,21 @@ def lint_exposition(text: str) -> List[str]:
         if not sample.startswith(PREFIX):
             problems.append(
                 f"sample {sample!r}: missing the {PREFIX!r} prefix")
+        # Gauge-unit rule: a `_ratio` gauge promises [0, 1] — check
+        # every sample value (gauge lines are `name[{labels}] value`;
+        # gauges never carry exemplar suffixes).
+        if declared.get(sample) == "gauge" \
+                and sample.endswith("_ratio"):
+            try:
+                value = float(line.rsplit(" ", 1)[1])
+            except (IndexError, ValueError):
+                problems.append(
+                    f"{sample}: unparseable gauge sample {line!r}")
+                continue
+            if not 0.0 <= value <= 1.0:  # NaN fails both bounds
+                problems.append(
+                    f"{sample}: _ratio gauge sample {value} outside "
+                    f"[0, 1]")
     problems += lint_families(declared)
     return problems
 
@@ -113,6 +132,18 @@ async def smoke() -> List[str]:
     req.path_params = {"name": "metrics-probe"}
     resp = await server._inference(req, "predict",
                                    server.dataplane.infer)
+    # Populate the roofline families with representative values so the
+    # lint always covers them (the probe model has no engine; a real
+    # replica publishes these from its engine stats at scrape time).
+    from kfserving_tpu.observability.profiling import roofline
+
+    roofline.publish_gauges("metrics-probe", {
+        "mfu": 0.42, "decode_mfu": 0.011, "prefill_mfu": 0.2,
+        "achieved_tflops": 82.7, "achieved_decode_tflops": 2.1,
+        "goodput_ratio": 0.97, "hbm_bw_util": 0.63,
+        "bucket_pad_waste": {"b8": 0.25, "b8s128": 0.5},
+        "prefill_bucket_pad_waste": {"s64": 0.11},
+    })
     problems: List[str] = []
     if resp.status != 200:
         problems.append(
