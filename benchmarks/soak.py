@@ -6,16 +6,23 @@ process recycling as the mitigation without building it.  This drives
 the full claimed stack end-to-end:
 
   load gen -> IngressRouter -> subprocess replica (owns the TPU) ->
-  RecyclePolicy(max_rss_mb, overlap=False) watchdog -> drain ->
-  respawn -> router scale-from-zero buffering carries traffic across
-  the swap window.
+  RecyclePolicy watchdog -> warm-standby swap (spawn -> mmap-param
+  activate while the incumbent serves -> drain) -> router announced-
+  swap holds carry any residual gap.
 
-Success = RSS stays bounded by the policy across >=1 recycle and the
-client sees no failed requests (requests during a swap are buffered by
-the router's activator path, reference activator semantics).
+ISSUE 10 made the warm standby the DEFAULT lifecycle: the successor
+activates off the mmap param cache while the incumbent still serves,
+so the swap window is 0 by construction; `--exclusive` measures the
+exclusive-device ordering (drain -> activate inside an announced
+window the router bridges by holding, not shedding).
+
+Success = RSS stays bounded by the policy across >=1 recycle, client
+sees no failed requests, and the committed swap_breakdown shows where
+every swap's milliseconds went (standby_spawn / activate / drain, plus
+the successor's own boot marks — params_mmap on a cache hit).
 
 Usage: python -m benchmarks.soak [--minutes 6] [--qps 60]
-       [--max-rss-mb 4096] [--smoke]
+       [--max-rss-mb 4096] [--exclusive] [--smoke]
 Writes SOAK.json.
 """
 
@@ -26,14 +33,31 @@ import os
 import tempfile
 import time
 
-import numpy as np
+
+def _registry_series(substr: str) -> dict:
+    """Samples of every registry series whose name contains `substr`
+    (the soak runs router + orchestrator in-process, so their counters
+    are readable without a scrape)."""
+    from kfserving_tpu.observability import REGISTRY
+
+    out = {}
+    for line in REGISTRY.render_lines():
+        if line.startswith("#") or substr not in line:
+            continue
+        try:
+            series, value = line.rsplit(" ", 1)
+            out[series] = float(value)
+        except ValueError:
+            continue
+    return out
 
 
 async def run_soak(minutes: float, qps: float, max_rss_mb: float,
                    smoke: bool, max_requests: int = None,
                    buffer_deadline_s: float = 15.0,
-                   overlap: bool = True) -> dict:
+                   exclusive: bool = False) -> dict:
     import aiohttp
+    import numpy as np
 
     from kfserving_tpu.control.controller import Controller
     from kfserving_tpu.control.router import IngressRouter
@@ -72,7 +96,7 @@ async def run_soak(minutes: float, qps: float, max_rss_mb: float,
         recycle=RecyclePolicy(max_rss_mb=max_rss_mb,
                               max_requests=max_requests,
                               check_interval_s=2.0 if smoke else 5.0,
-                              overlap=overlap,
+                              exclusive_device=exclusive,
                               min_age_s=10.0 if smoke else 30.0))
     controller = Controller(orch)
     router = IngressRouter(controller, upstream_timeout_s=180.0,
@@ -145,19 +169,32 @@ async def run_soak(minutes: float, qps: float, max_rss_mb: float,
         lat.sort()
         from benchmarks.harness import percentile
 
+        windows_ms = sorted(w * 1000.0 for w in orch.swap_windows_s)
         return {
             "minutes": minutes, "qps": qps, "max_rss_mb": max_rss_mb,
             "max_requests": max_requests,
             "buffer_deadline_s": buffer_deadline_s,
-            "overlap": overlap,
+            "mode": "exclusive_standby" if exclusive
+                    else "warm_standby",
             "requests": results["ok"] + results["fail"],
             "ok": results["ok"], "fail": results["fail"],
             "statuses": results["statuses"],
             "recycles": orch.recycle_count,
-            # Chip-release -> successor-serving gap per swap (the
-            # standby fast-path's figure of merit; r3 was ~22-30s).
+            "promotions": orch.promotions,
+            "swap_failures": orch.swap_failures,
+            # Unavailability gap per swap: warm-standby swaps are 0 by
+            # construction (successor entered rotation before the
+            # incumbent drained); the exclusive mode measures
+            # chip-release -> successor-serving.
             "swap_windows_s": list(orch.swap_windows_s),
+            "swap_window_p99_ms": (round(percentile(windows_ms, 0.99),
+                                         1) if windows_ms else None),
             "swap_breakdown": list(orch.swap_breakdown),
+            # Announced-swap holds the router absorbed instead of
+            # shedding (and the param-cache outcomes of every replica
+            # boot this run spawned, scraped from the successors).
+            "router_swap_holds": _registry_series(
+                "router_swap_held_total"),
             "p50_ms": round(percentile(lat, 0.5), 1) if lat else None,
             "p99_ms": round(percentile(lat, 0.99), 1) if lat else None,
             "max_ms": round(lat[-1], 1) if lat else None,
@@ -186,15 +223,17 @@ def main():
                     help="recycle every N served requests (deterministic "
                          ">=2 swaps per soak)")
     ap.add_argument("--buffer-deadline-s", type=float, default=15.0)
-    ap.add_argument("--no-overlap", action="store_true",
-                    help="exclusive-device mode: standby fast-swap "
-                         "instead of the zero-gap overlapped swap")
+    ap.add_argument("--exclusive", action="store_true",
+                    help="exclusive-device ordering: drain -> activate "
+                         "inside an announced window the router holds "
+                         "across (default: warm standby — activate "
+                         "BEFORE drain, zero-gap)")
     ap.add_argument("--smoke", action="store_true")
     args = ap.parse_args()
     out = asyncio.run(run_soak(args.minutes, args.qps, args.max_rss_mb,
                                args.smoke, args.max_requests,
                                args.buffer_deadline_s,
-                               overlap=not args.no_overlap))
+                               exclusive=args.exclusive))
     with open("SOAK.json", "w") as f:
         json.dump(out, f, indent=2)
     print(json.dumps(out))

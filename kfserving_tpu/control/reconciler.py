@@ -325,11 +325,20 @@ class InferenceServiceReconciler:
         by_rev: Dict[str, List] = {}
         for r in current:
             by_rev.setdefault(r.revision, []).append(r)
-        # scale down / remove dead revisions
+        # scale down / remove dead revisions — including any armed
+        # warm standby of a revision that stops serving entirely (a
+        # retired canary's standby surviving to be crash-promoted
+        # later would resurrect the exact revision this scale-down
+        # removes).
+        reap = getattr(self.orchestrator, "reap_standbys", None)
         for rev, replicas in by_rev.items():
             want = desired.get(rev, 0)
             for replica in replicas[want:]:
                 await self.orchestrator.delete_replica(replica)
+            if want == 0 and reap is not None:
+                await reap(cid, rev)
+        if not desired and reap is not None:
+            await reap(cid)
         # scale up — counting creates already in flight (an orchestrator
         # swapping/recycling a replica registers it only when ready; a
         # second spawn in that window would double-own a TPU chip).

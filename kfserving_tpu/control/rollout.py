@@ -569,12 +569,24 @@ class RolloutManager:
 
     async def _collect_evidence(self, cid: str, rec: RolloutRecord
                                 ) -> List[Dict[str, Any]]:
+        evidence: List[Dict[str, Any]] = []
+        # Supervisor-side evidence first: the orchestrator's pinned
+        # failover/swap-failure timelines for this component (a canary
+        # that kept crashing shows up HERE — its own ring died with
+        # every crash).
+        recorder = getattr(self.controller.reconciler.orchestrator,
+                           "flight_recorder", None)
+        if recorder is not None:
+            dump = recorder.dump(limit=EVIDENCE_LIMIT,
+                                 pinned_only=True)
+            evidence += [dict(e, replica="supervisor")
+                         for e in dump.get("pinned", [])
+                         if e.get("component") == cid]
         if self._session is None:
-            return []
+            return evidence
         hosts = [r.host for r in
                  self.controller.reconciler.orchestrator.replicas(cid)
                  if r.revision == rec.revision]
-        evidence: List[Dict[str, Any]] = []
         for host in hosts:
             try:
                 async with self._session.get(

@@ -58,7 +58,8 @@ class IngressRouter:
                  upstream_timeout_s: Optional[float] = None,
                  buffer_deadline_s: Optional[float] = None,
                  breaker_factory: Optional[
-                     Callable[[str], CircuitBreaker]] = None):
+                     Callable[[str], CircuitBreaker]] = None,
+                 swap_hold_max: int = 1024):
         self.controller = controller  # Controller (store + reconciler)
         self.http_port = http_port
         self.upstream_timeout_s = upstream_timeout_s or ACTIVATOR_TIMEOUT_S
@@ -71,6 +72,14 @@ class IngressRouter:
         self.buffer_deadline_s = (buffer_deadline_s
                                   if buffer_deadline_s is not None
                                   else ACTIVATOR_TIMEOUT_S)
+        # Announced-swap holds (ISSUE 10): when the orchestrator
+        # publishes a drain->activate window for a component, requests
+        # that find no replica are HELD in a bounded queue (at most
+        # swap_hold_max concurrently; the hold is also bounded by
+        # buffer_deadline_s and the request's own budget) instead of
+        # shedding 503s across a planned swap.
+        self.swap_hold_max = swap_hold_max
+        self._swap_held: Dict[str, int] = {}
         self._rng = random.Random(seed)
         self._rr = {}  # component_id -> round-robin counter
         self.router = Router()
@@ -357,14 +366,20 @@ class IngressRouter:
 
     async def _evict_replica(self, cid: str, host: str) -> None:
         """Drop a replica whose transport failed (crashed process) so
-        rotation skips it; the reconciler/autoscaler recreates capacity
-        on its next pass (the reference leans on kubelet restart +
-        readiness for this, SURVEY.md §5.3)."""
+        rotation skips it.  Orchestrators with crash supervision
+        (`report_crash`) promote the component's armed standby in the
+        same tick; otherwise the reconciler/autoscaler recreates
+        capacity on its next pass (the reference leans on kubelet
+        restart + readiness for this, SURVEY.md §5.3)."""
         orch = self.controller.reconciler.orchestrator
+        report = getattr(orch, "report_crash", None)
         for r in orch.replicas(cid):
             if r.host == host:
                 try:
-                    await orch.delete_replica(r)
+                    if report is not None:
+                        await report(r)
+                    else:
+                        await orch.delete_replica(r)
                 except Exception:
                     logger.exception("evicting dead replica %s failed",
                                      host)
@@ -409,12 +424,78 @@ class IngressRouter:
                 return None, cname, revision, (
                     f"no healthy replicas for {name}/{cname} "
                     f"(circuit open)")
-            host = await self._activate(isvc, cname, cid, revision,
-                                        deadline=deadline)
+            # Announced swap window: the orchestrator said this
+            # component is mid drain->activate — hold (bounded queue)
+            # rather than churning scale(); the successor it already
+            # has in flight will appear.
+            verdict, held_host = await self._hold_for_swap(
+                cid, revision, exclude, deadline)
+            if verdict == "host":
+                host = held_host
+            elif verdict == "shed":
+                return None, cname, revision, (
+                    f"no replicas for {name}/{cname} "
+                    f"(swap-hold queue full)")
+            else:
+                host = await self._activate(isvc, cname, cid, revision,
+                                            deadline=deadline)
             if host is None:
                 return None, cname, revision, \
                     f"no replicas for {name}/{cname}"
         return host, cname, revision, None
+
+    async def _hold_for_swap(self, cid: str, revision: str, exclude,
+                             deadline: Optional[Deadline]
+                             ) -> Tuple[str, Optional[str]]:
+        """Hold a request across an announced swap window.  Returns
+        ("host", h) when a replica (re)appeared inside the hold
+        budget, ("shed", None) when the bounded queue is full, and
+        ("pass", None) when no window is announced (or it closed
+        without a replica — the activator path takes over)."""
+        orch = self.controller.reconciler.orchestrator
+        announced = getattr(orch, "swap_announced", None)
+        if not announced or cid not in announced:
+            return "pass", None
+        loop = asyncio.get_running_loop()
+        if loop.time() >= announced.get(cid, 0.0):
+            return "pass", None
+        held = self._swap_held.get(cid, 0)
+        if held >= self.swap_hold_max:
+            obs.router_swap_held_total().labels(outcome="shed").inc()
+            return "shed", None
+        budget_s = self.buffer_deadline_s
+        if deadline is not None:
+            budget_s = min(budget_s, max(0.0, deadline.remaining_s()))
+        start = loop.time()
+        until = start + budget_s
+        self._swap_held[cid] = held + 1
+        try:
+            while loop.time() < until:
+                host = self._pick_replica(cid, revision,
+                                          exclude=exclude)
+                if host is not None:
+                    hold_ms = (loop.time() - start) * 1000.0
+                    obs.router_swap_held_total().labels(
+                        outcome="served").inc()
+                    obs.router_swap_hold_ms().observe(hold_ms)
+                    return "host", host
+                if cid not in announced and \
+                        not getattr(orch, "pending_creates",
+                                    lambda c, r: 0)(cid, revision):
+                    # Window closed with nothing in flight (failed
+                    # swap, incumbent kept or reconciler's turn):
+                    # stop holding, let the activator decide.
+                    return "pass", None
+                await asyncio.sleep(0.02)
+            obs.router_swap_held_total().labels(
+                outcome="expired").inc()
+            return "pass", None
+        finally:
+            n = self._swap_held.get(cid, 1) - 1
+            if n <= 0:
+                self._swap_held.pop(cid, None)
+            else:
+                self._swap_held[cid] = n
 
     async def _activate(self, isvc, cname: str, cid: str,
                         revision: str,
@@ -685,11 +766,31 @@ class IngressRouter:
                             status=400)
         only = req.query.get("replica")
         hosts = [only] if only else self._replica_hosts()
+        pinned_only = req.query.get("pinned", "0") == "1"
         qs = f"?limit={limit}"
-        if req.query.get("pinned", "0") == "1":
+        if pinned_only:
             qs += "&pinned=1"
         entries: list = []
         pinned: list = []
+        # The supervisor's own recorder (failover/swap-failure
+        # timelines pinned by the orchestrator's crash supervision)
+        # federates as replica="supervisor" — the decision trail of a
+        # promotion must be visible in the same place as the request
+        # evidence, and it survives the dead replica whose ring died
+        # with it.
+        if only is None or only == "supervisor":
+            recorder = getattr(
+                self.controller.reconciler.orchestrator,
+                "flight_recorder", None)
+            if recorder is not None:
+                body = recorder.dump(limit=limit,
+                                     pinned_only=pinned_only)
+                entries += [dict(e, replica="supervisor")
+                            for e in body.get("entries", [])]
+                pinned += [dict(e, replica="supervisor")
+                           for e in body.get("pinned", [])]
+        if only == "supervisor":
+            hosts = []
         for host, body in await self._scrape_json_all(
                 hosts, f"/debug/flightrecorder{qs}"):
             entries += [dict(e, replica=host)
@@ -722,14 +823,24 @@ class IngressRouter:
         obs.revision_request_ms().labels(
             model=name, revision=revision).observe(elapsed_ms)
 
-    def _stream_through(self, upstream, gauge_cid: str) -> Response:
+    def _stream_through(self, upstream, gauge_cid: str,
+                        name: Optional[str] = None,
+                        cname: Optional[str] = None,
+                        host: Optional[str] = None) -> Response:
         """Chunk-by-chunk SSE pass-through: no body buffering (the
         server's own transport backpressure applies per chunk), the
         in-flight gauge held for the stream's whole life, and a
         mid-stream upstream death (replica crash, recycle past its
-        drain budget) surfaces as a terminal SSE error event — never
-        a silently dead socket.  No failover after the first byte:
-        a retry would re-run the generation."""
+        drain budget) surfaces as a terminal SSE event — never a
+        silently dead socket.  The router cannot transparently resume
+        a broken generation (the decode state died with the replica,
+        and re-running it silently would duplicate tokens already
+        delivered) — so when the upstream process is DEAD it emits an
+        EXPLICIT retriable failover signal (`finish_reason:
+        "failover", retriable: true`), evicts the corpse so the
+        client's retry lands on the promoted standby, and leaves
+        non-fatal glitches on a live replica as the non-retriable
+        error they always were."""
         import aiohttp as _aiohttp
 
         from kfserving_tpu.server.http import StreamingResponse
@@ -743,9 +854,29 @@ class IngressRouter:
                     OSError) as e:
                 logger.warning("stream from upstream interrupted: %s",
                                e)
-                # The leading blank line terminates any partial SSE
-                # line the upstream death left dangling, so the error
-                # event always parses as its own event.
+                dead = (host is not None
+                        and not await self._replica_alive(host))
+                if dead:
+                    # The serving process is gone mid-generation:
+                    # evict it (promoting its standby on supervised
+                    # orchestrators) and tell the client — explicitly
+                    # — that a retry is safe and capacity is coming.
+                    obs.router_stream_failover_total().labels(
+                        model=name or "").inc()
+                    self._record_failure(host)
+                    if name is not None and cname is not None:
+                        asyncio.ensure_future(
+                            self._mark_failed_and_evict(
+                                name, cname, host, set()))
+                    # The leading blank line terminates any partial
+                    # SSE line the upstream death left dangling, so
+                    # the event always parses as its own event.
+                    yield (b'\n\ndata: {"error": "replica failed '
+                           b'mid-stream; standby promotion in '
+                           b'progress", "finish_reason": "failover", '
+                           b'"retriable": true, '
+                           b'"retry_after_ms": 250}\n\n')
+                    return
                 yield (b'\n\ndata: {"error": "upstream stream '
                        b'interrupted", "finish_reason": "error"}\n\n')
 
@@ -931,7 +1062,10 @@ class IngressRouter:
                                               upstream.status,
                                               attempt_started)
                         resp = self._stream_through(upstream,
-                                                    gauge_cid)
+                                                    gauge_cid,
+                                                    name=name,
+                                                    cname=cname,
+                                                    host=host)
                         gauge_cid = None  # gauge now owned by stream
                         return resp
                     try:

@@ -106,7 +106,8 @@ class JaxEngine:
                  pad_value: float = 0.0,
                  donate_inputs: bool = False,
                  pipeline_depth: int = 2,
-                 blocking_stats: Optional[bool] = None):
+                 blocking_stats: Optional[bool] = None,
+                 param_source: Optional[str] = None):
         import jax
 
         self._jax = jax
@@ -166,6 +167,11 @@ class JaxEngine:
                 "KFS_ENGINE_BLOCKING_STATS", "") not in ("", "0", "false")
         self._blocking_stats = blocking_stats
         self.pipeline_depth = max(1, pipeline_depth)
+        # Param provenance ("mmap" | "checkpoint" | "init" | None):
+        # lets a scrape tell a mapped-warm successor from a replica
+        # that paid full materialization — the lifecycle SOAK's
+        # per-replica evidence that the mmap cache actually engaged.
+        self.param_source = param_source
 
     # -- shape plumbing ------------------------------------------------------
     def _pad_to_bucket(self, arr: np.ndarray) -> Tuple[np.ndarray, int]:
@@ -453,6 +459,8 @@ class JaxEngine:
                 "avg_fetch_ms": self.fetch_ms_total / n if n else 0.0,
                 "blocking_stats": self._blocking_stats,
             }
+            if self.param_source is not None:
+                out["param_source"] = self.param_source
             # In the default non-blocking mode device_ms is just async
             # dispatch; device work completes inside the fetch wait, so
             # MFU divides by their sum (a floor on true utilization —

@@ -346,3 +346,78 @@ def rollout_quarantined():
         "kfserving_tpu_rollout_quarantined",
         "Quarantined (rolled-back) revision hashes currently "
         "remembered per component")
+
+
+# -- replica lifecycle (warm standby / failover) ------------------------
+def lifecycle_swaps_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_lifecycle_swaps_total",
+        "Replica recycle swaps by mode (warm_standby|exclusive_"
+        "standby|overlap|cold) and outcome (ok|failed)")
+
+
+def lifecycle_swap_failures_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_lifecycle_swap_failures_total",
+        "Standby swaps that aborted with the incumbent kept serving, "
+        "by reason (spawn_error|activate_error|activate_timeout)")
+
+
+def lifecycle_promotions_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_lifecycle_promotions_total",
+        "Crash-detected replicas replaced by standby promotion, by "
+        "trigger (process_exit|health_fail|crash_report) and outcome "
+        "(promoted|cold_respawn)")
+
+
+# Lifecycle phases span three decades (a warm activate is hundreds of
+# ms, a cold standby spawn tens of seconds) — the request-latency
+# ladder tops out too low to separate a 14 s activate from a 40 s one.
+LIFECYCLE_BUCKETS_MS = [50, 100, 250, 500, 1000, 2000, 5000, 10000,
+                        20000, 40000, 80000]
+
+
+def lifecycle_phase_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_lifecycle_phase_ms",
+        "Wall time of each replica lifecycle phase (standby_spawn|"
+        "activate|drain|promote)",
+        buckets=LIFECYCLE_BUCKETS_MS)
+
+
+def lifecycle_standby_pool():
+    return REGISTRY.gauge(
+        "kfserving_tpu_lifecycle_standby_pool",
+        "Warm standby processes currently armed (spawned, imports + "
+        "artifact done, device untouched) per component")
+
+
+def router_swap_held_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_router_swap_held_total",
+        "Requests that hit an announced swap window, by outcome "
+        "(served = a replica appeared inside the hold budget, shed = "
+        "bounded queue full, expired = hold budget ran out)")
+
+
+def router_swap_hold_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_router_swap_hold_ms",
+        "Time requests were held at the router across an announced "
+        "drain->activate swap window before being served",
+        buckets=LATENCY_BUCKETS_MS)
+
+
+def router_stream_failover_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_router_stream_failover_total",
+        "Mid-stream upstream deaths surfaced to the client as an "
+        "explicit retriable failover event, per model")
+
+
+def param_cache_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_param_cache_total",
+        "mmap param-cache lookups and stores, by outcome "
+        "(hit|miss|store|error)")

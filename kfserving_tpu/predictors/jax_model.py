@@ -118,6 +118,9 @@ class JaxModel(Model):
         self.engine: Optional[JaxEngine] = None
         self.batcher: Optional[DynamicBatcher] = None
         self._local_dir: Optional[str] = None
+        # How this model's params were materialized at load: "mmap"
+        # (param-cache hit), "checkpoint", or "init".
+        self.param_source: Optional[str] = None
 
     # -- lifecycle ---------------------------------------------------------
     def load(self) -> bool:
@@ -199,7 +202,8 @@ class JaxModel(Model):
     def _build_engine(self, spec, cfg):
         import jax.numpy as jnp
 
-        from kfserving_tpu.models import apply_fn_for, init_params
+        from kfserving_tpu.engine import param_cache
+        from kfserving_tpu.models import apply_fn_for
         from kfserving_tpu.parallel import build_mesh, shard_params
         from kfserving_tpu.parallel.mesh import MeshConfig
 
@@ -208,19 +212,15 @@ class JaxModel(Model):
         # Kept for subclasses that need the raw logits path (explainers
         # differentiate through base_apply, not the serving output mode).
         self._spec = spec
-        variables = init_params(spec, seed=0)
-        startup.mark("init_params")
-        ckpt_path = os.path.join(self._local_dir, CHECKPOINT_NAME)
-        if os.path.exists(ckpt_path):
-            from flax import serialization
-
-            with open(ckpt_path, "rb") as f:
-                variables = serialization.from_bytes(variables, f.read())
-            logger.info("restored checkpoint %s", ckpt_path)
-            startup.mark("checkpoint_restore")
-        else:
-            logger.warning("no checkpoint at %s; serving random init",
-                           ckpt_path)
+        # mmap-first param materialization: a recycle successor (or a
+        # cheap canary spawn) maps the predecessor's persisted host
+        # bytes instead of re-running init + checkpoint restore — the
+        # 8-18 s init_params residual of the r5 SOAK becomes page-cache
+        # reads feeding the device transfer.
+        variables, param_source = param_cache.load_or_materialize(
+            cfg.architecture, cfg.arch_kwargs, spec, self._local_dir,
+            checkpoint_name=CHECKPOINT_NAME)
+        self.param_source = param_source
 
         mesh_cfg = MeshConfig(**{k: int(v) for k, v in cfg.mesh.items()
                                  if k in ("dp", "tp", "sp")})
@@ -284,7 +284,8 @@ class JaxModel(Model):
                            if cfg.batch_buckets
                            else BucketPolicy.pow2(cfg.max_batch_size)),
             seq_buckets=seq_buckets,
-            pipeline_depth=cfg.pipeline_depth)
+            pipeline_depth=cfg.pipeline_depth,
+            param_source=param_source)
         try:
             if cfg.warmup:
                 example = self._example_instance(spec)

@@ -418,13 +418,14 @@ class GenerativeModel(Model):
         self.config_overrides = dict(config_overrides or {})
         self.engine: Optional[GenerationEngine] = None
         self.tokenizer = None
+        # "mmap" | "checkpoint" | "init" once loaded.
+        self.param_source: Optional[str] = None
 
     # -- lifecycle ---------------------------------------------------------
     def load(self) -> bool:
-        from flax import serialization
-
         from kfserving_tpu import startup
-        from kfserving_tpu.models import create_model, init_params
+        from kfserving_tpu.engine import param_cache
+        from kfserving_tpu.models import create_model
 
         startup.mark("load_start")
         local = Storage.download(self.model_dir)
@@ -440,17 +441,11 @@ class GenerativeModel(Model):
             _warn_paged_kernel_ineligible(cfg.block_size)
 
         spec = create_model(cfg.architecture, **cfg.arch_kwargs)
-        variables = init_params(spec, seed=0)
-        startup.mark("init_params")
-        ckpt = os.path.join(local, "checkpoint.msgpack")
-        if os.path.exists(ckpt):
-            with open(ckpt, "rb") as f:
-                variables = serialization.from_bytes(variables, f.read())
-            logger.info("restored checkpoint %s", ckpt)
-            startup.mark("checkpoint_restore")
-        else:
-            logger.warning("no checkpoint at %s; serving random init",
-                           ckpt)
+        # mmap-first materialization (shared with JaxModel): a standby
+        # successor maps the predecessor's persisted host params and
+        # its activation cost collapses to the device transfer.
+        variables, self.param_source = param_cache.load_or_materialize(
+            cfg.architecture, cfg.arch_kwargs, spec, local)
 
         mesh = None
         if cfg.mesh:
@@ -729,7 +724,10 @@ class GenerativeModel(Model):
         return GuardedStream(events(), on_close)
 
     def engine_stats(self) -> Dict[str, Any]:
-        return dict(self.engine.stats()) if self.engine else {}
+        stats = dict(self.engine.stats()) if self.engine else {}
+        if self.param_source is not None:
+            stats["param_source"] = self.param_source
+        return stats
 
     def metadata(self) -> Dict[str, Any]:
         meta = super().metadata()

@@ -10,6 +10,12 @@ Every retry/breaker-wrapped edge calls into the process-global
     dataplane.infer     DataPlane.infer, keyed by model name (inject
                         per-model latency the SLO engine / monitors
                         must detect)
+    orchestrator.standby_activate
+                        SubprocessOrchestrator standby activation,
+                        keyed by "host cid revision:<hash>" — an
+                        injected error/hang drives the swap-failure
+                        path (incumbent kept serving, broken standby
+                        reaped) without breaking a real process
 
 A site with no configuration costs one dict lookup (the common case).
 Configuration comes from the `KFS_FAULTS` env var (JSON object keyed

@@ -619,6 +619,8 @@ class ModelServer:
                                  headers={REQUEST_ID_HEADER: rid})
 
     async def _standby_activate(self, req: Request) -> Response:
+        from kfserving_tpu import startup
+
         if self._standby_fn is None:
             return _json({"error": "server is not in standby mode"},
                          status=409)
@@ -628,6 +630,7 @@ class ModelServer:
             return _json({"error": "activation already in progress"},
                          status=409)
         self._standby_state = "activating"
+        t0 = time.perf_counter()
         try:
             model = await asyncio.get_running_loop().run_in_executor(
                 None, self._standby_fn)
@@ -638,7 +641,16 @@ class ModelServer:
             logger.exception("standby activation failed")
             return _json({"error": f"activation failed: {e}"},
                          status=500)
-        return _json({"activated": True, "model": model.name})
+        startup.mark("standby_activate")
+        # The orchestrator's swap breakdown attaches this: how long
+        # the device-touching half took, and whether params came off
+        # the mmap cache ("mmap") or paid full materialization.
+        return _json({
+            "activated": True, "model": model.name,
+            "activate_s": round(time.perf_counter() - t0, 3),
+            "param_source": getattr(model, "param_source", None),
+            "phases": startup.phases(),
+        })
 
     async def _load(self, req: Request) -> Response:
         name = req.path_params["name"]

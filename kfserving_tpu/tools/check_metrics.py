@@ -144,6 +144,27 @@ async def smoke() -> List[str]:
         "bucket_pad_waste": {"b8": 0.25, "b8s128": 0.5},
         "prefill_bucket_pad_waste": {"s64": 0.11},
     })
+    # Replica-lifecycle families (ISSUE 10): touched with
+    # representative samples so the lint always covers the names,
+    # label shapes, and unit suffixes the orchestrator/router emit.
+    from kfserving_tpu.observability import metrics as obs
+
+    obs.lifecycle_swaps_total().labels(
+        mode="warm_standby", outcome="ok").inc()
+    obs.lifecycle_swap_failures_total().labels(
+        reason="activate_error").inc()
+    obs.lifecycle_promotions_total().labels(
+        trigger="process_exit", outcome="promoted").inc()
+    for phase, ms in (("standby_spawn", 1800.0), ("activate", 650.0),
+                      ("drain", 120.0), ("promote", 900.0)):
+        obs.lifecycle_phase_ms().labels(phase=phase).observe(ms)
+    obs.lifecycle_standby_pool().labels(
+        component="default/probe/predictor").set(1.0)
+    obs.router_swap_held_total().labels(outcome="served").inc()
+    obs.router_swap_hold_ms().observe(42.0)
+    obs.router_stream_failover_total().labels(
+        model="metrics-probe").inc()
+    obs.param_cache_total().labels(outcome="hit").inc()
     problems: List[str] = []
     if resp.status != 200:
         problems.append(

@@ -48,6 +48,25 @@ def pytest_configure(config):
 
 
 @pytest.fixture(autouse=True)
+def _private_param_cache(tmp_path_factory):
+    """Per-test mmap param-cache isolation: without this, the first
+    test to load an artifact stores into the user-level default cache
+    and every later load of the same config silently mmaps — tests
+    asserting materialization phases (init_params/checkpoint marks)
+    would then depend on execution order, and runs would leak entries
+    into ~/.cache.  Subprocess replicas inherit the env, so warm-swap
+    tests still share a cache WITHIN their test."""
+    prior = os.environ.get("KFS_PARAM_CACHE")
+    os.environ["KFS_PARAM_CACHE"] = str(
+        tmp_path_factory.mktemp("param-cache"))
+    yield
+    if prior is None:
+        os.environ.pop("KFS_PARAM_CACHE", None)
+    else:
+        os.environ["KFS_PARAM_CACHE"] = prior
+
+
+@pytest.fixture(autouse=True)
 def _metrics_registry_guard():
     """Process-wide metrics isolation: the observability registry is
     reset after EVERY test, and a test that begins with samples
