@@ -50,9 +50,9 @@ class Downloader:
         the puller's retry policy can re-invoke this freely (the
         `agent.pull` fault site injects failures here, before any
         filesystem mutation)."""
-        from kfserving_tpu.reliability import faults
+        from kfserving_tpu.reliability import fault_sites, faults
 
-        faults.inject_sync("agent.pull", key=model_name)
+        faults.inject_sync(fault_sites.AGENT_PULL, key=model_name)
         digest = spec_digest(spec)
         target = self.model_path(model_name)
         marker = self._marker(model_name, digest)

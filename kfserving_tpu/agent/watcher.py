@@ -94,8 +94,13 @@ class ModelConfigWatcher:
             return None
 
     async def sync(self) -> bool:
-        """One reconcile pass; returns True if events were emitted."""
-        raw = self._read()
+        """One reconcile pass; returns True if events were emitted.
+
+        The config read runs in an executor (kfslint async-blocking):
+        ConfigMap volumes are network-backed mounts, and the watcher
+        shares the agent's loop with live pulls."""
+        raw = await asyncio.get_running_loop().run_in_executor(
+            None, self._read)
         if raw is None:
             return False
         digest = hashlib.sha256(raw).hexdigest()

@@ -115,12 +115,30 @@ def _payload(args) -> dict:
     return json.load(sys.stdin)
 
 
+async def _payload_async(args) -> dict:
+    """`_payload` off the loop (kfslint async-blocking): `kfs predict
+    -f -` reads stdin, which can block indefinitely on a pipe."""
+    return await asyncio.get_running_loop().run_in_executor(
+        None, _payload, args)
+
+
+def _read_json(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _write_json(path: str, data: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(data, f)
+
+
 async def _run(args) -> dict:
+    loop = asyncio.get_running_loop()
     async with KFServingClient(args.control_url, args.ingress_url) as c:
         ns = args.namespace
         if args.command == "apply":
-            with open(args.filename) as f:
-                spec = json.load(f)
+            spec = await loop.run_in_executor(None, _read_json,
+                                              args.filename)
             return await c.create(spec)
         if args.command == "get":
             return await c.get(args.name, ns) if args.name \
@@ -132,11 +150,13 @@ async def _run(args) -> dict:
                                     timeout_seconds=args.timeout)
             return {"name": args.name, "ready": True}
         if args.command == "predict":
-            return await c.predict(args.name, _payload(args),
+            return await c.predict(args.name,
+                                   await _payload_async(args),
                                    protocol=args.protocol,
                                    model_name=args.model)
         if args.command == "explain":
-            return await c.explain(args.name, _payload(args))
+            return await c.explain(args.name,
+                                   await _payload_async(args))
         if args.command == "canary":
             return await c.rollout_canary(args.name, args.percent, ns)
         if args.command == "promote":
@@ -146,8 +166,8 @@ async def _run(args) -> dict:
         if args.command == "profile":
             trace = await c.profile(window_s=args.window,
                                     replica=args.replica)
-            with open(args.output, "w") as f:
-                json.dump(trace, f)
+            await loop.run_in_executor(None, _write_json,
+                                       args.output, trace)
             return {"saved": args.output,
                     "events": len(trace.get("traceEvents", []))}
         if args.command == "credentials":
@@ -175,8 +195,9 @@ async def _run(args) -> dict:
                 return await c.delete_secret(args.name)
         if args.command == "trainedmodel":
             if args.tm_command == "apply":
-                with open(args.filename) as f:
-                    return await c.create_trained_model(json.load(f))
+                return await c.create_trained_model(
+                    await loop.run_in_executor(None, _read_json,
+                                               args.filename))
             if args.tm_command == "delete":
                 return await c.delete_trained_model(args.name, ns)
             return await c.get_trained_model(args.name, ns) \

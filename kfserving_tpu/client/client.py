@@ -23,7 +23,7 @@ import json
 from dataclasses import asdict, is_dataclass
 from typing import Any, Dict, List, Optional
 
-from kfserving_tpu.reliability import RetryPolicy, faults
+from kfserving_tpu.reliability import RetryPolicy, fault_sites, faults
 
 DEFAULT_TIMEOUT_S = 60.0
 
@@ -107,7 +107,7 @@ class KFServingClient:
         data = json.dumps(body).encode() if body is not None else None
 
         async def attempt():
-            await faults.inject("client.request", key=url)
+            await faults.inject(fault_sites.CLIENT_REQUEST, key=url)
             async with session.request(method, url, data=data) as resp:
                 payload = await resp.read()
                 try:
@@ -338,9 +338,13 @@ class KFServingClient:
         """Register a GCS key file (reference set_gcs_credentials)."""
         from kfserving_tpu.client.creds import gcs_secret_payload
 
+        # Executor read (kfslint async-blocking): the SDK runs inside
+        # callers' live event loops, and a key file can sit on a slow
+        # mount.
+        payload = await asyncio.get_running_loop().run_in_executor(
+            None, gcs_secret_payload, credentials_file)
         return await self.create_secret(
-            gcs_secret_payload(credentials_file),
-            service_account=service_account)
+            payload, service_account=service_account)
 
     async def set_s3_credentials(self, credentials_file: str,
                                  service_account: str = "default",
@@ -353,15 +357,19 @@ class KFServingClient:
         """Register AWS-CLI-format credentials (reference
         set_s3_credentials; endpoint/region/SSL knobs become the same
         secret annotations the builder consumes)."""
+        from functools import partial
+
         from kfserving_tpu.client.creds import s3_secret_payload
 
+        payload = await asyncio.get_running_loop().run_in_executor(
+            None, partial(s3_secret_payload, credentials_file,
+                          s3_profile=s3_profile,
+                          s3_endpoint=s3_endpoint,
+                          s3_region=s3_region,
+                          s3_use_https=s3_use_https,
+                          s3_verify_ssl=s3_verify_ssl))
         return await self.create_secret(
-            s3_secret_payload(credentials_file, s3_profile=s3_profile,
-                              s3_endpoint=s3_endpoint,
-                              s3_region=s3_region,
-                              s3_use_https=s3_use_https,
-                              s3_verify_ssl=s3_verify_ssl),
-            service_account=service_account)
+            payload, service_account=service_account)
 
     async def set_azure_credentials(self, credentials_file: str,
                                     service_account: str = "default"
@@ -370,9 +378,10 @@ class KFServingClient:
         set_azure_credentials)."""
         from kfserving_tpu.client.creds import azure_secret_payload
 
+        payload = await asyncio.get_running_loop().run_in_executor(
+            None, azure_secret_payload, credentials_file)
         return await self.create_secret(
-            azure_secret_payload(credentials_file),
-            service_account=service_account)
+            payload, service_account=service_account)
 
 
 def isvc_spec(name: str, framework: str, storage_uri: str,

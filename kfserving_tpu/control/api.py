@@ -31,6 +31,7 @@ create_secret + set_service_account against the K8s API); secret data is
 write-only — list/read endpoints never return it.
 """
 
+import asyncio
 import json
 import logging
 from dataclasses import asdict
@@ -76,6 +77,7 @@ class ControlAPI:
         # to credentials_path when configured.
         self.credentials = credentials
         self.credentials_path = credentials_path
+        self._persist_lock = asyncio.Lock()
         self.router = Router()
         self._register_routes()
         self.http_server = HTTPServer(self.router)
@@ -219,9 +221,20 @@ class ControlAPI:
         return _json({"deleted": f"{ns}/{name}"})
 
     # -- handlers: credentials ----------------------------------------------
-    def _persist_credentials(self) -> None:
-        if self.credentials_path:
-            self.credentials.save(self.credentials_path)
+    async def _persist_credentials(self) -> None:
+        """Persist the store without stalling the loop (kfslint
+        async-blocking: this API shares the manager's event loop with
+        the router — a slow credentials-volume fsync here would stall
+        live inference routing).  Snapshot on the loop (consistent,
+        cheap), write in an executor, serialized so an older snapshot
+        can never land after a newer one."""
+        if not self.credentials_path:
+            return
+        async with self._persist_lock:
+            snapshot = self.credentials.to_dict()
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.credentials.write_snapshot,
+                self.credentials_path, snapshot)
 
     async def _list_secrets(self, req: Request) -> Response:
         if self.credentials is None:
@@ -252,7 +265,7 @@ class ControlAPI:
             account = data.get("serviceAccount")
             if account:
                 self.credentials.attach(account, name)
-            self._persist_credentials()
+            await self._persist_credentials()
         except (ValidationError, KeyError, TypeError) as e:
             return _err(str(e), 422)
         return _json({"name": name,
@@ -266,7 +279,7 @@ class ControlAPI:
             self.credentials.remove_secret(name)
         except KeyError:
             return _err(f"secret {name} not found", 404)
-        self._persist_credentials()
+        await self._persist_credentials()
         return _json({"deleted": name})
 
     async def _list_service_accounts(self, req: Request) -> Response:
@@ -290,7 +303,7 @@ class ControlAPI:
             return _err(str(e), 422)
         except KeyError as e:
             return _err(str(e), 404)
-        self._persist_credentials()
+        await self._persist_credentials()
         return _json({"serviceAccount": account,
                       "secrets": list(
                           self.credentials.service_accounts[account])})

@@ -8,6 +8,7 @@ per-shard models.json files the agent watcher consumes
 (reference pkg/controller/v1alpha1/trainedmodel/controller.go:67-147).
 """
 
+import asyncio
 import logging
 import os
 from typing import Dict, List, Optional
@@ -38,6 +39,7 @@ class Controller:
         self.shard_strategies: Dict[str, HBMShardStrategy] = {}
         self.modelconfig_dir = modelconfig_dir
         self.shard_budget_bytes = shard_budget_bytes
+        self._shardcfg_lock = asyncio.Lock()
 
     # -- InferenceService lifecycle ---------------------------------------
     async def apply(self, isvc: InferenceService) -> IsvcStatus:
@@ -90,8 +92,8 @@ class Controller:
                 or self.shard_budget_bytes))
         shard = strategy.get_or_assign(tm)
         self.trained_models[f"{tm.namespace}/{tm.name}"] = tm
-        self._write_shard_config(tm.inference_service, tm.namespace,
-                                 strategy, shard)
+        await self._write_shard_config(tm.inference_service,
+                                       tm.namespace, strategy, shard)
         # Status URL mirrors the reference (trainedmodel/controller.go:
         # 149-179): <isvc-url>/v1/models/<tm>:predict
         return {"shard": shard,
@@ -108,12 +110,19 @@ class Controller:
             return
         shard = strategy.remove(name)
         if shard is not None:
-            self._write_shard_config(tm.inference_service, namespace,
-                                     strategy, shard)
+            await self._write_shard_config(tm.inference_service,
+                                           namespace, strategy, shard)
 
-    def _write_shard_config(self, isvc_name: str, namespace: str,
-                            strategy: HBMShardStrategy,
-                            shard: int) -> None:
+    async def _write_shard_config(self, isvc_name: str, namespace: str,
+                                  strategy: HBMShardStrategy,
+                                  shard: int) -> None:
+        """Write one shard's models.json without stalling the loop
+        (kfslint async-blocking: the controller shares the manager's
+        event loop with the router, and the modelconfig volume can be
+        a slow network mount).  Entries are snapshotted on the loop
+        BEFORE the first await — they must reflect the state at call
+        time — and writes are serialized so an older snapshot can
+        never land after a newer one."""
         if self.modelconfig_dir is None:
             return
         entries: List[dict] = []
@@ -123,6 +132,8 @@ class Controller:
         path = os.path.join(
             self.modelconfig_dir,
             f"{namespace}-{isvc_name}-shard-{shard}.json")
-        modelconfig.write_file(path, entries)
+        async with self._shardcfg_lock:
+            await asyncio.get_running_loop().run_in_executor(
+                None, modelconfig.write_file, path, entries)
         logger.info("wrote shard config %s (%d models)",
                     path, len(entries))

@@ -36,6 +36,11 @@ from kfserving_tpu.control.subprocess_orchestrator import (
 logger = logging.getLogger("kfserving_tpu.control.manager")
 
 
+def _load_spec_file(path: str) -> object:
+    with open(path) as f:
+        return json.load(f)
+
+
 class ServingManager:
     def __init__(self, cluster_config: Optional[ClusterConfig] = None,
                  orchestrator: str = "inprocess",
@@ -113,10 +118,16 @@ class ServingManager:
             await shutdown()
 
     async def apply_files(self, paths: List[str]) -> None:
-        """Apply spec files at startup (kubectl-apply-at-boot)."""
+        """Apply spec files at startup (kubectl-apply-at-boot).
+
+        File reads go through an executor (kfslint async-blocking):
+        by the time apply_files runs, start_async has the router and
+        API serving on this same loop, so a slow spec volume would
+        stall live traffic."""
+        loop = asyncio.get_running_loop()
         for path in paths:
-            with open(path) as f:
-                data = json.load(f)
+            data = await loop.run_in_executor(None, _load_spec_file,
+                                              path)
             items = data if isinstance(data, list) else [data]
             for item in items:
                 isvc = InferenceService.from_dict(item)

@@ -32,6 +32,7 @@ from kfserving_tpu.reliability import (
     Deadline,
     FaultInjected,
     TIMEOUT_HEADER,
+    fault_sites,
     faults,
 )
 from kfserving_tpu.server.http import HTTPServer, Request, Response, Router
@@ -1035,10 +1036,10 @@ class IngressRouter:
                     # scope chaos to one side of a canary split — the
                     # hardware-free way to drive the rollout manager's
                     # rollback path.
-                    if faults.configured("router.dispatch"):
+                    if faults.configured(fault_sites.ROUTER_DISPATCH):
                         await asyncio.wait_for(
                             faults.inject(
-                                "router.dispatch",
+                                fault_sites.ROUTER_DISPATCH,
                                 key=f"{url} revision:{revision}"),
                             timeout=self.upstream_timeout_s)
                     # Forwarded budget computed AFTER the fault sleep:

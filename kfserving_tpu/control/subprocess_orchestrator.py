@@ -441,13 +441,13 @@ class SubprocessOrchestrator:
         the serving state."""
         import aiohttp
 
-        from kfserving_tpu.reliability import faults
+        from kfserving_tpu.reliability import fault_sites, faults
 
         # Chaos hook: an injected error/hang here drives the
         # activation-failure path (incumbent kept, standby reaped)
         # without breaking a real process.
         await faults.inject(
-            "orchestrator.standby_activate",
+            fault_sites.ORCHESTRATOR_STANDBY_ACTIVATE,
             key=f"{replica.host} {replica.component_id} "
                 f"revision:{replica.revision}")
         url = f"http://{replica.host}/standby/activate"
@@ -576,6 +576,9 @@ class SubprocessOrchestrator:
                         - handle.spawned_at
                     if age < self.recycle.min_age_s:
                         continue  # successor grace: no thrash loop
+                    # kfslint: disable=async-blocking — /proc reads
+                    # are RAM-backed (never disk), microseconds per
+                    # replica.
                     reason = self._over_threshold(handle)
                     if reason is None and \
                             self.recycle.max_requests is not None:

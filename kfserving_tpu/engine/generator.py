@@ -1312,6 +1312,8 @@ class GenerationEngine:
         skipped (except the final one — it must run to sample the
         first token)."""
         idx = act.chunk_next
+        # kfslint: disable=spin-loop — bounded by chunk_total (each
+        # pass increments idx); no external coroutine gates the exit.
         while idx < act.chunk_total - 1 and self._chunk_shared(act,
                                                                idx):
             self.prefill_chunks_skipped += 1

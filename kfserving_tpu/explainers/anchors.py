@@ -80,6 +80,8 @@ async def estimate_precisions(predict_fn: Callable,
     pieces: List[Tuple[Tuple[int, ...], int]] = []
     for a in anchors:
         remaining = n
+        # kfslint: disable=spin-loop — bounded arithmetic split (take
+        # >= 1 every pass); no external coroutine gates the exit.
         while remaining > 0:
             take = min(remaining, cap)
             pieces.append((a, take))

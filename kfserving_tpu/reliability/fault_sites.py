@@ -1,0 +1,59 @@
+"""Canonical fault-injection site manifest — GENERATED, do not hand
+edit the constants section.
+
+`SITES` is the single source of truth for every site name the
+process-global `faults` injector can be called with.  To add a site:
+add its row to `SITES`, regenerate the constants with
+
+    python -m kfserving_tpu.tools.analyzers --write-fault-sites
+
+and use the generated constant at the call site
+(`faults.inject(fault_sites.ROUTER_DISPATCH, ...)`).  kfslint's
+`fault-site` rule enforces both directions in the fast tier: an
+inject call whose site is not in this manifest fails the lint (a
+typo'd site string can no longer silently never fire), and a manifest
+row no inject call uses fails as dead (so this file can't rot into a
+list of sites that no longer exist).
+"""
+
+from typing import Dict
+
+# {CONSTANT_NAME: (site string, what the site gates)}
+SITES: Dict[str, tuple] = {
+    "STORAGE_DOWNLOAD": (
+        "storage.download",
+        "Storage.download per-scheme dispatch"),
+    "AGENT_PULL": (
+        "agent.pull",
+        "Downloader.download (the agent's model pull)"),
+    "CLIENT_REQUEST": (
+        "client.request",
+        "KFServingClient HTTP calls"),
+    "ROUTER_DISPATCH": (
+        "router.dispatch",
+        "IngressRouter upstream proxy attempts (key carries "
+        "`revision:<hash>` for canary-scoped chaos)"),
+    "DATAPLANE_INFER": (
+        "dataplane.infer",
+        "DataPlane.infer, keyed by model name (per-model latency "
+        "the SLO engine / monitors must detect)"),
+    "ORCHESTRATOR_STANDBY_ACTIVATE": (
+        "orchestrator.standby_activate",
+        "SubprocessOrchestrator standby activation, keyed by `host "
+        "cid revision:<hash>` — drives the swap-failure path"),
+}
+
+
+def site_values() -> Dict[str, str]:
+    """{CONSTANT_NAME: site string} view of the manifest."""
+    return {name: row[0] for name, row in SITES.items()}
+
+
+# -- generated constants (python -m kfserving_tpu.tools.analyzers
+#    --write-fault-sites) — do not edit below this line -----------------
+STORAGE_DOWNLOAD = "storage.download"
+AGENT_PULL = "agent.pull"
+CLIENT_REQUEST = "client.request"
+ROUTER_DISPATCH = "router.dispatch"
+DATAPLANE_INFER = "dataplane.infer"
+ORCHESTRATOR_STANDBY_ACTIVATE = "orchestrator.standby_activate"

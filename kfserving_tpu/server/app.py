@@ -641,6 +641,8 @@ class ModelServer:
             logger.exception("standby activation failed")
             return _json({"error": f"activation failed: {e}"},
                          status=500)
+        # kfslint: disable=async-blocking — mark()'s /proc read is
+        # RAM-backed and runs once per process (birth time cached).
         startup.mark("standby_activate")
         # The orchestrator's swap breakdown attaches this: how long
         # the device-touching half took, and whether params came off
@@ -867,6 +869,8 @@ class ModelServer:
             self.grpc_port = self.grpc_server.port
         from kfserving_tpu import startup
 
+        # kfslint: disable=async-blocking — mark()'s /proc read is
+        # RAM-backed and runs once per process (birth time cached).
         startup.mark("serving")
 
     async def drain(self, budget_s: float) -> bool:

@@ -23,6 +23,7 @@ from kfserving_tpu.reliability.deadline import (
     check_deadline,
     deadline_scope,
 )
+from kfserving_tpu.reliability import fault_sites
 from kfserving_tpu.reliability.faults import faults
 from kfserving_tpu.tracing import tracer
 
@@ -138,8 +139,8 @@ class DataPlane:
         # the knob tests/test_monitoring.py drives the alert loop
         # with.  configured() keeps the no-faults hot path at one
         # dict lookup.
-        if faults.configured("dataplane.infer"):
-            await faults.inject("dataplane.infer", key=name)
+        if faults.configured(fault_sites.DATAPLANE_INFER):
+            await faults.inject(fault_sites.DATAPLANE_INFER, key=name)
         check_deadline("dataplane.infer")
         with tracer.span("dataplane.preprocess", model=name):
             request = await model.preprocess(body)

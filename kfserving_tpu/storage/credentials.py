@@ -155,10 +155,19 @@ class CredentialStore:
     def save(self, path: str) -> None:
         """Persist the store (atomic replace; the file holds live
         credentials, so 0600 like the GCS key file)."""
+        self.write_snapshot(path, self.to_dict())
+
+    @staticmethod
+    def write_snapshot(path: str, data: Dict) -> None:
+        """Write an already-taken `to_dict()` snapshot.  Split from
+        `save` so async callers can snapshot on the event loop (cheap,
+        consistent) and ship only the disk write to an executor —
+        handing the live store to a writer thread would race its dict
+        iteration against loop-side mutations."""
         tmp = f"{path}.tmp"
         fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, "w") as f:
-            json.dump(self.to_dict(), f, indent=2)
+            json.dump(data, f, indent=2)
         os.replace(tmp, path)
 
     # -- builder (CreateSecretVolumeAndEnv equivalent) ----------------------

@@ -200,10 +200,14 @@ class Storage:
         # full success, so a half-pulled attempt just re-pulls.
         # Terminal errors (unknown scheme, missing SDK, HTTP 4xx) are
         # not connection-level and fail fast.
-        from kfserving_tpu.reliability import RetryPolicy, faults
+        from kfserving_tpu.reliability import (
+            RetryPolicy,
+            fault_sites,
+            faults,
+        )
 
         def pull():
-            faults.inject_sync("storage.download", key=uri)
+            faults.inject_sync(fault_sites.STORAGE_DOWNLOAD, key=uri)
             if uri.startswith(_GCS_PREFIX):
                 Storage._download_gcs(uri, out_dir)
             elif uri.startswith(_S3_PREFIX):

@@ -1,0 +1,74 @@
+"""kfslint — AST-based concurrency & serving-discipline analyzer.
+
+Usage (CLI)::
+
+    python -m kfserving_tpu.tools.analyzers [paths ...]
+    kfs-lint [paths ...]                      # console-script alias
+
+With no paths it analyzes the installed ``kfserving_tpu`` package.
+Exit 0 means: zero findings that are neither pragma-suppressed nor in
+the committed baseline, AND zero stale baseline entries.
+
+Rules (see ``asyncrules.py`` / ``discipline.py`` for the defect class
+each one encodes): ``async-blocking``, ``spin-loop``,
+``await-under-lock``, ``cancellation-safety``, ``fault-site``,
+``metric-name`` (the last two are the serving-discipline pair).
+
+Suppression: ``# kfslint: disable=<rule>[,<rule>]  <justification>``
+on the finding's line.  Known legacy findings live in
+``baseline.json`` next to this package; a baseline entry whose
+finding disappeared fails the run as stale.
+"""
+
+import os
+from typing import List
+
+from kfserving_tpu.tools.analyzers.asyncrules import (
+    AsyncBlockingRule,
+    AwaitUnderLockRule,
+    CancellationSafetyRule,
+    SpinLoopRule,
+)
+from kfserving_tpu.tools.analyzers.core import (
+    Finding,
+    Rule,
+    analyze_paths,
+    analyze_snippets,
+    analyze_source,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+from kfserving_tpu.tools.analyzers.discipline import (
+    FaultSiteRule,
+    MetricNameRule,
+)
+
+__all__ = [
+    "Finding", "Rule", "analyze_paths", "analyze_snippets",
+    "analyze_source", "apply_baseline", "load_baseline",
+    "save_baseline", "default_rules", "rule_ids",
+    "default_baseline_path", "default_target",
+]
+
+
+def default_rules() -> List[Rule]:
+    """Fresh rule instances (rules carry per-run state; never share
+    instances across runs)."""
+    return [AsyncBlockingRule(), SpinLoopRule(), AwaitUnderLockRule(),
+            CancellationSafetyRule(), FaultSiteRule(),
+            MetricNameRule()]
+
+
+def rule_ids() -> List[str]:
+    return [r.id for r in default_rules()]
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def default_target() -> str:
+    """The installed package root — what a bare `kfs-lint` analyzes."""
+    import kfserving_tpu
+    return os.path.dirname(os.path.abspath(kfserving_tpu.__file__))

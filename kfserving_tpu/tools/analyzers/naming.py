@@ -1,0 +1,46 @@
+"""Metric family naming rules — the single authority.
+
+These are the house conventions for every exported metric family.
+Two consumers apply them to the SAME rule code:
+
+- `tools/check_metrics.py` lints the *runtime* view: the families a
+  booted server actually registered and rendered (catches dynamic
+  names, duplicate declarations, out-of-range ratio samples);
+- the kfslint `metric-name` rule lints the *static* view: every
+  string-literal family name passed to `REGISTRY.counter/gauge/
+  histogram(...)` anywhere in the tree (catches misnamed families on
+  code paths no smoke test happens to execute).
+
+Keeping one implementation here means a new convention lands in both
+tiers at once — the pre-PR-11 state, where check_metrics owned a
+private copy, is exactly how the static and runtime twins drift.
+"""
+
+from typing import List
+
+PREFIX = "kfserving_tpu_"
+UNIT_SUFFIXES = ("_ms", "_seconds", "_bytes", "_ratio", "_per_second")
+
+
+def family_name_problems(name: str, kind: str) -> List[str]:
+    """Naming problems for one family declaration.
+
+    `kind` is "counter" | "gauge" | "histogram" (unknown kinds get the
+    kind-independent checks only).
+    """
+    problems: List[str] = []
+    if not name.startswith(PREFIX):
+        problems.append(f"{name}: missing the {PREFIX!r} prefix")
+    if kind == "counter" and not name.endswith("_total"):
+        problems.append(f"{name}: counters must end in _total")
+    if kind != "counter" and name.endswith("_total"):
+        problems.append(
+            f"{name}: _total suffix is reserved for counters "
+            f"(is a {kind})")
+    if "_milliseconds" in name or "_millis" in name:
+        problems.append(f"{name}: spell milliseconds as _ms")
+    if kind == "histogram" and not name.endswith(UNIT_SUFFIXES):
+        problems.append(
+            f"{name}: histograms must carry a unit suffix "
+            f"({', '.join(UNIT_SUFFIXES)})")
+    return problems

@@ -31,33 +31,19 @@ import re
 import sys
 from typing import Dict, List
 
-PREFIX = "kfserving_tpu_"
-UNIT_SUFFIXES = ("_ms", "_seconds", "_bytes", "_ratio", "_per_second")
-# Sample suffixes histograms append to their family name.
-_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+from kfserving_tpu.tools.analyzers.naming import (
+    PREFIX,
+    family_name_problems,
+)
 
 
 def lint_families(families: Dict[str, str]) -> List[str]:
-    """Lint a {family name: kind} mapping (registry introspection)."""
+    """Lint a {family name: kind} mapping (registry introspection).
+    The naming rules live in `tools/analyzers/naming.py`, shared with
+    kfslint's static `metric-name` rule — one rule set, two tiers."""
     problems: List[str] = []
     for name, kind in sorted(families.items()):
-        if not name.startswith(PREFIX):
-            problems.append(
-                f"{name}: missing the {PREFIX!r} prefix")
-        if kind == "counter" and not name.endswith("_total"):
-            problems.append(
-                f"{name}: counters must end in _total")
-        if kind != "counter" and name.endswith("_total"):
-            problems.append(
-                f"{name}: _total suffix is reserved for counters "
-                f"(is a {kind})")
-        if "_milliseconds" in name or "_millis" in name:
-            problems.append(
-                f"{name}: spell milliseconds as _ms")
-        if kind == "histogram" and not name.endswith(UNIT_SUFFIXES):
-            problems.append(
-                f"{name}: histograms must carry a unit suffix "
-                f"({', '.join(UNIT_SUFFIXES)})")
+        problems.extend(family_name_problems(name, kind))
     return problems
 
 

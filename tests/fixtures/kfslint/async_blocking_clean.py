@@ -1,0 +1,29 @@
+"""kfslint golden fixture: async-blocking must NOT fire anywhere
+here (never executed, only parsed)."""
+import asyncio
+import time
+
+
+async def handler():
+    await asyncio.sleep(0.1)        # async sleep is the point
+
+    def helper():
+        # Sync def nested in an async def runs wherever it's called
+        # (typically an executor) — not this frame.
+        time.sleep(1)
+
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, helper)
+
+
+def sync_path():
+    # Blocking calls in plain sync code are fine.
+    time.sleep(0.5)
+    with open("/tmp/x") as f:
+        return f.read()
+
+
+async def suppressed():
+    # kfslint: disable=async-blocking — fixture: justified one-off.
+    time.sleep(0.01)
+    time.sleep(0.02)  # kfslint: disable=async-blocking — trailing form
