@@ -127,6 +127,17 @@ def _compact_configs(results: dict) -> dict:
                 "gateless_p99_ms": (r.get("gateless") or {}).get(
                     "p99_ms_median"),
             })
+            step = r.get("traffic_step") or {}
+            c.update({
+                "step_reactive_p99_ms": ((step.get("reactive") or {})
+                                         .get("held") or {}).get(
+                    "p99_ms_median"),
+                "step_predictive_p99_ms": (
+                    (step.get("predictive") or {})
+                    .get("held") or {}).get("p99_ms_median"),
+                "step_predictive_held": (step.get("slo") or {}).get(
+                    "predictive_held"),
+            })
         elif name == "bert_flash_ab":
             c["xla_over_flash_sync"] = r.get("xla_over_flash_sync")
         elif name == "generate":

@@ -391,6 +391,211 @@ async def bench_overload(smoke: bool) -> Dict[str, Any]:
             raw["p99_ms_median"] / gate["p99_ms_median"], 3)
         out["goodput_ratio"] = round(
             gate["req_per_s_median"] / raw["req_per_s_median"], 3)
+    # Predictive SLO control loop (ISSUE 12): traffic-step A/B through
+    # the full control plane, committed to BENCH_overload.json.
+    out["traffic_step"] = await _overload_traffic_step(smoke)
+    record = {
+        "scenario": "overload_traffic_step",
+        "smoke": smoke,
+        "admission_ab": {k: out.get(k) for k in
+                         ("gateless", "admission",
+                          "accepted_p99_improvement", "goodput_ratio")},
+        "traffic_step": out["traffic_step"],
+    }
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "BENCH_overload.json"), "w") as f:
+        json.dump(record, f, indent=2)
+    return out
+
+
+class _SleepModel:
+    """Deterministic-service-time model for the control-plane step
+    bench: capacity per replica is exactly containerConcurrency /
+    service_s, so the A/B measures the CONTROL LOOP, not model or
+    tunnel noise."""
+
+    def __init__(self, name: str, service_s: float):
+        from kfserving_tpu.model.model import Model
+
+        class _M(Model):
+            def load(self):
+                self.ready = True
+                return True
+
+            async def predict(self, request):
+                await asyncio.sleep(service_s)
+                return {"predictions": [1]}
+
+        self.model = _M(name)
+        self.model.load()
+
+
+async def _overload_traffic_step(smoke: bool) -> Dict[str, Any]:
+    """Interleaved A/B at a fixed traffic step: REACTIVE (pre-ISSUE-12
+    autoscaler, no brownout) vs PREDICTIVE (feed-forward sizing +
+    standby pre-arm + brownout admission).  The step offers ~3x the
+    component's max capacity; the latency SLO can only hold if the
+    excess is shed selectively.  Per round, the step is split into a
+    `settle` slice (detection + actuation transient, reported) and a
+    `held` slice (steady state, gated on the SLO) — convergence time
+    is evidence, not something to hide inside a tail percentile."""
+    from kfserving_tpu.control.autoscaler import Autoscaler
+    from kfserving_tpu.control.controller import Controller
+    from kfserving_tpu.control.orchestrator import (
+        InProcessOrchestrator,
+    )
+    from kfserving_tpu.control.predictive import PredictiveScaler
+    from kfserving_tpu.control.router import IngressRouter
+    from kfserving_tpu.control.spec import (
+        InferenceService,
+        PredictorSpec,
+    )
+    from kfserving_tpu.observability.monitoring.slo import SLOObjective
+    from kfserving_tpu.reliability import (
+        BrownoutController,
+        PRIORITY_HEADER,
+    )
+
+    service_s = 0.25
+    cc = 8
+    max_replicas = 2
+    objective_ms = 500.0  # on a histogram bucket bound (exact burn)
+    base_rate, step_rate = 8, 96
+    warm_s, settle_s, held_s = 1.5, 1.2, 3.5
+    rounds = 2 if smoke else 4
+    tick_s = 0.1
+    out: Dict[str, Any] = {
+        "service_ms": service_s * 1000.0, "container_concurrency": cc,
+        "max_replicas": max_replicas,
+        "capacity_req_per_s": max_replicas * cc / service_s,
+        "latency_objective_ms": objective_ms,
+        "base_rate_qps": base_rate, "step_rate_qps": step_rate,
+        "rounds": rounds,
+        "priority_mix": {"batch": 0.5, "normal": 0.4,
+                         "critical": 0.1},
+    }
+
+    # i -> priority tier: 50% batch / 40% normal / 10% critical,
+    # interleaved so every slice of the step carries the full mix.
+    def tier_of(i: int) -> str:
+        slot = i % 10
+        if slot < 5:
+            return "batch"
+        if slot < 9:
+            return "normal"
+        return "critical"
+
+    def headers_fn(i: int) -> Dict[str, str]:
+        return {PRIORITY_HEADER: tier_of(i)}
+
+    stacks: Dict[str, Dict[str, Any]] = {}
+    results: Dict[str, Dict[str, list]] = {
+        "reactive": {"settle": [], "held": []},
+        "predictive": {"settle": [], "held": []},
+    }
+    try:
+        for mode in ("reactive", "predictive"):
+            orch = InProcessOrchestrator(
+                model_factory=lambda cid, spec: _SleepModel(
+                    "step", service_s).model)
+            controller = Controller(orch)
+            brownout = BrownoutController() \
+                if mode == "predictive" else None
+            router = IngressRouter(controller, brownout=brownout)
+            predictive = None
+            if mode == "predictive":
+                predictive = PredictiveScaler(
+                    controller, router,
+                    objectives={"step": SLOObjective(
+                        "step", latency_ms=objective_ms)},
+                    windows_s=(0.6, 3.0), burn_alert=2.0,
+                    burn_exit=1.0, exit_ticks=3, brownout=brownout)
+            scaler = Autoscaler(controller, router,
+                                tick_seconds=tick_s,
+                                predictive=predictive)
+            isvc = InferenceService(
+                name="step",
+                predictor=PredictorSpec(
+                    framework="sklearn",
+                    storage_uri="file:///dev/null",
+                    min_replicas=1, max_replicas=max_replicas,
+                    container_concurrency=cc))
+            await controller.apply(isvc)
+            await router.start_async()
+            await scaler.start()
+            stacks[mode] = dict(orch=orch, controller=controller,
+                                router=router, scaler=scaler,
+                                predictive=predictive,
+                                brownout=brownout, isvc=isvc)
+
+        body = json.dumps({"instances": [[1.0]]}).encode()
+        path = "/v1/models/step:predict"
+        order = list(stacks.items())
+        for rnd in range(rounds):
+            for mode, stack in (order if rnd % 2 == 0
+                                else list(reversed(order))):
+                # Round reset: back to 1 replica, fresh windows/levels.
+                await stack["controller"].reconciler.scale(
+                    stack["isvc"], "predictor", 1)
+                stack["scaler"]._windows.clear()
+                stack["scaler"]._idle.clear()
+                if stack["brownout"] is not None:
+                    stack["brownout"].set_level("step", 0)
+                port = stack["router"].http_port
+                await open_loop(port, path, lambda i: body,
+                                base_rate, warm_s,
+                                headers_fn=headers_fn)
+                results[mode]["settle"].append(await open_loop(
+                    port, path, lambda i: body, step_rate, settle_s,
+                    headers_fn=headers_fn, label_fn=tier_of))
+                results[mode]["held"].append(await open_loop(
+                    port, path, lambda i: body, step_rate, held_s,
+                    headers_fn=headers_fn, label_fn=tier_of))
+                # Cool-down past the LONG burn window so the next arm
+                # starts from a calm series — and so the predictive
+                # arm's automatic brownout EXIT (burn recovered, gap
+                # cleared) lands in the decision trail.
+                await asyncio.sleep(3.2)
+    finally:
+        for stack in stacks.values():
+            await stack["scaler"].stop()
+            await stack["router"].stop_async()
+            await stack["orch"].shutdown()
+
+    from benchmarks.harness import aggregate_rounds
+
+    for mode in results:
+        out[mode] = {
+            "settle": aggregate_rounds(results[mode]["settle"]),
+            "held": aggregate_rounds(results[mode]["held"]),
+            "held_rounds": results[mode]["held"],
+        }
+    reactive_p99 = out["reactive"]["held"].get("p99_ms_median")
+    predictive_p99 = out["predictive"]["held"].get("p99_ms_median")
+    out["slo"] = {
+        "latency_objective_ms": objective_ms,
+        "reactive_breached": (reactive_p99 is not None
+                              and reactive_p99 > objective_ms),
+        "predictive_held": (predictive_p99 is not None
+                            and predictive_p99 <= objective_ms),
+        "predictive_errors": out["predictive"]["held"]["errors"]
+        + out["predictive"]["settle"]["errors"],
+        "predictive_shed_retriable":
+            out["predictive"]["held"]["shed_retriable"]
+            + out["predictive"]["settle"]["shed_retriable"],
+    }
+    # The decision trail: every pre-arm/scale/brownout decision the
+    # predictive loop pinned into the supervisor flight recorder
+    # (federated live at /debug/flightrecorder, replica="supervisor").
+    stack = stacks.get("predictive", {})
+    recorder = getattr(stack.get("orch"), "flight_recorder", None)
+    if recorder is not None:
+        dump = recorder.dump(limit=64, pinned_only=True)
+        out["decision_trail"] = dump.get("pinned", [])
+    orch = stack.get("orch")
+    if orch is not None:
+        out["standby_adoptions"] = getattr(orch, "standby_adoptions",
+                                           0)
     return out
 
 

@@ -35,9 +35,12 @@ def percentile(sorted_values: List[float], q: float) -> float:
 def summarize(latencies_ms: List[float], wall_s: float,
               errors: int = 0,
               first_error: Optional[str] = None,
-              shed: int = 0) -> Dict[str, Any]:
-    """shed: admission-gate 503s — load management, reported apart from
-    errors so goodput-vs-shed is visible."""
+              shed: int = 0,
+              shed_retriable: int = 0) -> Dict[str, Any]:
+    """shed: admission-gate 503s — load management, reported apart
+    from errors so goodput-vs-shed is visible.  shed_retriable: the
+    subset carrying an explicit machine-readable retry signal
+    (`"retriable": true` + Retry-After — the brownout gate's shape)."""
     lat = sorted(latencies_ms)
     n = len(lat)
     total = n + errors + shed
@@ -54,6 +57,8 @@ def summarize(latencies_ms: List[float], wall_s: float,
     if shed:
         out["shed"] = shed
         out["shed_rate"] = shed / total
+    if shed_retriable:
+        out["shed_retriable"] = shed_retriable
     if first_error:
         # A failing config must say WHY in the results JSON — an
         # all-errors run once shipped as silent zeros.
@@ -72,6 +77,8 @@ def aggregate_rounds(rounds: List[Dict[str, Any]],
         "req_per_s_rounds": [round(r.get("req_per_s", 0.0), 2)
                              for r in rounds],
         "shed": sum(r.get("shed", 0) for r in rounds),
+        "shed_retriable": sum(r.get("shed_retriable", 0)
+                              for r in rounds),
         "errors": sum(r.get("errors", 0) for r in rounds),
     }
     for key in keys:
@@ -96,6 +103,7 @@ async def closed_loop(port: int, path: str, body: bytes,
     latencies: List[float] = []
     errors = 0
     shed = 0
+    shed_retriable = 0
     first_error: Optional[str] = None
     sem = asyncio.Semaphore(concurrency)
     url = f"http://{host}:{port}{path}"
@@ -105,13 +113,20 @@ async def closed_loop(port: int, path: str, body: bytes,
             timeout=aiohttp.ClientTimeout(total=120)) as session:
 
         async def one():
-            nonlocal errors, shed, first_error
+            nonlocal errors, shed, shed_retriable, first_error
             async with sem:
                 t0 = time.perf_counter()
                 try:
                     async with session.post(
                             url, data=body, headers=headers) as resp:
                         payload = await resp.read()
+                        if resp.status == 503 and \
+                                b'"retriable": true' in payload:
+                            # Brownout-gate shedding: explicit
+                            # retriable signal + Retry-After.
+                            shed += 1
+                            shed_retriable += 1
+                            return
                         if resp.status == 503 and \
                                 b"concurrency limit" in payload:
                             # Admission-gate shedding (server/app.py
@@ -139,7 +154,8 @@ async def closed_loop(port: int, path: str, body: bytes,
         t0 = time.perf_counter()
         await asyncio.gather(*[one() for _ in range(num_requests)])
         wall = time.perf_counter() - t0
-    return summarize(latencies, wall, errors, first_error, shed=shed)
+    return summarize(latencies, wall, errors, first_error, shed=shed,
+                     shed_retriable=shed_retriable)
 
 
 async def open_loop(port: int, path: str,
@@ -147,20 +163,26 @@ async def open_loop(port: int, path: str,
                     rate_qps: float, duration_s: float,
                     host: str = "127.0.0.1",
                     headers: Optional[Dict[str, str]] = None,
-                    label_fn: Optional[Callable[[int], str]] = None
-                    ) -> Dict[str, Any]:
+                    label_fn: Optional[Callable[[int], str]] = None,
+                    headers_fn: Optional[
+                        Callable[[int], Optional[Dict[str, str]]]]
+                    = None) -> Dict[str, Any]:
     """Vegeta-style fixed-rate attack: request i fires at t0 + i/rate
     regardless of outstanding requests (open loop — queueing shows up
     as latency, exactly like the reference tables).
 
     label_fn classifies request i (e.g. by sequence-length class) so
-    mixed-traffic runs report per-class latency in out["by_label"]."""
+    mixed-traffic runs report per-class latency in out["by_label"];
+    headers_fn supplies per-request headers (e.g. a priority-tier
+    mix for the brownout bench), overriding `headers`."""
     import aiohttp
 
     latencies: List[float] = []
     by_label: Dict[str, List[float]] = {}
+    shed_by_label: Dict[str, int] = {}
     errors = 0
     shed = 0
+    shed_retriable = 0
     first_error: Optional[str] = None
     total = max(1, int(rate_qps * duration_s))
     url = f"http://{host}:{port}{path}"
@@ -170,16 +192,27 @@ async def open_loop(port: int, path: str,
             timeout=aiohttp.ClientTimeout(total=120)) as session:
 
         async def one(i: int):
-            nonlocal errors, shed, first_error
+            nonlocal errors, shed, shed_retriable, first_error
+            hdrs = headers_fn(i) if headers_fn is not None else headers
             t0 = time.perf_counter()
             try:
                 async with session.post(
-                        url, data=body_fn(i), headers=headers) as resp:
+                        url, data=body_fn(i), headers=hdrs) as resp:
                     payload = await resp.read()
                     if resp.status == 503 and \
-                            b"concurrency limit" in payload:
-                        # Admission-gate shedding (see closed_loop).
+                            (b"concurrency limit" in payload
+                             or b'"retriable": true' in payload):
+                        # Load management, not failure: the replica
+                        # admission gate (see closed_loop) or the
+                        # router's brownout gate (explicit retriable
+                        # signal + Retry-After).
                         shed += 1
+                        if b'"retriable": true' in payload:
+                            shed_retriable += 1
+                        if label_fn is not None:
+                            lbl = label_fn(i)
+                            shed_by_label[lbl] = \
+                                shed_by_label.get(lbl, 0) + 1
                         return
                     if resp.status != 200:
                         errors += 1
@@ -207,8 +240,11 @@ async def open_loop(port: int, path: str,
             tasks.append(asyncio.ensure_future(one(i)))
         await asyncio.gather(*tasks)
         wall = time.perf_counter() - start
-    out = summarize(latencies, wall, errors, first_error, shed=shed)
+    out = summarize(latencies, wall, errors, first_error, shed=shed,
+                    shed_retriable=shed_retriable)
     out["rate_qps"] = rate_qps
+    if shed_by_label:
+        out["shed_by_label"] = dict(sorted(shed_by_label.items()))
     if by_label:
         out["by_label"] = {
             label: {

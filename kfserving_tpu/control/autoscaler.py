@@ -12,13 +12,22 @@ Scale-to-zero fires after `idle_ticks` windows of zero traffic when
 min_replicas == 0 (cold start is then the router's _activate path, which
 on TPU includes compile time — the persistent compile cache is what makes
 it tolerable, SURVEY.md §5.3).
+
+With a `PredictiveScaler` attached (control/predictive.py, ISSUE 12)
+each tick additionally runs the feed-forward plan: burn-driven sizing
+from the router's latency/arrival series, standby pre-arming, and
+brownout entry/exit — the reactive signal then acts as the floor, the
+prediction as the leading edge.
 """
 
 import asyncio
 import logging
 import math
 from collections import deque
-from typing import Dict
+from typing import Dict, Optional
+
+from kfserving_tpu.observability import metrics as obs
+from kfserving_tpu.reliability import fault_sites, faults
 
 logger = logging.getLogger("kfserving_tpu.control.autoscaler")
 
@@ -29,18 +38,28 @@ IDLE_TICKS_TO_ZERO = 30
 # utilization (occupancy + queued prefills vs capacity) — the KPA
 # "target concurrency" analogue for slot-structured load.
 TARGET_SLOT_UTIL = 0.8
+# Consecutive failed ticks before the dead control loop is pinned into
+# the supervisor flight recorder (one-off failures just retry).
+STALL_TICKS = 3
 
 
 class Autoscaler:
     def __init__(self, controller, router,
                  target_concurrency: float = DEFAULT_TARGET_CONCURRENCY,
-                 tick_seconds: float = 2.0):
+                 tick_seconds: float = 2.0,
+                 predictive: Optional[object] = None):
         self.controller = controller
         self.router = router
         self.target_concurrency = target_concurrency
         self.tick_seconds = tick_seconds
+        # PredictiveScaler (control/predictive.py) or None (pure
+        # reactive — the pre-ISSUE-12 behavior, and the bench's
+        # baseline arm).
+        self.predictive = predictive
         self._windows: Dict[str, deque] = {}
         self._idle: Dict[str, int] = {}
+        self._consecutive_failures = 0
+        self._stall_pinned = False
         self._task = None
 
     async def start(self):
@@ -60,13 +79,58 @@ class Autoscaler:
             try:
                 await self.tick()
             except Exception:
+                # Swallowing alone made a dead control loop invisible
+                # until the next overload: count every failure and pin
+                # evidence once the loop is provably stalled, so
+                # /debug/flightrecorder (replica="supervisor") shows
+                # it before the capacity gap does.
                 logger.exception("autoscaler tick failed")
+                self._note_tick_failure()
+            else:
+                self._consecutive_failures = 0
+                self._stall_pinned = False
             await asyncio.sleep(self.tick_seconds)
 
+    def _note_tick_failure(self) -> None:
+        obs.autoscaler_tick_failures_total().inc()
+        self._consecutive_failures += 1
+        if self._consecutive_failures < STALL_TICKS or \
+                self._stall_pinned:
+            return
+        self._stall_pinned = True
+        from kfserving_tpu.control.predictive import (
+            ensure_flight_recorder,
+        )
+
+        recorder = ensure_flight_recorder(
+            self.controller.reconciler.orchestrator)
+        if recorder is not None:
+            recorder.record({
+                "kind": "autoscaler_stalled",
+                "consecutive_failures": self._consecutive_failures,
+                "tick_seconds": self.tick_seconds,
+            }, pin="autoscaler_stalled")
+        logger.error("autoscaler control loop stalled: %d consecutive "
+                     "tick failures", self._consecutive_failures)
+
     async def tick(self):
-        """One scaling evaluation (callable directly in tests)."""
+        """One scaling evaluation (callable directly in tests).  The
+        predictive signal snapshot and the brownout evaluation run
+        BEFORE the per-component actuation (and before its fault
+        site): a wedged scale() must not keep the brownout gate from
+        engaging — that ordering is exactly what the chaos test
+        injects `autoscaler.tick` faults to prove."""
+        if self.predictive is not None:
+            self.predictive.observe()
         for name, isvc in list(self.controller.specs.items()):
+            if self.predictive is not None:
+                # isvc.name, not the namespaced specs key: objectives
+                # and the router's series are keyed by model name.
+                self.predictive.evaluate_brownout(isvc.name, isvc)
             for cname, comp in isvc.components().items():
+                if faults.configured(fault_sites.AUTOSCALER_TICK):
+                    await faults.inject(fault_sites.AUTOSCALER_TICK,
+                                        key=f"{name}/{cname}")
                 await self._scale_component(name, isvc, cname, comp)
 
     def _occupancy_desired(self, cid: str) -> int:
@@ -117,6 +181,14 @@ class Autoscaler:
                   or self.target_concurrency)
         desired = math.ceil(avg / target) if avg > 0 else 0
         desired = max(desired, occupancy_load)
+        # Feed-forward: the predictive plan (burn rate x latency
+        # model, chain-joint) leads; the reactive average is the
+        # floor.  Pre-arming/evidence happen inside the plan call.
+        if self.predictive is not None:
+            current = len(
+                self.controller.reconciler.orchestrator.replicas(cid))
+            desired = max(desired, self.predictive.desired_replicas(
+                isvc.name, isvc, cname, comp, cid, current))
         key = f"{name}/{cname}"
         if desired == 0:
             self._idle[key] = self._idle.get(key, 0) + 1

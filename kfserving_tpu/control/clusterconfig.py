@@ -115,6 +115,21 @@ class BatcherConfig:
 class AutoscalerConfig:
     target_concurrency: float = 4.0
     tick_seconds: float = 2.0
+    # Predictive control loop (control/predictive.py): feed-forward
+    # sizing off the router's burn rates + standby pre-arming +
+    # brownout admission.  Engages only for models with declared SLO
+    # objectives (KFS_SLO_*); `predictive: false` restores the pure
+    # reactive loop.
+    predictive: bool = True
+    # Control-plane burn windows (seconds, short -> long) and alert
+    # threshold for the fast-burn trigger.
+    predictive_windows_s: list = field(
+        default_factory=lambda: [10.0, 60.0])
+    burn_alert: float = 2.0
+    # Brownout exit hysteresis: short-window burn must sit below
+    # burn_exit for exit_ticks consecutive ticks per level step-down.
+    burn_exit: float = 1.0
+    exit_ticks: int = 3
 
 
 @dataclass

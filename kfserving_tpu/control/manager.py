@@ -26,6 +26,7 @@ from kfserving_tpu.control.autoscaler import Autoscaler
 from kfserving_tpu.control.clusterconfig import ClusterConfig
 from kfserving_tpu.control.controller import Controller
 from kfserving_tpu.control.orchestrator import InProcessOrchestrator
+from kfserving_tpu.control.predictive import PredictiveScaler
 from kfserving_tpu.control.rollout import RolloutManager
 from kfserving_tpu.control.router import IngressRouter
 from kfserving_tpu.control.spec import InferenceService
@@ -73,13 +74,33 @@ class ServingManager:
         self.controller = Controller(
             self.orchestrator,
             modelconfig_dir=self.cluster_config.modelconfig_dir)
+        # Predictive SLO control loop (ISSUE 12): brownout admission
+        # at the router + feed-forward sizing in the autoscaler.
+        # Constructed whenever enabled; it stays dormant until a model
+        # declares SLO objectives (KFS_SLO_*).
+        scaler_cfg = self.cluster_config.autoscaler
+        self.brownout = None
+        self.predictive = None
+        if scaler_cfg.predictive:
+            from kfserving_tpu.reliability import BrownoutController
+
+            self.brownout = BrownoutController()
         self.router = IngressRouter(self.controller,
-                                    http_port=ingress_port)
+                                    http_port=ingress_port,
+                                    brownout=self.brownout)
+        if scaler_cfg.predictive:
+            self.predictive = PredictiveScaler(
+                self.controller, self.router,
+                windows_s=tuple(scaler_cfg.predictive_windows_s),
+                burn_alert=scaler_cfg.burn_alert,
+                burn_exit=scaler_cfg.burn_exit,
+                exit_ticks=scaler_cfg.exit_ticks,
+                brownout=self.brownout)
         self.autoscaler = Autoscaler(
             self.controller, self.router,
-            target_concurrency=(
-                self.cluster_config.autoscaler.target_concurrency),
-            tick_seconds=self.cluster_config.autoscaler.tick_seconds)
+            target_concurrency=scaler_cfg.target_concurrency,
+            tick_seconds=scaler_cfg.tick_seconds,
+            predictive=self.predictive)
         # Progressive delivery: steps canaries up their RolloutPolicy
         # schedule and auto-rolls back failed revisions (no-op for
         # specs without a rollout policy).

@@ -342,11 +342,19 @@ class InferenceServiceReconciler:
         # scale up — counting creates already in flight (an orchestrator
         # swapping/recycling a replica registers it only when ready; a
         # second spawn in that window would double-own a TPU chip).
+        # Orchestrators with an armed-standby pool satisfy the
+        # increment by ACTIVATING a standby first (one-tick promotion,
+        # the PR 7 actuator the predictive autoscaler pre-arms for) —
+        # only when the pool is dry does the cold spawn pay its price.
         pending = getattr(self.orchestrator, "pending_creates",
                           lambda cid_, rev_: 0)
+        adopt = getattr(self.orchestrator, "adopt_standby", None)
         for rev, want in desired.items():
             have = len(by_rev.get(rev, [])) + pending(cid, rev)
             for _ in range(max(0, want - have)):
+                if adopt is not None and \
+                        await adopt(cid, rev) is not None:
+                    continue
                 await self.orchestrator.create_replica(
                     cid, rev, specs.get(rev, comp),
                     placement=placements.get(rev))

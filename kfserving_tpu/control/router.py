@@ -31,9 +31,11 @@ from kfserving_tpu.reliability import (
     CircuitBreaker,
     Deadline,
     FaultInjected,
+    PRIORITY_HEADER,
     TIMEOUT_HEADER,
     fault_sites,
     faults,
+    priority_tier,
 )
 from kfserving_tpu.server.http import HTTPServer, Request, Response, Router
 from kfserving_tpu.tracing import (
@@ -60,7 +62,8 @@ class IngressRouter:
                  buffer_deadline_s: Optional[float] = None,
                  breaker_factory: Optional[
                      Callable[[str], CircuitBreaker]] = None,
-                 swap_hold_max: int = 1024):
+                 swap_hold_max: int = 1024,
+                 brownout=None):
         self.controller = controller  # Controller (store + reconciler)
         self.http_port = http_port
         self.upstream_timeout_s = upstream_timeout_s or ACTIVATOR_TIMEOUT_S
@@ -81,6 +84,10 @@ class IngressRouter:
         # shedding 503s across a planned swap.
         self.swap_hold_max = swap_hold_max
         self._swap_held: Dict[str, int] = {}
+        # Brownout admission control (ISSUE 12): a BrownoutController
+        # whose per-model levels the predictive control loop sets.
+        # None = every request admitted (the pre-brownout behavior).
+        self.brownout = brownout
         self._rng = random.Random(seed)
         self._rr = {}  # component_id -> round-robin counter
         self.router = Router()
@@ -89,6 +96,12 @@ class IngressRouter:
         self._session = None
         self.inflight: Dict[str, int] = {}  # component_id -> gauge
         self.request_count: Dict[str, int] = {}
+        # OFFERED load per entry component, counted BEFORE the
+        # brownout gate: the predictive scaler's arrival signal must
+        # see shed demand, or shedding would erase the very signal
+        # that justified it (request_count stays "dispatched", the
+        # pre-ISSUE-12 meaning).
+        self.offered_count: Dict[str, int] = {}
         # One circuit breaker per replica host (KFS_ROUTER_BREAKER_*
         # knobs).  half_open_max=0: recovery is NEVER a trial request —
         # an opened breaker's host rejoins rotation only after the
@@ -503,22 +516,15 @@ class IngressRouter:
                         deadline: Optional[Deadline] = None
                         ) -> Optional[str]:
         """Scale-from-zero: bring up one replica and wait (activator
-        buffering)."""
+        buffering).  The spawn runs as a BACKGROUND task: a cold load
+        (artifact download + compile) can dwarf any request budget,
+        and the buffering request must honor its deadline — bounded
+        wait then 504 — never ride the spawn to completion.  The
+        spawn itself keeps running past the shed, so the capacity
+        still arrives for the client's retry."""
         logger.info("activating %s (scale from zero)", cid)
-        try:
-            await self.controller.reconciler.scale(isvc, cname, 1)
-        except Exception:
-            # A racing create (e.g. a recycle swap) may win the chip and
-            # fail this one — the poll below still succeeds off the
-            # winner's replica.  But if nothing else is creating one,
-            # the failure is deterministic (bad spec, storage error):
-            # fail fast instead of hanging the client for the full poll.
-            logger.exception("activation scale for %s failed", cid)
-            pending = getattr(self.controller.reconciler.orchestrator,
-                              "pending_creates", lambda c, r: 0)
-            if pending(cid, revision) == 0 and \
-                    self._pick_replica(cid, revision) is None:
-                return None
+        scale_task = asyncio.get_running_loop().create_task(
+            self.controller.reconciler.scale(isvc, cname, 1))
         # Activator buffering is bounded by BOTH the router's own
         # deadline and the request's remaining budget: parking a
         # 2s-budget request for a 60s scale-up serves nobody.
@@ -526,12 +532,49 @@ class IngressRouter:
         if deadline is not None:
             budget_s = min(budget_s, max(0.0, deadline.remaining_s()))
         until = asyncio.get_running_loop().time() + budget_s
-        while asyncio.get_running_loop().time() < until:
-            host = self._pick_replica(cid, revision)
-            if host is not None:
-                return host
-            await asyncio.sleep(0.05)
-        return None
+        try:
+            while asyncio.get_running_loop().time() < until:
+                host = self._pick_replica(cid, revision)
+                if host is not None:
+                    return host
+                if scale_task is not None and scale_task.done() and \
+                        scale_task.exception() is not None:
+                    # A racing create (e.g. a recycle swap) may win
+                    # the chip and fail this one — the poll still
+                    # succeeds off the winner's replica.  But if
+                    # nothing else is creating one, the failure is
+                    # deterministic (bad spec, storage error): fail
+                    # fast instead of hanging the client for the
+                    # full poll.
+                    logger.error("activation scale for %s failed",
+                                 cid, exc_info=scale_task.exception())
+                    pending = getattr(
+                        self.controller.reconciler.orchestrator,
+                        "pending_creates", lambda c, r: 0)
+                    if pending(cid, revision) == 0 and \
+                            self._pick_replica(cid, revision) is None:
+                        return None
+                    scale_task = None  # handled; keep polling
+                await asyncio.sleep(0.05)
+            return None
+        finally:
+            # EVERY exit (served off a racing create, budget shed,
+            # fail-fast) leaves the spawn finishing in the
+            # background for the next request; the callback keeps a
+            # late failure from dying as an unretrieved task
+            # exception.
+            if scale_task is not None and not scale_task.done():
+                scale_task.add_done_callback(
+                    self._log_late_activation)
+
+    @staticmethod
+    def _log_late_activation(task: asyncio.Task) -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:
+            logger.warning("background activation scale failed: %s",
+                           exc)
 
     # -- handlers ----------------------------------------------------------
     async def _predict(self, req: Request) -> Response:
@@ -897,6 +940,48 @@ class IngressRouter:
                                  status=upstream.status,
                                  headers=headers)
 
+    async def _brownout_gate(self, name: str, req: Request,
+                             deadline: Optional[Deadline]
+                             ) -> Optional[Response]:
+        """Admission verdict for one request: None = admitted, else
+        the shed Response.  The `router.admission` fault site sits
+        here so chaos runs can wedge/fail the admission path itself
+        (an injected error sheds exactly like a brownout verdict —
+        explicit and retriable)."""
+        tier = priority_tier(req.headers.get(PRIORITY_HEADER))
+        if faults.configured(fault_sites.ROUTER_ADMISSION):
+            try:
+                await faults.inject(fault_sites.ROUTER_ADMISSION,
+                                    key=f"{name} priority:{tier}")
+            except FaultInjected:
+                obs.brownout_shed_total().labels(
+                    model=name, reason="fault").inc()
+                return self._brownout_shed(name, "fault")
+        if self.brownout is None:
+            return None
+        remaining = (deadline.remaining_s()
+                     if deadline is not None else None)
+        admitted, reason = self.brownout.admit(name, tier, remaining)
+        if admitted:
+            return None
+        return self._brownout_shed(name, reason)
+
+    def _brownout_shed(self, name: str, reason: str) -> Response:
+        """The explicit retriable shed: clients must be able to tell
+        load management from failure, machine-readably — `retriable`
+        in the body, `Retry-After` in the headers."""
+        level = self.brownout.level(name) if self.brownout else 0
+        retry_after = max(1, int(round(
+            getattr(self.brownout, "retry_after_s", 1.0) or 1.0)))
+        body = json.dumps({
+            "error": f"brownout: request shed ({reason})",
+            "retriable": True,
+            "reason": reason,
+            "brownout_level": level,
+        }).encode()
+        return Response(body=body, status=503,
+                        headers={"retry-after": str(retry_after)})
+
     async def _proxy(self, req: Request, verb: str,
                      component: Optional[str] = None,
                      strip_prefix: str = "",
@@ -961,6 +1046,24 @@ class IngressRouter:
         # receives the REMAINING budget, not the original — time spent
         # buffered at the router must not be granted twice.
         deadline = Deadline.from_headers(headers)
+
+        # Brownout admission (ISSUE 12): while the predictive loop
+        # has a model browned out, the lowest-priority tiers — and
+        # any request whose remaining budget provably cannot cover
+        # the observed service time — shed HERE with an explicit
+        # retriable 503 + Retry-After, before occupying an upstream
+        # slot.  Health probes are never shed: readiness gating must
+        # keep seeing the truth during an overload.
+        if verb != "health":
+            isvc = self.controller.get(name)
+            if isvc is not None:
+                entry = component or self._entry_component(isvc, verb)
+                offered_key = f"router/{name}/{entry}"
+                self.offered_count[offered_key] = \
+                    self.offered_count.get(offered_key, 0) + 1
+            shed = await self._brownout_gate(name, req, deadline)
+            if shed is not None:
+                return shed
 
         failed: set = set()
         gauge_cid = None

@@ -165,11 +165,19 @@ class SubprocessOrchestrator:
         # The router holds (bounded queue, never 503) requests for a
         # component inside its announced drain->activate window.
         self.swap_announced: Dict[str, float] = {}
-        # Armed standbys ((cid, revision) -> Replica): spawned with
-        # KFS_STANDBY (imports + artifact done, device untouched),
-        # promoted on recycle or crash.
-        self._standbys: Dict[tuple, Replica] = {}
-        self._standby_spawning: set = set()
+        # Armed standbys ((cid, revision) -> [Replica, ...]): spawned
+        # with KFS_STANDBY (imports + artifact done, device
+        # untouched), promoted on recycle or crash — and, since the
+        # predictive control loop (ISSUE 12), adopted directly by
+        # scale-ups (reconciler._scale_revisions prefers an armed
+        # standby over a cold spawn).  Pool depth per component is
+        # self._standby_targets (default 1); the feed-forward
+        # autoscaler pre-arms the pool to its predicted capacity gap
+        # so the actuation cost of a traffic step is one activation,
+        # not a cold spawn.
+        self._standbys: Dict[tuple, List[Replica]] = {}
+        self._standby_spawning: Dict[tuple, int] = {}
+        self._standby_targets: Dict[str, int] = {}
         self._health_fails: Dict[int, int] = {}
         # Supervisor flight recorder: failover and swap-failure
         # timelines pinned in the control-plane process (the router
@@ -637,20 +645,98 @@ class SubprocessOrchestrator:
                 self._creating[key] = n
         self.recycle_count += 1
 
+    def _pop_standby(self, key: tuple) -> Optional[Replica]:
+        """Pop one LIVE armed standby for (cid, revision); pool
+        corpses are discarded on the way (the next maintenance tick
+        re-arms)."""
+        pool = self._standbys.get(key)
+        popped = None
+        while pool:
+            candidate = pool.pop(0)
+            if candidate.handle.process.returncode is None:
+                popped = candidate
+                break
+            logger.warning("pooled standby for %s died (rc=%s); "
+                           "discarded", key[0],
+                           candidate.handle.process.returncode)
+        if not pool:
+            self._standbys.pop(key, None)
+        self._set_pool_gauge(key[0])
+        return popped
+
+    # -- predictive pre-arming (control/predictive.py) ----------------------
+    def set_standby_target(self, component_id: str, target: int) -> None:
+        """Size the armed-standby pool for a component: the feed-
+        forward autoscaler pre-arms `target` standbys ahead of a
+        predicted capacity gap so scale-up actuates as one-tick
+        activations.  1 is the lifecycle default (crash failover
+        always wants a warm successor); the cap keeps a runaway
+        prediction from forking the host to death."""
+        target = max(1, min(int(target), 8))
+        if self._standby_targets.get(component_id, 1) != target:
+            logger.info("standby pool target for %s -> %d",
+                        component_id, target)
+        self._standby_targets[component_id] = target
+
+    def standby_target(self, component_id: str) -> int:
+        return self._standby_targets.get(component_id, 1)
+
+    def standby_count(self, component_id: str) -> int:
+        """Live armed standbys for a component (the capacity the
+        predictive loop can actuate without a spawn)."""
+        return sum(
+            1 for (cid, _rev), pool in self._standbys.items()
+            if cid == component_id
+            for r in pool if r.handle.process.returncode is None)
+
+    async def adopt_standby(self, component_id: str,
+                            revision: str) -> Optional[Replica]:
+        """Scale-up fast path: activate an armed standby into serving
+        instead of cold-spawning.  Returns the serving replica, or
+        None when no live standby exists (or activation failed — the
+        caller falls back to create_replica)."""
+        standby = self._pop_standby((component_id, revision))
+        if standby is None:
+            return None
+        key = (component_id, revision)
+        # Reservation across the activation: replicas() lists only
+        # serving processes, so without it a concurrent reconcile
+        # would double-spawn while this standby activates.
+        self._creating[key] = self._creating.get(key, 0) + 1
+        try:
+            await asyncio.wait_for(self._activate_standby(standby),
+                                   READY_TIMEOUT_S)
+        except asyncio.CancelledError:
+            await asyncio.shield(
+                self._terminate(standby.handle.process))
+            raise
+        except Exception:
+            logger.exception("standby adoption for %s failed; caller "
+                             "falls back to cold spawn", component_id)
+            await asyncio.shield(
+                self._terminate(standby.handle.process))
+            return None
+        finally:
+            n = self._creating.get(key, 1) - 1
+            if n <= 0:
+                self._creating.pop(key, None)
+            else:
+                self._creating[key] = n
+        obs.lifecycle_promotions_total().labels(
+            trigger="scale_up", outcome="promoted").inc()
+        logger.info("scale-up adopted armed standby %s for %s",
+                    standby.host, component_id)
+        return standby
+
     async def _obtain_standby(self, cid: str, revision: str, spec,
                               placement) -> Tuple[Replica, float]:
-        """An armed standby for (cid, revision): the pooled one when it
+        """An armed standby for (cid, revision): a pooled one when it
         is still alive (spawn cost already paid outside the swap), else
         a fresh spawn.  Returns (standby, spawn_seconds)."""
         loop = asyncio.get_running_loop()
-        pooled = self._standbys.pop((cid, revision), None)
-        self._set_pool_gauge(cid)
+        pooled = self._pop_standby((cid, revision))
         if pooled is not None:
-            if pooled.handle.process.returncode is None:
-                return pooled, 0.0
-            logger.warning("pooled standby for %s died (rc=%s); "
-                           "spawning a fresh one", cid,
-                           pooled.handle.process.returncode)
+            return pooled, 0.0
         t0 = loop.time()
         standby = await self.create_replica(cid, revision, spec,
                                             placement=placement,
@@ -970,11 +1056,7 @@ class SubprocessOrchestrator:
                     pass
             dead_rc = (handle.process.returncode
                        if handle is not None else None)
-            standby = self._standbys.pop((cid, rev), None)
-            self._set_pool_gauge(cid)
-            if standby is not None and \
-                    standby.handle.process.returncode is not None:
-                standby = None  # pool corpse; fall through to respawn
+            standby = self._pop_standby((cid, rev))
             # Bridge the promotion gap for waiting requests: the dead
             # replica is out of rotation and the successor is not in
             # yet.
@@ -1055,14 +1137,19 @@ class SubprocessOrchestrator:
 
     def _set_pool_gauge(self, cid: str) -> None:
         obs.lifecycle_standby_pool().labels(component=cid).set(
-            float(sum(1 for (c, _r) in self._standbys if c == cid)))
+            float(sum(len(pool)
+                      for (c, _r), pool in self._standbys.items()
+                      if c == cid)))
 
     def _maintain_standby_pool(self) -> None:
-        """Arm one standby per component (for the latest revision a
-        serving replica carries): recycles then skip the spawn phase
-        and crash promotion always has a warm successor.  Spawning
-        runs as a background task — arming must never block the
-        supervisor tick."""
+        """Arm standbys per component (for the latest revision a
+        serving replica carries) up to the component's pool target
+        (default 1; the predictive autoscaler pre-arms deeper ahead
+        of a forecast capacity gap): recycles then skip the spawn
+        phase, crash promotion always has a warm successor, and a
+        predicted traffic step actuates as activations instead of
+        cold spawns.  Spawning runs as background tasks — arming must
+        never block the supervisor tick."""
         for cid, comp in list(self.state.items()):
             if not comp.replicas:
                 continue
@@ -1071,11 +1158,14 @@ class SubprocessOrchestrator:
             if handle is None or not self._standby_capable(handle.spec):
                 continue
             key = (cid, replica.revision)
-            if key in self._standbys or key in self._standby_spawning:
-                continue
-            self._standby_spawning.add(key)
-            asyncio.ensure_future(self._arm_standby(
-                key, handle.spec, replica.placement))
+            want = self._standby_targets.get(cid, 1)
+            have = len(self._standbys.get(key, ())) + \
+                self._standby_spawning.get(key, 0)
+            for _ in range(max(0, want - have)):
+                self._standby_spawning[key] = \
+                    self._standby_spawning.get(key, 0) + 1
+                asyncio.ensure_future(self._arm_standby(
+                    key, handle.spec, replica.placement))
 
     async def _arm_standby(self, key: tuple, spec, placement) -> None:
         cid, rev = key
@@ -1086,7 +1176,11 @@ class SubprocessOrchestrator:
             logger.exception("arming standby for %s failed", cid)
             return
         finally:
-            self._standby_spawning.discard(key)
+            n = self._standby_spawning.get(key, 1) - 1
+            if n <= 0:
+                self._standby_spawning.pop(key, None)
+            else:
+                self._standby_spawning[key] = n
         comp = self.state.get(cid)
         if comp is None or not any(r.revision == rev
                                    for r in comp.replicas):
@@ -1094,35 +1188,46 @@ class SubprocessOrchestrator:
             # standby armed — reap, don't leak.
             await self._terminate(standby.handle.process)
             return
-        self._standbys[key] = standby
+        self._standbys.setdefault(key, []).append(standby)
         self._set_pool_gauge(cid)
-        logger.info("standby armed for %s rev=%s at %s", cid, rev[:8],
-                    standby.host)
+        logger.info("standby armed for %s rev=%s at %s (pool %d/%d)",
+                    cid, rev[:8], standby.host,
+                    len(self._standbys[key]),
+                    self._standby_targets.get(cid, 1))
 
     def _reap_orphan_standbys(self) -> None:
         """Standbys whose component/revision no longer serves (scale
-        to zero, canary retired, rollback) are torn down; a dead pool
-        process is dropped so the next tick re-arms."""
-        for key, standby in list(self._standbys.items()):
+        to zero, canary retired, rollback) are torn down, dead pool
+        processes are dropped (the next tick re-arms), and pools
+        deeper than their target — a pre-arm whose predicted step
+        never came, or already actuated — shrink back."""
+        for key, pool in list(self._standbys.items()):
             cid, rev = key
             comp = self.state.get(cid)
-            alive = standby.handle.process.returncode is None
             wanted = comp is not None and any(
                 r.revision == rev for r in comp.replicas)
-            if alive and wanted:
-                continue
-            self._standbys.pop(key, None)
+            want = self._standby_targets.get(cid, 1) if wanted else 0
+            keep: List[Replica] = []
+            for standby in pool:
+                alive = standby.handle.process.returncode is None
+                if alive and len(keep) < want:
+                    keep.append(standby)
+                    continue
+                if alive:
+                    asyncio.ensure_future(
+                        self._terminate(standby.handle.process))
+            if keep:
+                self._standbys[key] = keep
+            else:
+                self._standbys.pop(key, None)
             self._set_pool_gauge(cid)
-            if alive:
-                asyncio.ensure_future(
-                    self._terminate(standby.handle.process))
 
     async def reap_standbys(self, component_id: str,
                             revision: Optional[str] = None) -> None:
         """Immediate teardown hook for the reconciler/rollout: a
-        retired (or quarantined) revision's armed standby must not
+        retired (or quarantined) revision's armed standbys must not
         survive to be promoted later."""
-        for key, standby in list(self._standbys.items()):
+        for key, pool in list(self._standbys.items()):
             cid, rev = key
             if cid != component_id:
                 continue
@@ -1130,7 +1235,8 @@ class SubprocessOrchestrator:
                 continue
             self._standbys.pop(key, None)
             self._set_pool_gauge(cid)
-            await self._terminate(standby.handle.process)
+            for standby in pool:
+                await self._terminate(standby.handle.process)
 
     async def delete_replica(self, replica: Replica) -> None:
         comp = self.state.get(replica.component_id)
@@ -1164,9 +1270,10 @@ class SubprocessOrchestrator:
             self._watchdog = None
         # Armed standbys live outside self.state — reap them first or
         # they orphan (an exclusive-device orphan holds the chip).
-        for key, standby in list(self._standbys.items()):
+        for key, pool in list(self._standbys.items()):
             self._standbys.pop(key, None)
-            await self._terminate(standby.handle.process)
+            for standby in pool:
+                await self._terminate(standby.handle.process)
         for comp in list(self.state.values()):
             for replica in list(comp.replicas):
                 await self.delete_replica(replica)

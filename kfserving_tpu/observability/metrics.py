@@ -304,6 +304,55 @@ def router_request_ms():
         buckets=LATENCY_BUCKETS_MS)
 
 
+# -- predictive control loop (control/predictive.py + autoscaler) -------
+def autoscaler_tick_failures_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_autoscaler_tick_failures_total",
+        "Autoscaler ticks that raised (the control loop swallowed the "
+        "exception and kept running) — a climbing rate means the "
+        "scaling loop is silently dead")
+
+
+def autoscaler_decisions_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_autoscaler_decisions_total",
+        "Predictive control-loop decisions by component and action "
+        "(scale_up|pre_arm|brownout_enter|brownout_exit) — every one "
+        "also lands as a pinned supervisor flight-recorder record")
+
+
+def autoscaler_predicted_replicas():
+    return REGISTRY.gauge(
+        "kfserving_tpu_autoscaler_predicted_replicas",
+        "Replica count the feed-forward latency model sized for a "
+        "component at the last tick (arrival rate x observed service "
+        "time vs SLO headroom); 0 = the predictive path is not "
+        "engaged")
+
+
+def brownout_level():
+    return REGISTRY.gauge(
+        "kfserving_tpu_brownout_level",
+        "Per-model brownout level (0 = off; level N sheds priority "
+        "tiers below N with explicit retriable 503s)")
+
+
+def brownout_shed_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_brownout_shed_total",
+        "Requests the brownout admission gate shed, by model and "
+        "reason (priority = tier below the active level, deadline = "
+        "remaining budget cannot cover the observed service time, "
+        "fault = injected admission fault)")
+
+
+def brownout_transitions_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_brownout_transitions_total",
+        "Brownout level transitions per model (direction=enter|"
+        "escalate|recover|exit)")
+
+
 # -- progressive rollout ------------------------------------------------
 def revision_requests_total():
     return REGISTRY.counter(

@@ -18,12 +18,23 @@ owns those behaviors itself:
 - `CircuitBreaker` — closed/open/half-open with a rolling failure
   window; the router keeps one per replica so a sick upstream is
   skipped (and health-reprobed) instead of feeding an error storm.
+- `BrownoutController` — selective load shedding for the predicted-
+  overload case: per-model brownout levels drop the lowest priority
+  tiers first with explicit retriable 503s + Retry-After, and
+  deadline-aware admission refuses requests whose remaining budget
+  cannot cover the observed service time (control/predictive.py
+  drives entry/exit off the SLO burn rates).
 - `faults` — the injection harness that keeps the rest honest: tests
   and soak runs inject deterministic error-rate / added-latency /
   hang faults at each wrapped edge (env `KFS_FAULTS` or programmatic).
 """
 
 from kfserving_tpu.reliability.breaker import CircuitBreaker
+from kfserving_tpu.reliability.brownout import (
+    BrownoutController,
+    PRIORITY_HEADER,
+    priority_tier,
+)
 from kfserving_tpu.reliability.deadline import (
     Deadline,
     DeadlineExceeded,
@@ -36,6 +47,7 @@ from kfserving_tpu.reliability.faults import FaultInjected, faults
 from kfserving_tpu.reliability.retry import RetryPolicy
 
 __all__ = [
+    "BrownoutController", "PRIORITY_HEADER", "priority_tier",
     "CircuitBreaker",
     "Deadline", "DeadlineExceeded", "TIMEOUT_HEADER",
     "clear_deadline", "current_deadline", "deadline_scope",

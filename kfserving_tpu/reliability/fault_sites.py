@@ -41,6 +41,16 @@ SITES: Dict[str, tuple] = {
         "orchestrator.standby_activate",
         "SubprocessOrchestrator standby activation, keyed by `host "
         "cid revision:<hash>` — drives the swap-failure path"),
+    "AUTOSCALER_TICK": (
+        "autoscaler.tick",
+        "Autoscaler per-component scaling evaluation, keyed by "
+        "`<isvc>/<component>` — injected delay/failure wedges the "
+        "control loop itself (the brownout path must still engage)"),
+    "ROUTER_ADMISSION": (
+        "router.admission",
+        "IngressRouter brownout admission gate, keyed by `<model> "
+        "priority:<tier>` — injected faults shed as explicit "
+        "retriable 503s, delay stalls admission"),
 }
 
 
@@ -57,3 +67,5 @@ CLIENT_REQUEST = "client.request"
 ROUTER_DISPATCH = "router.dispatch"
 DATAPLANE_INFER = "dataplane.infer"
 ORCHESTRATOR_STANDBY_ACTIVATE = "orchestrator.standby_activate"
+AUTOSCALER_TICK = "autoscaler.tick"
+ROUTER_ADMISSION = "router.admission"

@@ -151,6 +151,18 @@ async def smoke() -> List[str]:
     obs.router_stream_failover_total().labels(
         model="metrics-probe").inc()
     obs.param_cache_total().labels(outcome="hit").inc()
+    # Predictive control-loop families (ISSUE 12): decision counters,
+    # the feed-forward sizing gauge, and the brownout trio.
+    obs.autoscaler_tick_failures_total().inc()
+    obs.autoscaler_decisions_total().labels(
+        component="default/probe/predictor", action="pre_arm").inc()
+    obs.autoscaler_predicted_replicas().labels(
+        component="default/probe/predictor").set(3.0)
+    obs.brownout_level().labels(model="metrics-probe").set(1.0)
+    obs.brownout_shed_total().labels(
+        model="metrics-probe", reason="priority").inc()
+    obs.brownout_transitions_total().labels(
+        model="metrics-probe", direction="enter").inc()
     problems: List[str] = []
     if resp.status != 200:
         problems.append(

@@ -352,8 +352,9 @@ async def test_crash_promotion_within_one_tick(tmp_path):
     cid = "default/crash/predictor"
     replica = await orch.create_replica(cid, "rev1", spec)
     try:
-        standby = await _wait_for(
+        pool = await _wait_for(
             lambda: orch._standbys.get((cid, "rev1")))
+        standby = pool[0]
         os.kill(replica.handle.process.pid, signal.SIGKILL)
         await _wait_for(lambda: orch.promotions >= 1, timeout_s=30.0)
         reps = orch.replicas(cid)

@@ -1,0 +1,552 @@
+"""Predictive SLO control loop (ISSUE 12): feed-forward sizing,
+standby pre-arming, brownout admission, and the control loop's own
+failure visibility.
+
+Strategy mirrors the repo's control-plane testing: pure-logic units
+against synthetic series / fake orchestrators, plus in-process
+end-to-end acceptance (real router sockets, no TPU).  The chaos-marked
+acceptance drives the WHOLE loop with injected latency: burn rate ->
+pre-arm -> adoption -> brownout entry -> automatic exit, asserted via
+the pinned decision records.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from kfserving_tpu.control.autoscaler import Autoscaler
+from kfserving_tpu.control.controller import Controller
+from kfserving_tpu.control.orchestrator import (
+    FakeOrchestrator,
+    InProcessOrchestrator,
+    Replica,
+    _ComponentState,
+)
+from kfserving_tpu.control.predictive import (
+    PredictiveScaler,
+    ensure_flight_recorder,
+)
+from kfserving_tpu.control.router import IngressRouter
+from kfserving_tpu.control.spec import InferenceService, PredictorSpec
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.observability import metrics as obs
+from kfserving_tpu.observability.monitoring.slo import SLOObjective
+from kfserving_tpu.reliability import (
+    BrownoutController,
+    PRIORITY_HEADER,
+    faults,
+    priority_tier,
+)
+from tests.utils import http_request
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+
+
+def _isvc(name="m", **kw):
+    kw.setdefault("framework", "sklearn")
+    kw.setdefault("storage_uri", "file:///models/m")
+    return InferenceService(name=name,
+                            predictor=PredictorSpec(**kw))
+
+
+class _EchoModel(Model):
+    def __init__(self, name, service_s=0.0, load_s=0.0):
+        super().__init__(name)
+        self.service_s = service_s
+        self.load_s = load_s
+
+    def load(self):
+        if self.load_s:
+            time.sleep(self.load_s)  # runs in the loader executor
+        self.ready = True
+        return True
+
+    async def predict(self, request):
+        if self.service_s:
+            await asyncio.sleep(self.service_s)
+        return {"predictions": [1]}
+
+
+# ------------------------------------------------- brownout controller --
+def test_priority_tier_parsing():
+    assert priority_tier(None) == 1
+    assert priority_tier("batch") == 0
+    assert priority_tier("CRITICAL") == 2
+    assert priority_tier("gibberish") == 1  # degrades to normal
+
+
+def test_brownout_levels_shed_lowest_tier_first():
+    br = BrownoutController()
+    assert br.admit("m", 0) == (True, None)  # level 0: everything in
+    assert br.set_level("m", 1) == "enter"
+    assert br.admit("m", 0) == (False, "priority")  # batch shed
+    assert br.admit("m", 1) == (True, None)         # normal admitted
+    assert br.set_level("m", 2) == "escalate"
+    assert br.admit("m", 1) == (False, "priority")  # normal shed
+    assert br.admit("m", 2) == (True, None)         # critical survives
+    assert br.set_level("m", 1) == "recover"
+    assert br.set_level("m", 0) == "exit"
+    assert br.set_level("m", 0) is None  # no transition twice
+    assert br.admit("m", 0) == (True, None)
+
+
+def test_brownout_deadline_aware_admission():
+    """While browned out, a request whose remaining budget cannot
+    cover the observed service time never occupies a slot."""
+    br = BrownoutController()
+    br.update_estimate("m", 0.5)
+    # No brownout: the deadline rule does not engage.
+    assert br.admit("m", 2, remaining_budget_s=0.1) == (True, None)
+    br.set_level("m", 1)
+    assert br.admit("m", 2, remaining_budget_s=0.1) == \
+        (False, "deadline")
+    assert br.admit("m", 2, remaining_budget_s=2.0) == (True, None)
+    assert br.admit("m", 2, remaining_budget_s=None) == (True, None)
+
+
+# ---------------------------------------------------- sizing math ------
+def _feed_series(router, pred, *, rps=100, latency_ms=400.0,
+                 ticks=6, tick_s=0.5, model="m",
+                 component="predictor"):
+    """Synthesize the router-side series the predictive loop reads:
+    offered-arrival counters + per-revision latency samples."""
+    t = 1000.0
+    for i in range(ticks):
+        key = f"router/{model}/{component}"
+        router.offered_count[key] = int((i + 1) * rps * tick_s)
+        for _ in range(20):
+            obs.revision_requests_total().labels(
+                model=model, revision="r1", status="200").inc()
+            obs.revision_request_ms().labels(
+                model=model, revision="r1").observe(latency_ms)
+        pred.observe(now=t)
+        t += tick_s
+    return t
+
+
+async def test_predictive_sizing_from_little_law():
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    isvc = _isvc(min_replicas=1, max_replicas=8,
+                 container_concurrency=2)
+    await c.apply(isvc)
+    router = IngressRouter(c)  # not started: series fed directly
+    pred = PredictiveScaler(
+        c, router,
+        objectives={"m": SLOObjective("m", latency_ms=100.0)},
+        windows_s=(1.0, 5.0), burn_alert=2.0)
+    _feed_series(router, pred, rps=100, latency_ms=400.0)
+    fast, rates = pred.burn_state("m")
+    assert fast and rates["latency"]["1"] > 2.0
+    assert pred.arrival_rate("router/m/predictor") == pytest.approx(
+        100.0, rel=0.05)
+    # 400ms samples land in the 500ms bucket: midpoint mean 375ms.
+    assert pred.service_estimate_s("m") == pytest.approx(0.375,
+                                                         rel=0.01)
+    n = pred.desired_replicas("m", isvc, "predictor", isvc.predictor,
+                              "default/m/predictor", 1)
+    # ceil(100 * 0.375 / (0.8 * 2)) = 24, clamped to max_replicas.
+    assert pred._plans["default/m/predictor"]["required"] == 24
+    assert n == 8
+    # The sizing decision is recorded and counted.
+    kinds = [d["kind"] for d in pred.decisions]
+    assert "predictive_scaling" in kinds
+
+
+async def test_predictive_stays_out_without_fast_burn():
+    """Healthy latency -> no burn -> the reactive signal rules alone
+    (desired 0), no decisions recorded."""
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    isvc = _isvc(min_replicas=1, max_replicas=8,
+                 container_concurrency=2)
+    await c.apply(isvc)
+    router = IngressRouter(c)
+    pred = PredictiveScaler(
+        c, router,
+        objectives={"m": SLOObjective("m", latency_ms=100.0)},
+        windows_s=(1.0, 5.0), burn_alert=2.0)
+    _feed_series(router, pred, rps=100, latency_ms=5.0)
+    fast, _ = pred.burn_state("m")
+    assert not fast
+    n = pred.desired_replicas("m", isvc, "predictor", isvc.predictor,
+                              "default/m/predictor", 1)
+    assert n == 0
+    assert pred.decisions == []
+
+
+async def test_chain_joint_provisioning_floors_downstream_arrival():
+    """The transformer's arrival rate floors the predictor's: the
+    pipeline is provisioned jointly, not per component."""
+    from kfserving_tpu.control.spec import TransformerSpec
+
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    isvc = _isvc(name="chain", min_replicas=1, max_replicas=8,
+                 container_concurrency=2)
+    isvc.transformer = TransformerSpec(min_replicas=1, max_replicas=8,
+                                       container_concurrency=2,
+                                       command=["true"])
+    await c.apply(isvc)
+    router = IngressRouter(c)
+    pred = PredictiveScaler(
+        c, router,
+        objectives={"chain": SLOObjective("chain", latency_ms=100.0)},
+        windows_s=(1.0, 5.0), burn_alert=2.0)
+    # All measured arrival lands on the ENTRY (transformer); the
+    # predictor has seen nothing yet.
+    _feed_series(router, pred, rps=100, latency_ms=400.0,
+                 model="chain", component="transformer")
+    assert pred.arrival_rate("router/chain/predictor") == 0.0
+    n = pred.desired_replicas(
+        "chain", isvc, "predictor", isvc.predictor,
+        "default/chain/predictor", 1)
+    assert n == 8  # sized from the transformer's arrival
+
+
+# ------------------------------------------- pre-arm + adoption --------
+async def test_pre_arm_sets_standby_target_and_records():
+    class _PoolOrch(FakeOrchestrator):
+        def __init__(self):
+            super().__init__()
+            self.targets = {}
+
+        def set_standby_target(self, cid, target):
+            self.targets[cid] = target
+
+        def standby_count(self, cid):
+            return 0
+
+    orch = _PoolOrch()
+    c = Controller(orch)
+    isvc = _isvc(min_replicas=1, max_replicas=8,
+                 container_concurrency=2)
+    await c.apply(isvc)
+    router = IngressRouter(c)
+    pred = PredictiveScaler(
+        c, router,
+        objectives={"m": SLOObjective("m", latency_ms=100.0)},
+        windows_s=(1.0, 5.0), burn_alert=2.0)
+    _feed_series(router, pred, rps=100, latency_ms=400.0)
+    cid = "default/m/predictor"
+    pred.desired_replicas("m", isvc, "predictor", isvc.predictor,
+                          cid, 1)
+    assert orch.targets[cid] == 23  # required 24 - current 1
+    pre_arms = [d for d in pred.decisions
+                if d["action"] == "pre_arm"]
+    assert pre_arms and pre_arms[0]["standby_target"] == 23
+    # The decision is pinned into the supervisor flight recorder.
+    recorder = ensure_flight_recorder(orch)
+    pinned = recorder.dump(limit=10, pinned_only=True)["pinned"]
+    assert any(e.get("kind") == "predictive_scaling" for e in pinned)
+    # Spike over, burn calm, loop disengages: the pre-armed depth is
+    # handed back to the backend default (0 = "your own floor") —
+    # one transient spike must not park warm processes at peak depth
+    # forever.
+    _feed_series(router, pred, rps=1, latency_ms=1.0, ticks=12)
+    pred.desired_replicas("m", isvc, "predictor", isvc.predictor,
+                          cid, 1)
+    assert orch.targets[cid] == 0
+
+
+async def test_scale_up_adopts_armed_standby_before_cold_spawn():
+    """Reconciler scale-ups consume the armed pool first — the
+    satellite's 'standby short-circuits the cold spawn'."""
+    class _AdoptOrch(FakeOrchestrator):
+        def __init__(self):
+            super().__init__()
+            self.pool = []
+            self.creates = 0
+            self.adopted = 0
+
+        async def adopt_standby(self, cid, revision):
+            if not self.pool:
+                return None
+            replica = self.pool.pop()
+            replica = Replica(cid, revision, replica)
+            self.state.setdefault(
+                cid, _ComponentState()).replicas.append(replica)
+            self.adopted += 1
+            return replica
+
+        async def create_replica(self, *a, **kw):
+            self.creates += 1
+            return await super().create_replica(*a, **kw)
+
+    orch = _AdoptOrch()
+    c = Controller(orch)
+    isvc = _isvc(min_replicas=1, max_replicas=8)
+    await c.apply(isvc)
+    assert orch.creates == 1  # the floor replica cold-spawned
+    orch.pool = ["standby-host:1", "standby-host:2"]
+    await c.reconciler.scale(isvc, "predictor", 4)
+    # 3 new replicas wanted: 2 adopted from the pool, 1 cold spawn.
+    assert orch.adopted == 2
+    assert orch.creates == 2
+    assert len(orch.replicas("default/m/predictor")) == 4
+
+
+async def test_inprocess_standby_pool_arms_and_adopts():
+    """The in-process backend's warm pool end to end: pre-arm builds
+    replicas outside rotation, scale-up enters them in one tick."""
+    orch = InProcessOrchestrator(
+        model_factory=lambda cid, spec: _EchoModel("m"))
+    c = Controller(orch)
+    isvc = _isvc(min_replicas=1, max_replicas=4)
+    await c.apply(isvc)
+    cid = "default/m/predictor"
+    try:
+        orch.set_standby_target(cid, 2)
+        for _ in range(100):
+            if orch.standby_count(cid) >= 2:
+                break
+            await asyncio.sleep(0.02)
+        assert orch.standby_count(cid) == 2
+        assert len(orch.replicas(cid)) == 1  # pool is NOT rotation
+        await c.reconciler.scale(isvc, "predictor", 3)
+        assert len(orch.replicas(cid)) == 3
+        assert orch.standby_adoptions == 2
+        assert orch.standby_count(cid) == 0
+    finally:
+        await orch.shutdown()
+
+
+# ------------------------------ scale-to-zero burst (satellite) --------
+async def test_cold_spawn_buffering_honors_deadline_budget():
+    """A burst request that finds zero replicas while the cold spawn
+    is slow sheds with a bounded-wait 504 inside its budget — never
+    an unbounded hang riding the spawn."""
+    orch = InProcessOrchestrator(
+        model_factory=lambda cid, spec: _EchoModel("zero",
+                                                   load_s=3.0))
+    c = Controller(orch)
+    router = IngressRouter(c)
+    await router.start_async()
+    try:
+        isvc = _isvc(name="zero")
+        isvc.predictor.min_replicas = 0
+        await c.apply(isvc)
+        assert orch.replicas("default/zero/predictor") == []
+        t0 = time.perf_counter()
+        status, _, body = await http_request(
+            router.http_port, "POST", "/v1/models/zero:predict",
+            json.dumps({"instances": [[1.0]]}).encode(),
+            headers={"x-request-timeout-ms": "300"})
+        elapsed = time.perf_counter() - t0
+        assert status == 504
+        assert elapsed < 2.0  # bounded by the budget, not the spawn
+        # The spawn keeps finishing in the background: capacity
+        # arrives for the retry.
+        for _ in range(200):
+            if orch.replicas("default/zero/predictor"):
+                break
+            await asyncio.sleep(0.05)
+        assert orch.replicas("default/zero/predictor")
+    finally:
+        await router.stop_async()
+        await orch.shutdown()
+
+
+# ------------------------------------------- router brownout gate ------
+async def test_router_brownout_sheds_retriable_by_priority():
+    orch = InProcessOrchestrator(
+        model_factory=lambda cid, spec: _EchoModel("m"))
+    c = Controller(orch)
+    brownout = BrownoutController()
+    router = IngressRouter(c, brownout=brownout)
+    await router.start_async()
+    try:
+        await c.apply(_isvc(min_replicas=1))
+        body = json.dumps({"instances": [[1.0]]}).encode()
+        brownout.set_level("m", 1)
+        status, headers, payload = await http_request(
+            router.http_port, "POST", "/v1/models/m:predict", body,
+            headers={PRIORITY_HEADER: "batch"})
+        assert status == 503
+        shed = json.loads(payload)
+        assert shed["retriable"] is True
+        assert shed["reason"] == "priority"
+        assert shed["brownout_level"] == 1
+        assert headers.get("retry-after") == "1"
+        # Normal and critical tiers pass at level 1.
+        for tier in ("normal", "critical"):
+            status, _, _ = await http_request(
+                router.http_port, "POST", "/v1/models/m:predict",
+                body, headers={PRIORITY_HEADER: tier})
+            assert status == 200
+        # Deadline-aware: a browned-out model refuses a request whose
+        # budget cannot cover the observed service time.
+        brownout.update_estimate("m", 5.0)
+        status, _, payload = await http_request(
+            router.http_port, "POST", "/v1/models/m:predict", body,
+            headers={PRIORITY_HEADER: "critical",
+                     "x-request-timeout-ms": "100"})
+        assert status == 503
+        assert json.loads(payload)["reason"] == "deadline"
+        # Exit readmits everything.
+        brownout.set_level("m", 0)
+        status, _, _ = await http_request(
+            router.http_port, "POST", "/v1/models/m:predict", body,
+            headers={PRIORITY_HEADER: "batch"})
+        assert status == 200
+    finally:
+        await router.stop_async()
+        await orch.shutdown()
+
+
+@pytest.mark.chaos
+async def test_router_admission_fault_site_sheds_retriable():
+    """An injected fault at `router.admission` sheds exactly like a
+    brownout verdict: explicit and retriable."""
+    orch = InProcessOrchestrator(
+        model_factory=lambda cid, spec: _EchoModel("m"))
+    c = Controller(orch)
+    router = IngressRouter(c)  # no brownout controller needed
+    await router.start_async()
+    try:
+        await c.apply(_isvc(min_replicas=1))
+        body = json.dumps({"instances": [[1.0]]}).encode()
+        faults.configure(
+            {"router.admission": {"error_rate": 1.0,
+                                  "match": "priority:0"}})
+        status, _, payload = await http_request(
+            router.http_port, "POST", "/v1/models/m:predict", body,
+            headers={PRIORITY_HEADER: "batch"})
+        assert status == 503
+        assert json.loads(payload)["reason"] == "fault"
+        assert json.loads(payload)["retriable"] is True
+        # The match scopes the chaos: other tiers are untouched.
+        status, _, _ = await http_request(
+            router.http_port, "POST", "/v1/models/m:predict", body,
+            headers={PRIORITY_HEADER: "critical"})
+        assert status == 200
+    finally:
+        await router.stop_async()
+        await orch.shutdown()
+
+
+# --------------------------------- tick-failure visibility (satellite) --
+@pytest.mark.chaos
+async def test_autoscaler_tick_failures_counted_and_pinned():
+    """A control loop that keeps failing must become visible: the
+    failure counter climbs and after STALL_TICKS consecutive failures
+    a pinned supervisor flight-recorder entry appears."""
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    await c.apply(_isvc(min_replicas=1))
+    router = IngressRouter(c)
+    scaler = Autoscaler(c, router, tick_seconds=0.01)
+    faults.configure({"autoscaler.tick": {"error_rate": 1.0}})
+    await scaler.start()
+    try:
+        for _ in range(200):
+            if scaler._consecutive_failures >= 3:
+                break
+            await asyncio.sleep(0.01)
+    finally:
+        await scaler.stop()
+    assert scaler._consecutive_failures >= 3
+    counter = obs.autoscaler_tick_failures_total().labels()
+    assert counter.value >= 3
+    recorder = ensure_flight_recorder(orch)
+    pinned = recorder.dump(limit=10, pinned_only=True)["pinned"]
+    stalls = [e for e in pinned
+              if e.get("kind") == "autoscaler_stalled"]
+    assert stalls and stalls[0]["consecutive_failures"] >= 3
+
+
+# ----------------------------------------- end-to-end acceptance -------
+@pytest.mark.chaos
+async def test_predictive_loop_acceptance_burn_to_brownout_and_back():
+    """The whole loop under fault-injected latency: burn rate trips ->
+    feed-forward sizing + pre-arm -> brownout entry (retriable sheds
+    at the router) -> fault lifted, burn recovers -> automatic exit.
+    Asserted through the pinned decision records and the federated
+    /debug/flightrecorder supervisor view."""
+    orch = InProcessOrchestrator(
+        model_factory=lambda cid, spec: _EchoModel("hot",
+                                                   service_s=0.01))
+    c = Controller(orch)
+    brownout = BrownoutController()
+    router = IngressRouter(c, brownout=brownout)
+    pred = PredictiveScaler(
+        c, router,
+        objectives={"hot": SLOObjective("hot", latency_ms=25.0)},
+        windows_s=(0.4, 2.0), burn_alert=2.0, burn_exit=1.0,
+        exit_ticks=2, brownout=brownout)
+    scaler = Autoscaler(c, router, tick_seconds=0.05,
+                        predictive=pred)
+    await router.start_async()
+    await scaler.start()
+    body = json.dumps({"instances": [[1.0]]}).encode()
+
+    async def drive(n, tier="normal", delay=0.005):
+        # Concurrent burst: the offered arrival rate must exceed the
+        # component's capacity for the plan to see a gap (a serial
+        # driver self-limits to the service rate).
+        async def one():
+            status, _, payload = await http_request(
+                router.http_port, "POST", "/v1/models/hot:predict",
+                body, headers={PRIORITY_HEADER: tier})
+            return status, payload
+        tasks = []
+        for _ in range(n):
+            tasks.append(asyncio.ensure_future(one()))
+            await asyncio.sleep(delay)
+        return await asyncio.gather(*tasks)
+
+    try:
+        isvc = _isvc(name="hot", min_replicas=1, max_replicas=2,
+                     container_concurrency=2)
+        await c.apply(isvc)
+        await drive(5)  # healthy baseline
+        # Injected latency blows the 25ms objective on every request.
+        faults.configure(
+            {"dataplane.infer": {"latency_ms": 200.0,
+                                 "match": "hot"}})
+        deadline = time.monotonic() + 15.0
+        while brownout.level("hot") == 0 and \
+                time.monotonic() < deadline:
+            await drive(8, delay=0.005)
+        assert brownout.level("hot") > 0, \
+            f"brownout never engaged; decisions={pred.decisions}"
+        # While browned out, batch traffic sheds retriable.
+        shed = await drive(3, tier="batch")
+        assert any(s == 503 and b'"retriable": true' in p
+                   for s, p in shed)
+        # Decision trail: sizing + brownout entry pinned, federated
+        # under replica="supervisor".
+        kinds = {d["kind"] for d in pred.decisions}
+        assert {"predictive_scaling", "brownout"} <= kinds
+        status, _, payload = await http_request(
+            router.http_port, "GET",
+            "/debug/flightrecorder?pinned=1&replica=supervisor", b"")
+        assert status == 200
+        pinned = json.loads(payload)["pinned"]
+        assert any(e.get("kind") == "brownout" for e in pinned)
+        assert any(e.get("kind") == "predictive_scaling"
+                   for e in pinned)
+        # Fault lifted: traffic is healthy again, demand calm -> the
+        # loop steps the brownout back out on its own.
+        faults.reset()
+        deadline = time.monotonic() + 20.0
+        while brownout.level("hot") > 0 and \
+                time.monotonic() < deadline:
+            await drive(3, tier="critical", delay=0.01)
+            await asyncio.sleep(0.05)
+        assert brownout.level("hot") == 0, \
+            f"brownout never exited; decisions={pred.decisions}"
+        exits = [d for d in pred.decisions
+                 if d.get("action") in ("brownout_exit",
+                                        "brownout_recover")]
+        assert exits
+    finally:
+        await scaler.stop()
+        await router.stop_async()
+        await orch.shutdown()

@@ -330,7 +330,7 @@ def test_fault_site_rule_flags_dead_manifest_rows():
                    "kfserving_tpu/reliability/fault_sites.py", [rule])
     dead = {f.snippet for f in rule.finalize()}
     assert "DATAPLANE_INFER" not in dead
-    assert "ROUTER_DISPATCH" in dead and len(dead) == 5
+    assert "ROUTER_DISPATCH" in dead and len(dead) == 7
 
 
 def test_fault_site_coverage_skipped_without_manifest_in_scan():
