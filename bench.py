@@ -154,6 +154,11 @@ def _compact_configs(results: dict) -> dict:
         elif name == "generate_cold4k":
             c.update(pick(r, "gap_p99_ms", "gap_p99_ms_monolithic",
                           "gap_p99_chunked_over_monolithic"))
+        elif name == "cache":
+            c.update(pick(r, "hit_rate_shared", "hit_rate_unique",
+                          "tokens_saved_consistent"))
+            c["tokens_saved"] = (r.get("shared") or {}).get(
+                "tokens_saved_total")
         elif name == "generate_stream_wire":
             c["grpc_over_sse"] = r.get("grpc_over_sse")
             c["grpc_tokens_per_s"] = (r.get("grpc") or {}).get(
@@ -194,6 +199,7 @@ def main():
         "generate_4k": C.bench_generate_4k,
         "generate_cold4k": C.bench_generate_cold4k,
         "generate_stream_wire": C.bench_generate_stream_wire,
+        "cache": C.bench_cache,
     }
     results = {}
     for name, fn in matrix.items():

@@ -80,6 +80,54 @@ def _timeline_summary() -> Dict[str, Any]:
     return out
 
 
+def _cache_summary(model) -> Dict[str, Any]:
+    """Cache economics block every generate* config commits alongside
+    the PR-6 `timeline` block (ISSUE 13 bench discipline): prefix
+    hit rate, tokens saved, eviction causes, and pool occupancy
+    p50/p99 derived from the SAME timeline counter samples the
+    Perfetto view renders — the committed JSON and /debug/profile can
+    never disagree.  Dense engines commit {"paged": false} so the
+    record says the cache was off instead of silently omitting it."""
+    from kfserving_tpu.observability.profiling import TIMELINE
+
+    stats = model.engine_stats()
+    paged = stats.get("paged")
+    if not paged:
+        return {"paged": False}
+    hits = paged.get("prefix_hits", 0)
+    misses = paged.get("prefix_misses", 0)
+    pool = paged.get("pool_blocks") or 0
+    occupancy: List[float] = []
+    for e in TIMELINE.snapshot():
+        # (start, dur, track, name, trace_id, slot, attrs)
+        if e[2] == "counter" and e[3] == "pool" and e[6] and pool:
+            # Multi-engine benches (cold4k's chunked/monolithic pair)
+            # share one process ring: only THIS engine's samples may
+            # feed this model's occupancy ratio.
+            if e[6].get("engine") not in (None, model.name):
+                continue
+            free = e[6].get("free_blocks")
+            if free is None:
+                continue
+            reclaim = e[6].get("reclaimable_blocks", 0)
+            occupancy.append(
+                min(1.0, max(0.0, (pool - free - reclaim) / pool)))
+    occ = np.asarray(occupancy or [0.0])
+    return {
+        "paged": True,
+        "hit_rate": round(hits / max(1, hits + misses), 4),
+        "prefix_hits": hits,
+        "prefix_misses": misses,
+        "tokens_saved": paged.get("prefill_tokens_saved", 0),
+        "block_size": paged.get("block_size"),
+        "index_entries": paged.get("index_entries"),
+        "evictions": paged.get("evictions"),
+        "occupancy_p50": round(float(np.percentile(occ, 50)), 4),
+        "occupancy_p99": round(float(np.percentile(occ, 99)), 4),
+        "occupancy_samples": len(occupancy),
+    }
+
+
 async def _sse_measure(session, url, body, gaps, ttfts,
                        stop_after_first=False):
     """POST a generate_stream and fold per-event arrival times into
@@ -1178,6 +1226,7 @@ async def bench_generate(smoke: bool) -> Dict[str, Any]:
         out["cache_bytes"] = models["k1"].engine_stats().get(
             "cache_bytes")
         out["timeline"] = _timeline_summary()
+        out["cache"] = _cache_summary(models[variants[2]])
         return out
     finally:
         await server.stop_async()
@@ -1398,6 +1447,7 @@ async def bench_generate_poisson(smoke: bool) -> Dict[str, Any]:
         return {
             "requests": n_req, "max_tokens": max_tokens,
             "timeline": _timeline_summary(),
+            "cache": _cache_summary(model),
             "arrival_rate_req_s": round(rate, 3),
             "repetitions": n_reps,
             "wall_s": round(sum(r["wall_s"] for r in rep_records), 2),
@@ -1525,6 +1575,7 @@ async def bench_generate_4k(smoke: bool) -> Dict[str, Any]:
         return {
             "requests": n_req, "concurrency": conc,
             "timeline": _timeline_summary(),
+            "cache": _cache_summary(model),
             "context": cfg["max_seq"],
             "block_size": cfg["block_size"],
             "pool_blocks": cfg["cache_blocks"],
@@ -1738,6 +1789,8 @@ async def bench_generate_cold4k(smoke: bool) -> Dict[str, Any]:
         out["gap_p99_ms"] = c["gap_p99_ms"]
         out["gap_p99_ms_monolithic"] = mo["gap_p99_ms"]
         out["timeline"] = _timeline_summary()
+        out["cache"] = {label: _cache_summary(m)
+                        for label, m in models.items()}
         return out
     finally:
         await server.stop_async()
@@ -1871,10 +1924,197 @@ async def bench_generate_stream_wire(smoke: bool) -> Dict[str, Any]:
                 out["grpc"]["tokens_per_s"]
                 / out["sse"]["tokens_per_s"], 3)
         out["timeline"] = _timeline_summary()
+        out["cache"] = _cache_summary(model)
         return out
     finally:
         try:
             await channel.close()
         except Exception:
             pass
+        await server.stop_async()
+
+
+async def bench_cache(smoke: bool) -> Dict[str, Any]:
+    """Shared-prefix cache & cost attribution A/B (ISSUE 13
+    acceptance): the realistic multi-user prompt mix — one common
+    system prompt + unique per-request tails — against a control arm
+    of fully unique prompts on the SAME paged model, interleaved
+    reps, median-of-N.  Evidence committed to BENCH_cache.json:
+    hit-rate > 0 on the shared arm and ~0 on the unique arm,
+    tokens-saved consistent with hit-blocks x block_size, the
+    replica's /debug/cache snapshot (index census, hot chains, pool
+    occupancy), and per-request attribution records showing the
+    cache economics land in the cost feed."""
+    import aiohttp
+
+    from kfserving_tpu.observability import attribution
+    from kfserving_tpu.predictors.llm import GenerativeModel
+
+    if smoke:
+        cfg = {
+            "arch_kwargs": {"num_layers": 2, "hidden_size": 64,
+                            "num_heads": 2, "intermediate_size": 128,
+                            "max_seq": 256},
+            "max_slots": 4, "max_seq": 256,
+            "prefill_buckets": [64, 128, 256],
+            "block_size": 32, "cache_blocks": 32,
+            "steps_per_call": 2,
+        }
+        per_wave, reps, max_tokens = 3, 3, 6
+        system_len, tail_len = 96, 16      # 3 shared blocks
+    else:
+        cfg = {
+            "arch_kwargs": {"vocab_size": 32000, "hidden_size": 768,
+                            "num_layers": 12, "num_heads": 12,
+                            "intermediate_size": 3072,
+                            "max_seq": 4096},
+            "max_slots": 8, "max_seq": 4096,
+            "prefill_buckets": [512, 4096],
+            "block_size": 128, "cache_blocks": 160,
+            "steps_per_call": int(os.environ.get("BENCH_GEN_K", "16")),
+        }
+        per_wave, reps, max_tokens = 8, 3, 32
+        system_len, tail_len = 2944, 96    # 23 shared blocks
+    arch_kwargs = cfg.pop("arch_kwargs")
+    bs = cfg["block_size"]
+    model_dir = _write_jax_model_dir(
+        "decoder_tiny" if smoke else "decoder", arch_kwargs, **cfg)
+    model = GenerativeModel("cachebench", model_dir)
+    model.load()
+    _reset_timeline()
+    attribution.clear()
+    server = await _serve([model])
+    base = f"http://127.0.0.1:{server.http_port}"
+    # Byte tokenizer: ~1 token per char; the system prompt length is
+    # block-aligned so every shared block is a FULL block (partial
+    # trailing blocks never register in the prefix index).
+    system = ("you are a careful serving assistant. " * 200)[:system_len]
+    salt = {"n": 0}
+
+    def shared_prompt():
+        salt["n"] += 1
+        return system + f" req {salt['n']:05d} " + \
+            "t" * max(1, tail_len - 11)
+
+    def unique_prompt():
+        # Salt LEADS: even the first block differs per request — a
+        # genuinely cold prompt of the same total length.
+        salt["n"] += 1
+        return f"u{salt['n']:06d} " + "u" * (system_len + tail_len - 8)
+
+    try:
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=1800)) as s:
+            async def one(prompt, ttfts):
+                body = json.dumps({"text_input": prompt,
+                                   "max_tokens": max_tokens}).encode()
+                await _sse_measure(
+                    s, f"{base}/v2/models/cachebench/generate_stream",
+                    body, [], ttfts)
+
+            # Warmup: compile the prefill bucket + decode scan + pow2
+            # prefill row buckets, and SEED the shared system prompt's
+            # blocks into the prefix index (the steady-state a real
+            # fleet serves from).
+            for n in (1, 2, min(4, per_wave)):
+                await asyncio.gather(*[
+                    one(shared_prompt(), []) for _ in range(n)])
+
+            arms = {"shared": shared_prompt, "unique": unique_prompt}
+            rep_records = {a: [] for a in arms}
+            for r_i in range(reps):
+                order = (list(arms) if r_i % 2 == 0
+                         else list(reversed(list(arms))))
+                for arm in order:
+                    pre = dict(model.engine_stats()).get("paged", {})
+                    ttfts: List[float] = []
+                    t0 = time.perf_counter()
+                    await asyncio.gather(*[
+                        one(arms[arm](), ttfts)
+                        for _ in range(per_wave)])
+                    wall = time.perf_counter() - t0
+                    post = model.engine_stats().get("paged", {})
+                    hits = (post.get("prefix_hits", 0)
+                            - pre.get("prefix_hits", 0))
+                    misses = (post.get("prefix_misses", 0)
+                              - pre.get("prefix_misses", 0))
+                    rep_records[arm].append({
+                        "wall_s": round(wall, 3),
+                        "prefix_hits": hits,
+                        "prefix_misses": misses,
+                        "hit_rate": round(
+                            hits / max(1, hits + misses), 4),
+                        "tokens_saved": (
+                            post.get("prefill_tokens_saved", 0)
+                            - pre.get("prefill_tokens_saved", 0)),
+                        "ttft_p50_ms": round(float(np.percentile(
+                            np.asarray(ttfts or [0.0]), 50)), 2),
+                    })
+            # The replica's own federable snapshot (the exact feed
+            # prefix-affinity routing reads).
+            async with s.get(f"{base}/debug/cache") as r:
+                assert r.status == 200, await r.text()
+                debug_cache = await r.json()
+
+        out: Dict[str, Any] = {
+            "requests_per_wave": per_wave, "repetitions": reps,
+            "system_prompt_tokens": system_len,
+            "shared_blocks": system_len // bs,
+            "block_size": bs,
+        }
+        for arm in arms:
+            recs = rep_records[arm]
+            med = {k: round(float(np.median([r[k] for r in recs])), 4)
+                   for k in ("hit_rate", "tokens_saved",
+                             "ttft_p50_ms")}
+            out[arm] = {
+                **med,
+                "hit_rate_reps": [r["hit_rate"] for r in recs],
+                "prefix_hits_total": sum(r["prefix_hits"]
+                                         for r in recs),
+                "prefix_misses_total": sum(r["prefix_misses"]
+                                           for r in recs),
+                "tokens_saved_total": sum(r["tokens_saved"]
+                                          for r in recs),
+                "reps": recs,
+            }
+        # Acceptance arithmetic: tokens saved must equal hit blocks x
+        # block_size on the shared arm, and the unique arm must not
+        # have hit the index at all.
+        out["hit_rate_shared"] = out["shared"]["hit_rate"]
+        out["hit_rate_unique"] = out["unique"]["hit_rate"]
+        out["tokens_saved_consistent"] = (
+            out["shared"]["tokens_saved_total"]
+            == out["shared"]["prefix_hits_total"] * bs)
+        # Attribution evidence: one costed record per arm (the shared
+        # arm's must carry cache_saved_tokens > 0, the unique arm's
+        # 0) — proof the cache economics reach the per-request feed.
+        samples = attribution.recent(limit=4 * per_wave * reps)
+        out["attribution_samples"] = {
+            "shared": next((r for r in reversed(samples)
+                            if r.get("cache_saved_tokens", 0) > 0),
+                           None),
+            "unique": next((r for r in reversed(samples)
+                            if r.get("cache_saved_tokens", 1) == 0),
+                           None),
+        }
+        out["debug_cache"] = debug_cache
+        out["timeline"] = _timeline_summary()
+        out["cache"] = _cache_summary(model)
+        record = {
+            "scenario": "shared_prefix_cache_ab",
+            "smoke": smoke,
+            **{k: out[k] for k in
+               ("requests_per_wave", "repetitions",
+                "system_prompt_tokens", "shared_blocks", "block_size",
+                "shared", "unique", "hit_rate_shared",
+                "hit_rate_unique", "tokens_saved_consistent",
+                "attribution_samples", "debug_cache", "cache")},
+        }
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        with open(os.path.join(root, "BENCH_cache.json"), "w") as f:
+            json.dump(record, f, indent=2)
+        return out
+    finally:
         await server.stop_async()

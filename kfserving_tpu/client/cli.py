@@ -9,6 +9,7 @@
     python -m kfserving_tpu.client promote NAME
     python -m kfserving_tpu.client rollouts
     python -m kfserving_tpu.client profile --window 60 -o trace.json
+    python -m kfserving_tpu.client cache [--replica HOST] [--top-k N]
 
 The reference splits this between kubectl (CRDs) and the SDK; the TPU
 build ships one client for both planes.
@@ -77,6 +78,15 @@ p_profile.add_argument("--replica", default=None,
 p_profile.add_argument("-o", "--output", default="trace.json",
                        help="file to write the trace to (load it at "
                             "ui.perfetto.dev)")
+
+p_cache = sub.add_parser(
+    "cache",
+    help="fleet cache & cost snapshot (per-replica prefix-index "
+         "census, hot chains, pool/HBM occupancy)")
+p_cache.add_argument("--replica", default=None,
+                     help="narrow to one replica host:port")
+p_cache.add_argument("--top-k", type=int, default=None,
+                     help="hot chains per model (default 10)")
 
 p_creds = sub.add_parser(
     "credentials",
@@ -163,6 +173,9 @@ async def _run(args) -> dict:
             return await c.promote(args.name, ns)
         if args.command == "rollouts":
             return await c.rollouts()
+        if args.command == "cache":
+            return await c.cache(replica=args.replica,
+                                 top_k=args.top_k)
         if args.command == "profile":
             trace = await c.profile(window_s=args.window,
                                     replica=args.replica)

@@ -195,6 +195,23 @@ class KFServingClient:
         return await self._request(
             "GET", f"{self._ingress()}/debug/profile?{qs}")
 
+    async def cache(self, replica: Optional[str] = None,
+                    top_k: Optional[int] = None) -> Dict[str, Any]:
+        """Fetch the fleet's federated cache snapshot from the ingress
+        router: per-replica prefix-index census (entry count,
+        reuse-depth distribution, top-K hot chains), block-pool
+        occupancy, and HBM residency — the observability feed
+        prefix-affinity routing consumes.  `replica` narrows to one
+        host; `top_k` bounds the hot-chain list."""
+        params = []
+        if replica:
+            params.append(f"replica={replica}")
+        if top_k is not None:
+            params.append(f"top_k={int(top_k)}")
+        qs = ("?" + "&".join(params)) if params else ""
+        return await self._request(
+            "GET", f"{self._ingress()}/debug/cache{qs}")
+
     # -- readiness (reference wait_isvc_ready, kf_serving_client.py:232+) ---
     async def wait_isvc_ready(self, name: str, namespace: str = "default",
                               timeout_seconds: float = 120.0,

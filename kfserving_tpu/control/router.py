@@ -160,6 +160,11 @@ class IngressRouter:
         # replica its own Perfetto process group (?replica= narrows to
         # one host; window_s/format pass through).
         r.add("GET", "/debug/profile", self._debug_profile)
+        # Cache & cost attribution federation (ISSUE 13): every
+        # replica's /debug/cache snapshot keyed under the `replica`
+        # label — the feed prefix-affinity routing (ROADMAP item 3)
+        # and the HBM residency manager (item 4) will consume.
+        r.add("GET", "/debug/cache", self._debug_cache)
         # Progressive-delivery status (ISSUE 4): active rollouts,
         # recent promotions/rollbacks with pinned evidence, and the
         # quarantine ledger.
@@ -799,6 +804,44 @@ class IngressRouter:
             }).encode())
         return Response(json.dumps(merge_traces(
             [(host, body) for host, body in scraped])).encode())
+
+    async def _debug_cache(self, req: Request) -> Response:
+        """Federated cache view: each replica's /debug/cache body
+        under its `replica` host key, plus a fleet rollup (index
+        entries, hit totals) so one scrape answers "where are the warm
+        prefixes".  ?replica= narrows to one host; ?top_k= passes
+        through to the replicas' hot-chain census."""
+        only = req.query.get("replica")
+        top_k = req.query.get("top_k")
+        if top_k is not None:
+            try:
+                int(top_k)
+            except ValueError:
+                return Response(
+                    b'{"error": "top_k must be an integer"}',
+                    status=400)
+        hosts = [only] if only else self._replica_hosts()
+        qs = f"?top_k={top_k}" if top_k else ""
+        replicas: Dict[str, dict] = {}
+        totals = {"index_entries": 0, "prefix_hits": 0,
+                  "prefix_misses": 0, "prefill_tokens_saved": 0}
+        for host, body in await self._scrape_json_all(
+                hosts, f"/debug/cache{qs}"):
+            replicas[host] = body
+            for snap in (body.get("models") or {}).values():
+                if not snap.get("paged"):
+                    continue
+                totals["index_entries"] += snap.get("index_entries", 0)
+                pool = snap.get("pool") or {}
+                totals["prefix_hits"] += pool.get("prefix_hits", 0)
+                totals["prefix_misses"] += pool.get(
+                    "prefix_misses", 0)
+                totals["prefill_tokens_saved"] += pool.get(
+                    "prefill_tokens_saved", 0)
+        return Response(json.dumps({
+            "replicas": replicas,
+            "fleet": totals,
+        }).encode())
 
     async def _debug_flightrecorder(self, req: Request) -> Response:
         """Federated flight-recorder dump: each replica's entries and

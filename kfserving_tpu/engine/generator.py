@@ -68,6 +68,7 @@ from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from kfserving_tpu.observability import attribution
 from kfserving_tpu.observability import metrics as obs
 from kfserving_tpu.observability.profiling import TIMELINE
 from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
@@ -102,6 +103,18 @@ class _Request:
     trace_id: Optional[str] = None
     submit_t: float = 0.0
     last_emit_t: Optional[float] = None
+    # -- cost attribution (observability/attribution.py): accumulated
+    # by the scheduler across the request's whole life (preemptions
+    # included), finalized into ONE record at the terminal event.
+    # Device ms are the request's EVEN SHARE of each dispatch's busy
+    # interval — additive, so per-request costs sum to engine device
+    # time instead of multiply-counting shared waves.
+    prefill_device_ms: float = 0.0
+    decode_device_ms: float = 0.0
+    tokens_out: int = 0
+    blocks_held: int = 0          # peak slot-table blocks (paged)
+    cache_hit_blocks: int = 0     # prompt blocks served by the index
+    cache_saved_tokens: int = 0   # hit blocks x block_size
 
 
 @dataclass
@@ -271,6 +284,16 @@ class GenerationEngine:
             self._prefix_index: Dict[bytes, int] = {}
             self._block_chain: Dict[int, bytes] = {}
             self._reclaimable: "OrderedDict[int, None]" = OrderedDict()
+            # Hits per LIVE index entry (reuse depth): the /debug/cache
+            # census and the hot-chain top-K read this; entries drop
+            # with their index entry on eviction/invalidation.
+            self._chain_hits: Dict[bytes, int] = {}
+            # Eviction accounting by cause (registry twin:
+            # kfserving_tpu_generator_block_evictions_total).
+            self.block_evictions: Dict[str, int] = {
+                "capacity": 0, "index_invalidation": 0,
+                "zombie_deferral": 0}
+            self.prefill_tokens_saved = 0
             # (release_at_decode_step, [block ids]) — see
             # _free_slot_state for why release is deferred.
             self._deferred_frees: deque = deque()
@@ -710,6 +733,7 @@ class GenerationEngine:
         try:
             self._pending.remove(req)
             req.out.put_nowait((None, "cancelled"))
+            self._finalize_cost(req, "cancelled")
             self.requests_finished += 1
             return
         except ValueError:
@@ -719,6 +743,7 @@ class GenerationEngine:
                 self._free_slot_state(i)
                 self.requests_finished += 1
                 req.out.put_nowait((None, "cancelled"))
+                self._finalize_cost(req, "cancelled")
                 return
         # Neither pending nor active: either already finished (no-op)
         # or mid-prefill on the executor — the install step checks
@@ -897,13 +922,39 @@ class GenerationEngine:
                 if padded > 0}
         if self.block_size is not None:
             with self._block_lock:
+                refd = int(np.sum(self._block_ref > 0))
+                resident = sum(s.length for s in self._slots
+                               if s is not None)
+                # Fragmentation over per-slot TABLE blocks, not refd:
+                # a shared prefix block appears in every sharer's
+                # table AND every sharer's length, so numerator and
+                # denominator count it the same number of times —
+                # against refd (which counts it once) the ratio went
+                # negative exactly in the shared-prompt regime.
+                table_blocks = int(np.sum(self._tables >= 0))
+                frag = (1.0 - resident
+                        / (table_blocks * self.block_size)
+                        if table_blocks else 0.0)
                 out["paged"] = {
                     "block_size": self.block_size,
                     "pool_blocks": self.num_blocks,
+                    # Canonical names, matching the timeline pool
+                    # counter samples (_record_pool_sample).  The
+                    # blocks_* spellings below are DEPRECATED aliases
+                    # kept for one release (ISSUE 13 satellite).
+                    "free_blocks": len(self._free_blocks),
+                    "reclaimable_blocks": len(self._reclaimable),
                     "blocks_free": len(self._free_blocks),
                     "blocks_reclaimable": len(self._reclaimable),
                     "prefix_hits": self.prefix_hits,
                     "prefix_misses": self.prefix_misses,
+                    "prefill_tokens_saved": self.prefill_tokens_saved,
+                    "index_entries": len(self._prefix_index),
+                    "pool_occupancy_ratio": round(
+                        min(1.0, refd / max(1, self.num_blocks)), 4),
+                    "fragmentation_ratio": round(
+                        min(1.0, max(0.0, frag)), 4),
+                    "evictions": dict(self.block_evictions),
                     "preemptions": self.preemptions,
                 }
             if self.prefill_chunk_tokens is not None:
@@ -914,6 +965,44 @@ class GenerationEngine:
                     "chunks_skipped_shared": self.prefill_chunks_skipped,
                 }
         return out
+
+    def cache_debug(self, top_k: int = 10) -> Dict[str, Any]:
+        """The per-replica `GET /debug/cache` body: prefix-index
+        census (entry count, reuse-depth distribution, top-K hot
+        chains by hit count) plus the pool occupancy snapshot — the
+        exact feed prefix-affinity routing (ROADMAP item 3) and the
+        LRU HBM residency manager (item 4) will read, federated by
+        the router under the `replica` label."""
+        if self.block_size is None:
+            return {"paged": False}
+        with self._block_lock:
+            census = {chain: self._chain_hits.get(chain, 0)
+                      for chain in self._prefix_index}
+        depths = sorted(census.values())
+
+        def pct(q: float) -> int:
+            if not depths:
+                return 0
+            return depths[min(len(depths) - 1, int(len(depths) * q))]
+
+        hot = sorted(census.items(), key=lambda kv: (-kv[1], kv[0]))
+        hot = hot[:max(0, int(top_k))]
+        return {
+            "paged": True,
+            "index_entries": len(census),
+            "reuse_depth": {
+                "p50": pct(0.50),
+                "p99": pct(0.99),
+                "max": depths[-1] if depths else 0,
+                "mean": (round(sum(depths) / len(depths), 3)
+                         if depths else 0.0),
+            },
+            "hot_chains": [{"chain": chain.hex(), "hits": hits}
+                           for chain, hits in hot],
+            # stats() re-takes the block lock — called OUTSIDE the
+            # census hold above.
+            "pool": self.stats()["paged"],
+        }
 
     # -- paged-cache bookkeeping -------------------------------------------
     # All mutation happens under _block_lock: the enqueue thread
@@ -935,6 +1024,12 @@ class GenerationEngine:
                 # a concurrent duplicate admission may have re-pointed
                 # the chain at a different (still-resident) block.
                 self._prefix_index.pop(chain, None)
+                self._chain_hits.pop(chain, None)
+            self.block_evictions["capacity"] += 1
+            obs.generator_block_evictions_total().labels(
+                model=self.name, cause="capacity").inc()
+            TIMELINE.record("host", "cache.evict",
+                            attrs={"cause": "capacity", "block": blk})
             return blk
         return None
 
@@ -963,9 +1058,28 @@ class GenerationEngine:
         if self.block_size is None:
             return
         with self._block_lock:
+            dropped = 0
             for chain, blk in self._plan_regs.pop(slot, []):
-                self._prefix_index.pop(chain, None)
+                if self._prefix_index.pop(chain, None) is not None:
+                    dropped += 1
+                    self._chain_hits.pop(chain, None)
                 self._block_chain.pop(blk, None)
+            self._count_invalidations_locked(dropped)
+
+    def _count_invalidations_locked(self, dropped: int) -> None:
+        """Account `dropped` prefix-index entries removed because
+        their planned writes never dispatched (plan rollback / enqueue
+        failure) — a stale chain surviving here is the share-unwritten-
+        blocks bug class, so the count is the telemetry proof the
+        invalidation path ran."""
+        if dropped <= 0:
+            return
+        self.block_evictions["index_invalidation"] += dropped
+        obs.generator_block_evictions_total().labels(
+            model=self.name, cause="index_invalidation").inc(dropped)
+        TIMELINE.record("host", "cache.evict",
+                        attrs={"cause": "index_invalidation",
+                               "entries": dropped})
 
     def _confirm_plan(self, slot: int) -> None:
         """The slot's prefill is enqueued: its registrations are
@@ -993,16 +1107,25 @@ class GenerationEngine:
     def _process_deferred_frees(self, force: bool = False) -> None:
         if self.block_size is None:
             return
+        released = 0
         while self._deferred_frees and (
                 force or self._deferred_frees[0][0] <= self.decode_steps):
             _, blocks = self._deferred_frees.popleft()
+            released += len(blocks)
             with self._block_lock:
                 for blk in blocks:
                     self._unref_block_locked(blk)
+        if released:
+            # The normal release path: every slot block matures through
+            # the zombie-wave deferral window exactly once.
+            self.block_evictions["zombie_deferral"] += released
+            obs.generator_block_evictions_total().labels(
+                model=self.name, cause="zombie_deferral").inc(released)
 
     def _plan_prompt_blocks(self, req: _Request, slot: int,
                             chunk_regs: Optional[Dict[int, Tuple[
-                                bytes, int]]] = None
+                                bytes, int]]] = None,
+                            force_miss: bool = False
                             ) -> Optional[List[int]]:
         """Allocate/share blocks for a prompt (loop thread, pre-
         enqueue).  Full chunks probe the prefix index by chain hash —
@@ -1013,6 +1136,11 @@ class GenerationEngine:
         scatter (-1 = shared hit, write dropped), or None when the
         pool cannot satisfy the request right now (caller leaves it
         pending).
+
+        force_miss (the `generator.prefix_lookup` chaos site, probed
+        async by the scheduler loop): skip every index probe — a
+        cache-miss storm on demand, which the lookup telemetry must
+        count as misses.
 
         chunk_regs (chunked-prefill admissions): fresh full-block
         registrations land in this dict keyed by block index INSTEAD
@@ -1031,6 +1159,14 @@ class GenerationEngine:
         dest: List[int] = []
         taken: List[int] = []
         fresh_regs: List[Tuple[bytes, int]] = []
+        # Plan-local lookup accounting, flushed to the registry twins
+        # outside the block lock (one .labels() resolve per plan, not
+        # per block); hit_chains lets the rollback path rewind the
+        # reuse-depth census it provisionally advanced.
+        plan_hits = 0
+        plan_misses = 0
+        hit_chains: List[bytes] = []
+        depth_obs: List[int] = []
         # Chain digests depend only on the prompt bytes — compute them
         # outside the lock, once, for both the hit probe and the
         # allocation loop below.
@@ -1061,7 +1197,8 @@ class GenerationEngine:
                 bpc = self.prefill_chunk_tokens // bs
                 h = 0
                 for c in range(full):
-                    if self._prefix_index.get(chains[c]) is None:
+                    if force_miss or \
+                            self._prefix_index.get(chains[c]) is None:
                         break
                     h += 1
                 n_chunks = -(-n // self.prefill_chunk_tokens)
@@ -1070,7 +1207,8 @@ class GenerationEngine:
             for c in range(total):
                 if c < full:
                     chain = chains[c]
-                    hit = self._prefix_index.get(chain)
+                    hit = (None if force_miss
+                           else self._prefix_index.get(chain))
                     if hit is not None and (max_hit_blocks is None
                                             or c < max_hit_blocks):
                         self._ref_block_locked(hit)
@@ -1078,6 +1216,11 @@ class GenerationEngine:
                         taken.append(hit)
                         dest.append(-1)
                         self.prefix_hits += 1
+                        plan_hits += 1
+                        hit_chains.append(chain)
+                        depth = self._chain_hits.get(chain, 0) + 1
+                        self._chain_hits[chain] = depth
+                        depth_obs.append(depth)
                         continue
                 blk = self._alloc_block_locked()
                 if blk is None:
@@ -1086,18 +1229,34 @@ class GenerationEngine:
                     # first — their blocks were never written, and a
                     # later plan hitting a stale chain would share
                     # all-zero k/v (code-review r5).
+                    dropped = 0
                     for ch, b in fresh_regs:
-                        self._prefix_index.pop(ch, None)
+                        if self._prefix_index.pop(ch, None) is not None:
+                            dropped += 1
+                            self._chain_hits.pop(ch, None)
                         self._block_chain.pop(b, None)
+                    self._count_invalidations_locked(dropped)
                     for b in taken:
                         self._unref_block_locked(b)
+                    # Rewind the reuse-depth census: the replan will
+                    # re-probe these chains and count them again.
+                    for ch in hit_chains:
+                        d = self._chain_hits.get(ch)
+                        if d is not None:
+                            if d <= 1:
+                                self._chain_hits.pop(ch, None)
+                            else:
+                                self._chain_hits[ch] = d - 1
                     self._tables[slot, :] = -1
+                    self._flush_lookup_counters(req, None, plan_hits,
+                                                plan_misses, depth_obs)
                     return None
                 self._ref_block_locked(blk)
                 self._tables[slot, c] = blk
                 taken.append(blk)
                 dest.append(blk)
                 if c < full:
+                    plan_misses += 1
                     # Freshly written FULL prompt blocks become
                     # shareable (they are never written again: decode
                     # writes land past the prompt).  PROVISIONAL until
@@ -1118,7 +1277,41 @@ class GenerationEngine:
                         fresh_regs.append((chain, blk))
             if chunk_regs is None:
                 self._plan_regs[slot] = fresh_regs
+        self._flush_lookup_counters(req, dest, plan_hits, plan_misses,
+                                    depth_obs)
         return dest
+
+    def _flush_lookup_counters(self, req: _Request,
+                               dest: Optional[List[int]],
+                               plan_hits: int, plan_misses: int,
+                               depth_obs: List[int]) -> None:
+        """Flush one plan's lookup accounting to the registry twins
+        (one family resolve per plan, outside the per-block loop) and,
+        on a successful plan, fold the cache economics into the
+        request's cost record and the timeline."""
+        if plan_hits:
+            obs.generator_prefix_lookups_total().labels(
+                model=self.name, outcome="hit").inc(plan_hits)
+            fam = obs.generator_prefix_reuse_depth_hits()
+            for depth in depth_obs:
+                fam.labels(model=self.name).observe(depth)
+        if plan_misses:
+            obs.generator_prefix_lookups_total().labels(
+                model=self.name, outcome="miss").inc(plan_misses)
+        if dest is None:
+            return
+        req.blocks_held = max(req.blocks_held, len(dest))
+        if plan_hits:
+            saved = plan_hits * self.block_size
+            self.prefill_tokens_saved += saved
+            req.cache_hit_blocks += plan_hits
+            req.cache_saved_tokens += saved
+            obs.generator_prefill_tokens_saved_total().labels(
+                model=self.name).inc(saved)
+            TIMELINE.record("host", "cache.hit",
+                            trace_id=req.trace_id,
+                            attrs={"blocks": plan_hits,
+                                   "tokens_saved": saved})
 
     def _ensure_block_capacity(self) -> List[int]:
         """Grow active slots' tables to cover the next
@@ -1141,6 +1334,7 @@ class GenerationEngine:
                            self.blocks_per_slot)
                 cur = int(np.sum(self._tables[i] >= 0))
                 ok = True
+                grown = cur
                 for c in range(cur, need):
                     blk = self._alloc_block_locked()
                     if blk is None:
@@ -1148,6 +1342,11 @@ class GenerationEngine:
                         break
                     self._ref_block_locked(blk)
                     self._tables[i, c] = blk
+                    grown = c + 1
+                # Peak residency for the cost record: grown starts at
+                # cur and only increases, so it IS the table's block
+                # count for this stream now.
+                s.req.blocks_held = max(s.req.blocks_held, grown)
                 if not ok:
                     failed.append(i)
         return failed
@@ -1173,6 +1372,12 @@ class GenerationEngine:
             "active_slots": sum(1 for s in self._slots
                                 if s is not None),
             "pending": len(self._pending),
+            # String attr: the Chrome exporter drops non-numerics from
+            # counter series, but multi-engine consumers (the bench
+            # cache summary) need to know WHOSE pool a sample
+            # describes — untagged samples would blend two engines'
+            # pools into one meaningless ratio.
+            "engine": self.name,
         }
         if self.block_size is not None:
             values["free_blocks"] = len(self._free_blocks)
@@ -1225,7 +1430,30 @@ class GenerationEngine:
                             t_end=now)
             self._hold_since = None
 
-    def _take_prefill_group(self):
+    async def _probe_prefix_fault(self) -> bool:
+        """The `generator.prefix_lookup` chaos site, probed ON the
+        loop (async sleeps for injected latency — never a blocking
+        sleep on the scheduler): an injected error forces the next
+        admission's plan to MISS the whole prefix index, a cache-miss
+        storm on demand whose misses the lookup telemetry must count.
+        configured() keeps the no-faults hot path at one dict
+        lookup."""
+        from kfserving_tpu.reliability import fault_sites
+        from kfserving_tpu.reliability.faults import (
+            FaultInjected,
+            faults,
+        )
+
+        if not faults.configured(fault_sites.GENERATOR_PREFIX_LOOKUP):
+            return False
+        try:
+            await faults.inject(fault_sites.GENERATOR_PREFIX_LOOKUP,
+                                key=self.name)
+        except FaultInjected:
+            return True
+        return False
+
+    def _take_prefill_group(self, force_miss: bool = False):
         """Pop the front run of pending requests that share a prefill
         bucket, up to the free slot count — they ride ONE prefill
         dispatch.  Strict FIFO: a different-bucket request at the front
@@ -1250,7 +1478,8 @@ class GenerationEngine:
                 break
             if dest_rows is not None:
                 plan = self._plan_prompt_blocks(self._pending[0],
-                                                free[len(group)])
+                                                free[len(group)],
+                                                force_miss=force_miss)
                 if plan is None:
                     break  # pool pressure: wait for released blocks
                 dest_rows.append(plan)
@@ -1281,7 +1510,8 @@ class GenerationEngine:
         hi = min(lo + bpc, len(act.chunk_dest))
         return all(act.chunk_dest[c] == -1 for c in range(lo, hi))
 
-    async def _admit_chunked(self, loop, inflight: deque) -> bool:
+    async def _admit_chunked(self, loop, inflight: deque,
+                             force_miss: bool = False) -> bool:
         """Admit the front pending (cold) request onto a free slot in
         chunked mode: plan ALL prompt blocks now (prefix hits share;
         registration of fresh blocks is deferred per chunk), install
@@ -1291,7 +1521,8 @@ class GenerationEngine:
         req = self._pending[0]
         chunk_regs: Dict[int, Tuple[bytes, int]] = {}
         dest = self._plan_prompt_blocks(req, slot,
-                                        chunk_regs=chunk_regs)
+                                        chunk_regs=chunk_regs,
+                                        force_miss=force_miss)
         if dest is None:
             return False
         self._pending.popleft()
@@ -1493,6 +1724,28 @@ class GenerationEngine:
             time.time() - duration_s, duration_s * 1000.0,
             {"tokens": tokens, "finish_reason": finished}))
 
+    def _finalize_cost(self, req: _Request, finished: str) -> None:
+        """Fold the request's accumulated accounting into ONE cost
+        record (observability/attribution.py): attributed device ms by
+        phase, prefill/decode tokens, peak blocks held, cache-saved
+        tokens.  Every terminal path calls this — eos/length AND
+        timeout/cancel, because the timed-out request is exactly the
+        one the flight recorder pins and must find cost evidence
+        for."""
+        attribution.observe(self.name, req.trace_id, {
+            "trace_id": req.trace_id,
+            "finish_reason": finished,
+            "device_ms": {
+                "prefill": round(req.prefill_device_ms, 3),
+                "decode": round(req.decode_device_ms, 3),
+            },
+            "prefill_tokens": int(req.prompt_ids.size),
+            "decode_tokens": req.tokens_out,
+            "blocks_held": req.blocks_held,
+            "cache_hit_blocks": req.cache_hit_blocks,
+            "cache_saved_tokens": req.cache_saved_tokens,
+        })
+
     def _expire_deadlines(self) -> None:
         """Between decode waves: requests whose budget ran out get a
         terminal "timeout" event and free their slot (active) or leave
@@ -1503,6 +1756,7 @@ class GenerationEngine:
                     and s.req.deadline.expired:
                 s.req.out.put_nowait((None, "timeout"))
                 self._record_finish_span(s.req, s.generated, "timeout")
+                self._finalize_cost(s.req, "timeout")
                 self._free_slot_state(i)
                 self.requests_finished += 1
         if any(r.deadline is not None and r.deadline.expired
@@ -1513,6 +1767,7 @@ class GenerationEngine:
                 if r.deadline is not None and r.deadline.expired:
                     r.out.put_nowait((None, "timeout"))
                     self._record_finish_span(r, 0, "timeout")
+                    self._finalize_cost(r, "timeout")
                     self.requests_finished += 1
                 else:
                     keep.append(r)
@@ -1524,18 +1779,21 @@ class GenerationEngine:
             admitted = False
             while (not self._growth_starved and self._pending
                    and self._free_slot() is not None):
+                force_miss = (self.block_size is not None
+                              and await self._probe_prefix_fault())
                 if self._is_cold(self._pending[0]):
                     # Cold long prompt: chunked admission — one slot,
                     # block-aligned chunks interleaving with decode
                     # waves (strict FIFO preserved: a cold request at
                     # the front is admitted, or blocks the queue on
                     # pool pressure exactly like a group plan would).
-                    if not await self._admit_chunked(loop, inflight):
+                    if not await self._admit_chunked(
+                            loop, inflight, force_miss=force_miss):
                         break  # pool pressure: wait for frees
                     admitted = True
                     continue
                 group, slots, bucket, dest_rows = \
-                    self._take_prefill_group()
+                    self._take_prefill_group(force_miss=force_miss)
                 if not group:
                     break  # paged pool pressure: wait for frees
                 try:
@@ -1576,6 +1834,7 @@ class GenerationEngine:
                         # Planned blocks release (deferred — the just-
                         # enqueued prefill still writes them).
                         req.out.put_nowait((None, "cancelled"))
+                        self._finalize_cost(req, "cancelled")
                         self.requests_finished += 1
                         self._schedule_block_release(slot)
                         entries.append((slot, None))
@@ -1824,7 +2083,8 @@ class GenerationEngine:
                                         trace_id=s.req.trace_id,
                                         slot=slot_i)
                 self._record_pool_sample()
-                self._distribute(fetched, lp, meta)
+                self._distribute(fetched, lp, meta,
+                                 device_ms=dev_dur * 1000.0)
             elif kind == "chunk":
                 self._prefill_device_s += busy
                 self._prefill_wait_s += wait_s
@@ -1843,6 +2103,9 @@ class GenerationEngine:
                                 trace_id=act.req.trace_id, slot=slot,
                                 attrs={"chunk": _idx})
                 act.chunks_inflight -= 1
+                # A chunk dispatch serves exactly one request: its
+                # whole busy interval is that request's prefill cost.
+                act.req.prefill_device_ms += dev_dur * 1000.0
                 if final and self._slots[slot] is act:
                     # The final chunk carries the stream's first
                     # sampled token (the feed arrays got it at enqueue
@@ -1868,14 +2131,27 @@ class GenerationEngine:
                                         dur_s=dev_dur, t_end=wall,
                                         trace_id=act.req.trace_id,
                                         slot=slot_i)
-                self._finish_prefill(fetched, lp, meta)
+                self._finish_prefill(fetched, lp, meta,
+                                     device_ms=dev_dur * 1000.0)
             self._process_deferred_frees()
 
-    def _finish_prefill(self, firsts: np.ndarray, lp, entries):
+    def _finish_prefill(self, firsts: np.ndarray, lp, entries,
+                        device_ms: float = 0.0):
         """Deliver a fetched prefill batch's first tokens.  A slot
         whose _Active was replaced since enqueue (cancel) discards its
         row, exactly like _distribute."""
         self.prefills += 1
+        # Even split of the bucket dispatch across the rows whose cost
+        # records are still OPEN (slot unchanged since enqueue).  A
+        # cancelled row's record was finalized at cancel time —
+        # mutating it would be lost work — so its computed prompt's
+        # share redistributes onto the survivors of the same dispatch:
+        # device time stays conserved across stored records.
+        live = [act for slot, act in entries
+                if act is not None and self._slots[slot] is act]
+        share_ms = device_ms / len(live) if live else 0.0
+        for act in live:
+            act.req.prefill_device_ms += share_ms
         for i, (slot, act) in enumerate(entries):
             if act is None or self._slots[slot] is not act:
                 continue
@@ -2036,6 +2312,7 @@ class GenerationEngine:
         method never touches `length`)."""
         s = self._slots[slot]
         s.generated += 1
+        s.req.tokens_out += 1
         self.tokens_generated += 1
         obs.llm_tokens_total().labels(direction="out").inc()
         # Generation latency series: first emission is TTFT, later
@@ -2074,12 +2351,14 @@ class GenerationEngine:
                     s.generated / duration_s,
                     trace_id=s.req.trace_id)
             self._record_finish_span(s.req, s.generated, finished)
+            self._finalize_cost(s.req, finished)
             self._free_slot_state(slot)
             self.requests_finished += 1
         else:
             s.last_token = token
 
-    def _distribute(self, tokens: np.ndarray, lp, snapshot):
+    def _distribute(self, tokens: np.ndarray, lp, snapshot,
+                    device_ms: float = 0.0):
         """tokens [S, K]: deliver each slot's chunk in order.  A slot
         only consumes its row if the SAME _Active object that was
         in the slot at enqueue time is still there — a slot freed (or
@@ -2091,6 +2370,14 @@ class GenerationEngine:
         k = tokens.shape[1]
         self._token_steps += k
         resident_tokens = 0
+        # Even split of the wave's busy interval across the live
+        # streams it decoded: the per-request decode cost sums to the
+        # engine's device time (additive attribution), and garbage
+        # rows (freed slots) are excluded — their waste already shows
+        # in goodput_ratio.
+        live = sum(1 for i, s in enumerate(snapshot)
+                   if s is not None and self._slots[i] is s)
+        share_ms = device_ms / live if live else 0.0
         for i, s in enumerate(snapshot):
             if s is None:
                 continue
@@ -2100,6 +2387,11 @@ class GenerationEngine:
                 self._wasted_token_steps += k
                 continue
             self._occupied_slot_steps += k
+            s.req.decode_device_ms += share_ms
+            if self.block_size is not None:
+                s.req.blocks_held = max(
+                    s.req.blocks_held,
+                    -(-int(s.length) // self.block_size))
             # Roofline accounting over LIVE rows: matmul FLOPs per fed
             # token plus attention over the slot's resident context
             # (length at wave start — within a K-step wave the drift

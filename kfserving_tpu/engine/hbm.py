@@ -18,7 +18,9 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+from kfserving_tpu.observability import metrics as obs
 
 logger = logging.getLogger("kfserving_tpu.hbm")
 
@@ -78,6 +80,7 @@ class HBMManager:
         self.evict_cb = evict_cb
         self._resident: "OrderedDict[str, Residency]" = OrderedDict()
         self._lock = threading.Lock()
+        obs.hbm_budget_bytes().set(float(budget_bytes))
 
     @property
     def used_bytes(self) -> int:
@@ -133,8 +136,11 @@ class HBMManager:
             self._resident = plan
         for victim in victims:
             logger.info("evicting model %s to fit %s", victim, name)
+            obs.hbm_evictions_total().labels(model=victim).inc()
+            obs.hbm_resident_bytes().prune(model=victim)
             if self.evict_cb:
                 self.evict_cb(victim)
+        obs.hbm_resident_bytes().labels(model=name).set(float(nbytes))
         return victims
 
     def touch(self, name: str) -> None:
@@ -148,6 +154,10 @@ class HBMManager:
     def release(self, name: str) -> None:
         with self._lock:
             self._resident.pop(name, None)
+        # Prune, not zero: a released model must drop OUT of /metrics
+        # (a forever-0 series per unloaded model would grow the scrape
+        # unboundedly under multi-model churn).
+        obs.hbm_resident_bytes().prune(model=name)
 
     def commit(self, staging: str, name: str,
                nbytes: Optional[int] = None) -> None:
@@ -168,6 +178,8 @@ class HBMManager:
             final = nbytes if nbytes is not None else src.bytes
             self._resident[name] = Residency(
                 name, final, src.loaded_at, time.time())
+        obs.hbm_resident_bytes().prune(model=staging)
+        obs.hbm_resident_bytes().labels(model=name).set(float(final))
 
     def stats(self) -> Dict[str, float]:
         return {
@@ -175,4 +187,21 @@ class HBMManager:
             "used_bytes": self.used_bytes,
             "free_bytes": self.free_bytes,
             "resident_models": len(self._resident),
+        }
+
+    def debug(self) -> Dict[str, Any]:
+        """The `/debug/cache` HBM snapshot: budget totals plus the
+        per-model residency ledger in LRU order (index 0 = next
+        eviction victim) — what the multi-model residency manager
+        (ROADMAP item 4) will consume."""
+        with self._lock:
+            residents = [
+                {"model": r.name, "bytes": r.bytes,
+                 "loaded_at": round(r.loaded_at, 3),
+                 "last_used": round(r.last_used, 3)}
+                for r in self._resident.values()]
+        return {
+            "budget_bytes": self.budget_bytes,
+            "used_bytes": sum(r["bytes"] for r in residents),
+            "resident": residents,
         }

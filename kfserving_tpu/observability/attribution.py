@@ -1,0 +1,155 @@
+"""Per-request cost attribution: device time, tokens, and cache economics.
+
+PR 6 made device time *visible* (the engine timeline renders every wave
+and chunk in Perfetto); this module makes it *attributable*: the
+generator folds its dispatch accounting into one cost record per
+finished request — attributed device milliseconds split by phase,
+prefill vs. decode tokens, peak blocks held, and prompt tokens the
+prefix cache saved — and hands it here.  The record then:
+
+- lands in the JSON access log (`cost` field) so offline analysis can
+  join cost to status/latency per request;
+- is embedded in pinned flight-recorder entries (a p99 outlier pin
+  shows what the request *cost*, not just how long it took);
+- feeds per-model aggregate histograms through the process registry
+  (`kfserving_tpu_request_device_ms{model,phase}`,
+  `_request_phase_tokens`, `_request_held_blocks`,
+  `_request_cache_saved_tokens`), federated by the router like every
+  PR-2 series.
+
+Attribution discipline: a dispatch's busy interval is split EVENLY
+across the live streams it served, so per-request device ms sum to the
+engine's total device time — an additive decomposition (InferLine's
+per-stage cost shape, arxiv 1812.01776), not a latency measurement.
+
+The record store is a bounded ring keyed by trace id
+(`KFS_ATTRIBUTION_RECORDS`, default 1024): the server's completion
+path and the flight recorder look records up moments after the engine
+finalizes them, so a small window is plenty.  Lookups are
+non-destructive (access log AND pin evaluation both read the same
+record).
+
+Import discipline (observability package contract): nothing from
+`server/`, `control/`, `engine/`, or `reliability/` — the engine calls
+*into* this module, never the reverse.
+"""
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Set
+
+from kfserving_tpu.observability import metrics as obs
+
+DEFAULT_RECORDS = 1024
+
+_lock = threading.Lock()
+_records: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+
+def _capacity() -> int:
+    try:
+        return max(16, int(os.environ.get("KFS_ATTRIBUTION_RECORDS",
+                                          DEFAULT_RECORDS)))
+    except ValueError:
+        return DEFAULT_RECORDS
+
+
+def observe(model: str, trace_id: Optional[str],
+            record: Dict[str, Any]) -> Dict[str, Any]:
+    """Finalize one request's cost record: stamp the model, feed the
+    per-model aggregate histograms, and (when traced) store it for the
+    access log / flight recorder to attach.  Never raises into the
+    engine's completion path."""
+    record = dict(record)
+    record["model"] = model
+    try:
+        device = record.get("device_ms") or {}
+        for phase in ("prefill", "decode"):
+            ms = device.get(phase)
+            if isinstance(ms, (int, float)) and ms > 0:
+                obs.request_device_ms().labels(
+                    model=model, phase=phase).observe(
+                        float(ms), trace_id=trace_id)
+        for phase, key in (("prefill", "prefill_tokens"),
+                           ("decode", "decode_tokens")):
+            n = record.get(key)
+            if isinstance(n, (int, float)):
+                obs.request_phase_tokens().labels(
+                    model=model, phase=phase).observe(float(n))
+        blocks = record.get("blocks_held")
+        if isinstance(blocks, (int, float)) and blocks > 0:
+            obs.request_held_blocks().labels(model=model).observe(
+                float(blocks))
+        saved = record.get("cache_saved_tokens")
+        if isinstance(saved, (int, float)):
+            obs.request_cache_saved_tokens().labels(
+                model=model).observe(float(saved))
+        if trace_id:
+            with _lock:
+                _records[trace_id] = record
+                _records.move_to_end(trace_id)
+                cap = _capacity()
+                while len(_records) > cap:
+                    _records.popitem(last=False)
+    except Exception:
+        # Telemetry must never fail a finishing request.
+        import logging
+
+        logging.getLogger("kfserving_tpu.attribution").exception(
+            "cost attribution failed for %s", model)
+    return record
+
+
+def lookup(trace_id: Optional[str]) -> Optional[Dict[str, Any]]:
+    """Non-destructive fetch of a trace's cost record (None when the
+    request was untraced, never finished a generation, or rotated out
+    of the bounded store)."""
+    if not trace_id:
+        return None
+    with _lock:
+        rec = _records.get(trace_id)
+        return dict(rec) if rec is not None else None
+
+
+def recent(limit: int = 10) -> List[Dict[str, Any]]:
+    """Newest `limit` records (bench evidence / debugging)."""
+    limit = max(0, int(limit))
+    with _lock:
+        return [dict(r) for r in list(_records.values())[-limit:]]
+
+
+def clear() -> None:
+    with _lock:
+        _records.clear()
+
+
+def _clamp01(value: float) -> float:
+    return min(1.0, max(0.0, float(value)))
+
+
+def publish_cache_gauges(model: str, stats: Dict[str, Any]) -> Set[str]:
+    """Promote an engine stats dict's paged-pool ratios into registry
+    gauges at /metrics scrape time (the roofline.publish_gauges shape).
+    Returns the consumed TOP-LEVEL stat keys — none today: the `paged`
+    dict keeps its legacy per-key export (tests and dashboards read
+    `kfserving_tpu_engine_paged{bucket=...}`), the ratio gauges are
+    published IN ADDITION so the `_ratio` unit contract holds."""
+    consumed: Set[str] = set()
+    try:
+        paged = stats.get("paged")
+        if isinstance(paged, dict):
+            occ = paged.get("pool_occupancy_ratio")
+            if isinstance(occ, (int, float)):
+                obs.generator_pool_occupancy_ratio().labels(
+                    model=model).set(_clamp01(occ))
+            frag = paged.get("fragmentation_ratio")
+            if isinstance(frag, (int, float)):
+                obs.generator_pool_fragmentation_ratio().labels(
+                    model=model).set(_clamp01(frag))
+    except Exception:
+        import logging
+
+        logging.getLogger("kfserving_tpu.attribution").exception(
+            "cache gauge publish failed for %s", model)
+    return consumed

@@ -126,6 +126,134 @@ def generator_suppressed_waves_total():
         "the waves already in flight")
 
 
+# -- prefix cache & block pool (ISSUE 13) -------------------------------
+# Count-valued buckets for the cache/attribution distributions: token
+# counts span prompt sizes (1..4k), block counts span pool tables, and
+# reuse depth counts hits per prefix-index entry.
+TOKEN_BUCKETS = [1, 4, 16, 64, 256, 1024, 4096]
+BLOCK_BUCKETS = [1, 2, 4, 8, 16, 32, 64, 128]
+REUSE_DEPTH_BUCKETS = [1, 2, 4, 8, 16, 32, 64]
+
+
+def generator_prefix_lookups_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_generator_prefix_lookups_total",
+        "Chain-hash prefix-index probes per full prompt block at plan "
+        "time, by outcome (hit = the block's k/v were already "
+        "resident and the plan points at the shared block; miss = a "
+        "fresh block was allocated) — the replica-side feed "
+        "prefix-affinity routing reads through /metrics federation")
+
+
+def generator_prefill_tokens_saved_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_generator_prefill_tokens_saved_total",
+        "Prompt tokens whose k/v came from shared prefix blocks "
+        "instead of being stored again (hit blocks x block_size); "
+        "chunked admissions additionally skip the compute for "
+        "whole-chunk hits (generator_prefill_chunks_total{outcome="
+        "\"skipped_shared\"})")
+
+
+def generator_block_evictions_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_generator_block_evictions_total",
+        "Pool blocks leaving their role, by cause: capacity = LRU "
+        "reclaim of a zero-ref cached prefix block under allocation "
+        "pressure (its index entry drops with it); index_invalidation "
+        "= provisional prefix registrations dropped because their "
+        "planned writes never dispatched (plan rollback / enqueue "
+        "failure); zombie_deferral = slot blocks released after "
+        "maturing through the zombie-wave deferral window (the "
+        "normal free path, counted so the deferral machinery is "
+        "observable)")
+
+
+def generator_prefix_reuse_depth_hits():
+    return REGISTRY.histogram(
+        "kfserving_tpu_generator_prefix_reuse_depth_hits",
+        "Cumulative hit count of a prefix-index entry at each hit "
+        "(observed per hit event: an entry hit for the Nth time "
+        "lands in the N bucket) — deep entries are hot shared "
+        "system prompts, the routing-affinity signal",
+        buckets=REUSE_DEPTH_BUCKETS)
+
+
+def generator_pool_occupancy_ratio():
+    return REGISTRY.gauge(
+        "kfserving_tpu_generator_pool_occupancy_ratio",
+        "Referenced (ref > 0) blocks over the whole pool at the last "
+        "scrape — 1.0 means every block is held by a live slot or "
+        "shared prefix; reclaimable cached blocks do not count")
+
+
+def generator_pool_fragmentation_ratio():
+    return REGISTRY.gauge(
+        "kfserving_tpu_generator_pool_fragmentation_ratio",
+        "Internal fragmentation of slot tables: 1 - resident tokens "
+        "/ (table blocks x block_size), with shared prefix blocks "
+        "counted per sharer on both sides — the tail positions "
+        "allocated for growth but not yet holding k/v")
+
+
+# -- HBM residency (engine/hbm.py accountant) ---------------------------
+def hbm_resident_bytes():
+    return REGISTRY.gauge(
+        "kfserving_tpu_hbm_resident_bytes",
+        "Accounted HBM residency per model (params + cache pool as "
+        "admitted to the HBMManager budget); series are pruned when "
+        "the model is released")
+
+
+def hbm_budget_bytes():
+    return REGISTRY.gauge(
+        "kfserving_tpu_hbm_budget_bytes",
+        "The HBMManager's packing budget for this device/mesh")
+
+
+def hbm_evictions_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_hbm_evictions_total",
+        "Models evicted from HBM residency by the LRU accountant to "
+        "fit an admission, labeled by the evicted model")
+
+
+# -- per-request cost attribution (observability/attribution.py) --------
+def request_device_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_request_device_ms",
+        "Per-request attributed device time by phase (prefill|"
+        "decode): each dispatch's busy interval is split evenly "
+        "across the live streams it served, so the series sums to "
+        "total device time (the InferLine-style per-stage cost the "
+        "provisioning math consumes)")
+
+
+def request_phase_tokens():
+    return REGISTRY.histogram(
+        "kfserving_tpu_request_phase_tokens",
+        "Per-request token counts by phase (prefill = prompt tokens "
+        "ingested, decode = tokens generated)",
+        buckets=TOKEN_BUCKETS)
+
+
+def request_held_blocks():
+    return REGISTRY.histogram(
+        "kfserving_tpu_request_held_blocks",
+        "Peak pool blocks a request's slot table held (paged mode; "
+        "prompt + growth horizon) — the residency cost of admitting "
+        "this request",
+        buckets=BLOCK_BUCKETS)
+
+
+def request_cache_saved_tokens():
+    return REGISTRY.histogram(
+        "kfserving_tpu_request_cache_saved_tokens",
+        "Prompt tokens a request did not re-store thanks to prefix-"
+        "cache hits (hit blocks x block_size; 0 = fully cold)",
+        buckets=TOKEN_BUCKETS)
+
+
 # -- engine roofline (fed by observability/profiling/roofline.py at
 # /metrics scrape time from the engines' stats dicts) -------------------
 def engine_mfu():

@@ -138,6 +138,18 @@ class Monitoring:
                 "latency_ms": round(latency_ms, 3),
                 "stages": stages or {},
             }
+            # Cost attribution (ISSUE 13): the request's cost record
+            # (attributed device ms, prefill/decode tokens, blocks
+            # held, cache-saved tokens) rides every entry it exists
+            # for — a pinned p99 outlier then shows what the request
+            # COST, not just how long it took.  One bounded-dict
+            # lookup; absent for non-generative verbs.
+            if trace_id:
+                from kfserving_tpu.observability import attribution
+
+                cost = attribution.lookup(trace_id)
+                if cost is not None:
+                    entry["cost"] = cost
             # Eager span capture ONLY for pinned entries: pinned
             # evidence must not depend on the tracer ring still
             # holding the spans at dump time, but scanning the ring

@@ -51,6 +51,12 @@ SITES: Dict[str, tuple] = {
         "IngressRouter brownout admission gate, keyed by `<model> "
         "priority:<tier>` — injected faults shed as explicit "
         "retriable 503s, delay stalls admission"),
+    "GENERATOR_PREFIX_LOOKUP": (
+        "generator.prefix_lookup",
+        "GenerationEngine prompt-block prefix-index probe, keyed by "
+        "engine name — an injected error forces the whole plan to "
+        "MISS (cache-miss storm on demand), proving the lookup "
+        "telemetry counts it"),
 }
 
 
@@ -69,3 +75,4 @@ DATAPLANE_INFER = "dataplane.infer"
 ORCHESTRATOR_STANDBY_ACTIVATE = "orchestrator.standby_activate"
 AUTOSCALER_TICK = "autoscaler.tick"
 ROUTER_ADMISSION = "router.admission"
+GENERATOR_PREFIX_LOOKUP = "generator.prefix_lookup"

@@ -271,6 +271,11 @@ class ModelServer:
         # start/stop pair above stays for long captures).
         r.add("GET", "/debug/profile", self._profile)
         r.add("POST", "/debug/profile/capture", self._profile_capture)
+        # Cache & cost attribution (ISSUE 13): per-engine prefix-index
+        # census + pool/HBM occupancy snapshot, federated by the
+        # router under the `replica` label — the feed prefix-affinity
+        # routing and the HBM residency manager will read.
+        r.add("GET", "/debug/cache", self._cache)
 
     # -- handlers ----------------------------------------------------------
     async def _live(self, req: Request) -> Response:
@@ -448,9 +453,12 @@ class ModelServer:
         self.monitoring.record_request(name, verb, status, latency_ms,
                                        trace_id=trace_id,
                                        stages=stages or None)
+        from kfserving_tpu.observability import attribution
+
         log_access("server", trace_id=trace_id, model=name, verb=verb,
                    status=status, latency_ms=round(latency_ms, 3),
-                   stages=stages or None, tokens_out=tokens_out)
+                   stages=stages or None, tokens_out=tokens_out,
+                   cost=attribution.lookup(trace_id))
         for hook in self.request_hooks:
             try:
                 hook(name, verb, req, resp, latency_ms)
@@ -593,14 +601,20 @@ class ModelServer:
             self.monitoring.record_request(name, "generate_stream",
                                            state["status"],
                                            latency_ms, trace_id=rid)
+            from kfserving_tpu.observability import attribution
             from kfserving_tpu.observability.accesslog import (
                 log_access,
             )
 
+            # The stream's cost record exists by now: the engine
+            # finalizes it at the terminal event, and on_close runs
+            # after the event stream ended (or was abandoned — the
+            # cancel path finalizes too).
             log_access("server", trace_id=rid, model=name,
                        verb="generate_stream",
                        status=state["status"],
-                       latency_ms=round(latency_ms, 3))
+                       latency_ms=round(latency_ms, 3),
+                       cost=attribution.lookup(rid))
             # Hooks get a minimal response carrying the stream's REAL
             # outcome: a mid-stream failure must not reach the payload
             # logger / monitor bus stamped as a 200.  The body is
@@ -693,8 +707,15 @@ class ModelServer:
                 # the router federates them under a `replica` label;
                 # consumed keys skip the generic per-key export below
                 # so the merged exposition declares each family
-                # exactly once.
+                # exactly once.  The cache publisher adds the paged
+                # pool's occupancy/fragmentation `_ratio` gauges
+                # (ISSUE 13) without consuming the legacy
+                # `kfserving_tpu_engine_paged{bucket=...}` export.
                 consumed = roofline.publish_gauges(model.name, stats)
+                from kfserving_tpu.observability import attribution
+
+                consumed |= attribution.publish_cache_gauges(
+                    model.name, stats)
                 for key, value in stats.items():
                     if key in consumed:
                         continue
@@ -821,6 +842,45 @@ class ModelServer:
             profiler.stop()
         return _json({"captured": True, "log_dir": log_dir,
                       "duration_s": duration_s})
+
+    async def _cache(self, req: Request) -> Response:
+        """Replica cache snapshot: per generative model the prefix-
+        index entry count, reuse-depth distribution, top-K hot chains
+        by hit count, and the pool occupancy stats; plus the HBM
+        accountant's residency ledger when one is wired.  ?top_k=
+        bounds the hot-chain list (default 10)."""
+        try:
+            top_k = int(req.query.get("top_k", "10"))
+        except ValueError:
+            return _json({"error": "top_k must be an integer"},
+                         status=400)
+        models: Dict[str, Any] = {}
+        hbm = None
+        seen_managers = set()
+        for model in self.repository.get_models():
+            debug = getattr(getattr(model, "engine", None),
+                            "cache_debug", None)
+            if debug is not None:
+                try:
+                    models[model.name] = debug(top_k=top_k)
+                except Exception:
+                    logger.exception("cache debug for %s failed",
+                                     model.name)
+            manager = getattr(model, "hbm", None)
+            if manager is not None and id(manager) not in seen_managers:
+                seen_managers.add(id(manager))
+                try:
+                    # One manager per device in practice; a second one
+                    # (multi-mesh) appends its ledger.
+                    snap = manager.debug()
+                    if hbm is None:
+                        hbm = snap
+                    else:
+                        hbm["resident"] += snap["resident"]
+                        hbm["used_bytes"] += snap["used_bytes"]
+                except Exception:
+                    logger.exception("hbm debug failed")
+        return _json({"models": models, "hbm": hbm})
 
     async def _profiler_start(self, req: Request) -> Response:
         from kfserving_tpu.tracing import profiler

@@ -19,7 +19,12 @@ private copy, is exactly how the static and runtime twins drift.
 from typing import List
 
 PREFIX = "kfserving_tpu_"
-UNIT_SUFFIXES = ("_ms", "_seconds", "_bytes", "_ratio", "_per_second")
+# Count units (_tokens, _blocks, _hits) joined the ladder with the
+# cache/attribution families (ISSUE 13): token-count, block-count, and
+# hits-per-entry histograms are distributions over discrete units, and
+# forcing a time/size suffix onto them would lie about the unit.
+UNIT_SUFFIXES = ("_ms", "_seconds", "_bytes", "_ratio", "_per_second",
+                 "_tokens", "_blocks", "_hits")
 
 
 def family_name_problems(name: str, kind: str) -> List[str]:

@@ -163,6 +163,40 @@ async def smoke() -> List[str]:
         model="metrics-probe", reason="priority").inc()
     obs.brownout_transitions_total().labels(
         model="metrics-probe", direction="enter").inc()
+    # Cache & cost attribution families (ISSUE 13): prefix-index
+    # lookups/evictions/reuse depth, the paged-pool `_ratio` gauges
+    # (must be bounded [0, 1]), HBM residency, and the per-request
+    # attribution histograms — touched with representative samples so
+    # the lint always covers names, label shapes, and unit suffixes.
+    obs.generator_prefix_lookups_total().labels(
+        model="metrics-probe", outcome="hit").inc(3)
+    obs.generator_prefix_lookups_total().labels(
+        model="metrics-probe", outcome="miss").inc()
+    obs.generator_prefill_tokens_saved_total().labels(
+        model="metrics-probe").inc(384)
+    for cause in ("capacity", "index_invalidation", "zombie_deferral"):
+        obs.generator_block_evictions_total().labels(
+            model="metrics-probe", cause=cause).inc()
+    obs.generator_prefix_reuse_depth_hits().labels(
+        model="metrics-probe").observe(3)
+    obs.generator_pool_occupancy_ratio().labels(
+        model="metrics-probe").set(0.62)
+    obs.generator_pool_fragmentation_ratio().labels(
+        model="metrics-probe").set(0.18)
+    obs.hbm_resident_bytes().labels(model="metrics-probe").set(2.1e9)
+    obs.hbm_budget_bytes().set(12.0 * 1024**3)
+    obs.hbm_evictions_total().labels(model="metrics-probe").inc()
+    for phase, ms in (("prefill", 41.0), ("decode", 220.0)):
+        obs.request_device_ms().labels(
+            model="metrics-probe", phase=phase).observe(ms)
+    obs.request_phase_tokens().labels(
+        model="metrics-probe", phase="prefill").observe(128)
+    obs.request_phase_tokens().labels(
+        model="metrics-probe", phase="decode").observe(64)
+    obs.request_held_blocks().labels(
+        model="metrics-probe").observe(5)
+    obs.request_cache_saved_tokens().labels(
+        model="metrics-probe").observe(256)
     problems: List[str] = []
     if resp.status != 200:
         problems.append(

@@ -328,9 +328,15 @@ def test_fault_site_rule_flags_dead_manifest_rows():
     analyze_source(user, "kfserving_tpu/server/dataplane.py", [rule])
     analyze_source("SITES = {}\n",
                    "kfserving_tpu/reliability/fault_sites.py", [rule])
+    from kfserving_tpu.reliability import fault_sites
+
     dead = {f.snippet for f in rule.finalize()}
     assert "DATAPLANE_INFER" not in dead
-    assert "ROUTER_DISPATCH" in dead and len(dead) == 7
+    # Every manifest row except the one with a live inject call above
+    # must be flagged dead — sized off the live manifest so adding a
+    # site doesn't silently shrink the rule's coverage.
+    assert "ROUTER_DISPATCH" in dead
+    assert len(dead) == len(fault_sites.SITES) - 1
 
 
 def test_fault_site_coverage_skipped_without_manifest_in_scan():
