@@ -132,6 +132,8 @@ async def run_soak(minutes: float, qps: float, max_rss_mb: float,
             await asyncio.sleep(5.0)
             reps = orch.replicas("default/soak/predictor")
             if reps and reps[0].handle:
+                # kfslint: disable=async-blocking — /proc reads are
+                # RAM-backed (same waiver as the recycle watchdog's).
                 rss = _proc_rss_mb(reps[0].handle.process.pid)
                 if rss is not None:
                     rss_samples.append(

@@ -18,6 +18,27 @@ DEFAULT_CACHE_DIR = os.path.expanduser("~/.cache/kfserving_tpu/xla")
 _active_dir: Optional[str] = None
 
 
+def note_compilation(source: str, key) -> None:
+    """Every engine reports its first-dispatch-per-shape here (the
+    JaxEngine bucket grid, the generator's decode/prefill/chunk
+    programs).  This module is the funnel because compilation policy
+    lives here: today the note feeds the KFS_SANITIZE recompile
+    assertion (a compile after `source`'s declared warmup is a
+    violation); a disabled sanitizer makes this one env read."""
+    from kfserving_tpu.reliability import sanitizer
+
+    sanitizer.note_compilation(source, key)
+
+
+def declare_warmup_complete(source: str) -> None:
+    """Engines call this when their warmup grid is fully compiled;
+    from then on a note_compilation() for `source` is a sanitizer
+    violation (KFS_SANITIZE=1) instead of expected behavior."""
+    from kfserving_tpu.reliability import sanitizer
+
+    sanitizer.declare_warmup_complete(source)
+
+
 def enable(cache_dir: Optional[str] = None,
            min_compile_time_secs: float = 0.5) -> str:
     """Enable the JAX persistent compilation cache.

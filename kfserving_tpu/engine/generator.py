@@ -60,6 +60,7 @@ params + cache against engine/hbm.py's budget.
 
 import asyncio
 import concurrent.futures
+import itertools
 import logging
 import time
 from collections import deque
@@ -68,12 +69,19 @@ from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from kfserving_tpu.engine import compile_cache
 from kfserving_tpu.observability import attribution
 from kfserving_tpu.observability import metrics as obs
 from kfserving_tpu.observability.profiling import TIMELINE
 from kfserving_tpu.protocol.errors import InferenceError, InvalidInput
+from kfserving_tpu.reliability import sanitizer
 
 logger = logging.getLogger("kfserving_tpu.engine.generator")
+
+# Monotonic engine ids for the sanitizer's recompile assertion (see
+# jax_engine._engine_seq): a model name alone would let a reloaded
+# engine inherit its predecessor's warmup declaration.
+_generator_seq = itertools.count()
 
 
 @dataclass
@@ -228,6 +236,17 @@ class GenerationEngine:
         # concurrent temperature requests differ from each other, and
         # an explicit seed reproduces exactly.
         self._seed_counter = 0
+        # First-dispatch-per-program ledger feeding the KFS_SANITIZE
+        # recompile assertion: every (kind, shape-signature) this
+        # engine dispatches is noted once through compile_cache —
+        # a new program after declared warmup is a violation.  Only
+        # touched on the single-threaded enqueue executor.  The
+        # source is process-monotonic (never just the model name): a
+        # reloaded engine with the same name must not inherit its
+        # predecessor's warmup declaration.
+        self._dispatched_programs: set = set()
+        self.sanitize_source = (
+            f"generator:{self.name}:{next(_generator_seq)}")
 
         n_layers = cfg.num_layers
         cache_dtype = cfg.dtype
@@ -1659,6 +1678,7 @@ class GenerationEngine:
         # drop via the block_idx >= mb guard in paged_write.
         bpc = C // self.block_size
         nb = min((idx + 1) * bpc, self._tables.shape[1])
+        self._note_program("chunk", nb)
         with self._block_lock:
             row = self._tables[slot:slot + 1, :nb].copy()
         (first, self._caches, chosen_lp, top_ids, top_lps) = \
@@ -1697,7 +1717,17 @@ class GenerationEngine:
         # delivery order.
         inflight: deque = deque()
         try:
-            await self._run_pipeline(loop, inflight)
+            # KFS_SANITIZE=1: jax.transfer_guard("disallow") armed on
+            # this (the scheduler's) thread for the pipeline's whole
+            # life — any implicit host<->device transfer inside the
+            # decode loop raises, is counted as a forbidden_transfer
+            # violation, and fails generation loudly.  The sanctioned
+            # fetch/enqueue paths run on executor threads the guard
+            # (thread-local) never covers, and additionally wrap
+            # themselves in sanitizer.sanctioned_fetch().  Disabled,
+            # loop_guard is one env read.
+            with sanitizer.loop_guard(self.name):
+                await self._run_pipeline(loop, inflight)
         finally:
             # A global failure (or close) can leave eagerly-submitted
             # fetch futures behind; consume their exceptions so a
@@ -2164,11 +2194,23 @@ class GenerationEngine:
                         zip(lp[1][i][:n_lp], lp[2][i][:n_lp])])
             self._emit(slot, int(firsts[i]), rec)
 
+    def _note_program(self, kind: str, *signature) -> None:
+        """Record one dispatched program shape (enqueue-executor
+        thread only).  The first sighting per (kind, signature) flows
+        to compile_cache.note_compilation — post-warmup sightings are
+        KFS_SANITIZE recompile violations; off, this is a set probe."""
+        key = (kind,) + signature
+        if key not in self._dispatched_programs:
+            self._dispatched_programs.add(key)
+            compile_cache.note_compilation(self.sanitize_source, key)
+
     def _enqueue_wave(self):
         """Dispatch one K-step decode wave (non-blocking: JAX async
         dispatch).  Consumes the device-resident caches + feed arrays
         and replaces them with the wave's output handles."""
         jnp = self._jnp
+        self._note_program("decode", self.max_slots,
+                           self.steps_per_call)
         temps, top_ks, top_ps, seeds, want_lp = self._sampling_arrays()
         (toks, self._caches, self._feed_tokens, self._feed_positions,
          chosen_lp, top_ids, top_lps) = self._decode(
@@ -2195,10 +2237,17 @@ class GenerationEngine:
         Returns (tokens, lp, wait_s); the caller attributes the wait
         to decode or prefill (this path serves both kinds)."""
         t0 = time.perf_counter()
-        tokens = np.asarray(toks_h)
-        lp = None
-        if lp_h is not None:
-            lp = tuple(np.asarray(h) for h in lp_h)
+        # THE sanctioned generation fetch: the one place device
+        # handles become host arrays, on the fetch executor.
+        with sanitizer.sanctioned_fetch():
+            # kfslint: disable=host-sync — sanctioned fetch site: the
+            # wave's D2H join, off-loop on the fetch executor.
+            tokens = np.asarray(toks_h)
+            lp = None
+            if lp_h is not None:
+                # kfslint: disable=host-sync — sanctioned fetch site:
+                # logprob handles fetched beside their wave's tokens.
+                lp = tuple(np.asarray(h) for h in lp_h)
         return tokens, lp, time.perf_counter() - t0
 
     def _enqueue_prefill_group(self, group: List[_Request],
@@ -2251,6 +2300,7 @@ class GenerationEngine:
                                                      [0.0, 0.0])
         rec[0] += sum(int(r.prompt_ids.size) for r in group)
         rec[1] += b_bucket * bucket
+        self._note_program("prefill", b_bucket, bucket)
         firsts, new_caches, chosen_lp, top_ids, top_lps = \
             self._prefill(
                 self.variables, jnp.asarray(ids), jnp.asarray(lengths),

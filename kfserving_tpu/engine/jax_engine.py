@@ -20,6 +20,7 @@ new native heart:
 import asyncio
 import concurrent.futures
 import contextvars
+import itertools
 import logging
 import os
 import time
@@ -27,10 +28,17 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from kfserving_tpu.engine import compile_cache
 from kfserving_tpu.engine.buckets import BucketPolicy
 from kfserving_tpu.observability.profiling import TIMELINE
+from kfserving_tpu.reliability import sanitizer
 
 logger = logging.getLogger("kfserving_tpu.engine")
+
+# Monotonic engine ids for the sanitizer's recompile assertion:
+# id(self) would recycle addresses across engine unload/load, making
+# a fresh engine inherit its predecessor's warmup declaration.
+_engine_seq = itertools.count()
 
 
 def device_peak_flops() -> Optional[float]:
@@ -172,6 +180,12 @@ class JaxEngine:
         # that paid full materialization — the lifecycle SOAK's
         # per-replica evidence that the mmap cache actually engaged.
         self.param_source = param_source
+        # Identity for the KFS_SANITIZE recompile assertion: each
+        # engine declares its own warmup, so one engine warming never
+        # flags another engine serving.  Process-monotonic (never an
+        # address): a recycled id would hand a fresh engine its
+        # predecessor's warmup declaration.
+        self.sanitize_source = f"jax_engine:{next(_engine_seq)}"
 
     # -- shape plumbing ------------------------------------------------------
     def _pad_to_bucket(self, arr: np.ndarray) -> Tuple[np.ndarray, int]:
@@ -252,7 +266,13 @@ class JaxEngine:
                 # pure device time and fetch_ms pure D2H.
                 out = self._jax.block_until_ready(out)
             t2 = time.perf_counter()
-            result = self._jax.tree.map(lambda a: np.asarray(a)[:n], out)
+            # THE sanctioned result fetch: this executor thread is
+            # where device results become host arrays by design.
+            with sanitizer.sanctioned_fetch():
+                result = self._jax.tree.map(
+                    # kfslint: disable=host-sync — sanctioned fetch
+                    # site: the engine's one D2H join, worker thread.
+                    lambda a: np.asarray(a)[:n], out)
             t3 = time.perf_counter()
             first = (padded[next(iter(padded))]
                      if isinstance(padded, dict) else padded)
@@ -283,9 +303,11 @@ class JaxEngine:
             TIMELINE.record("device", "engine.execute",
                             dur_s=t3 - t1, trace_id=trace_id,
                             attrs={"bucket": int(bucket), "batch": n})
+            first_dispatch = False
             with self._stats_lock:
                 if flops_key not in self._compiled_shapes:
                     self._compiled_shapes.add(flops_key)
+                    first_dispatch = True
                     obs.compile_cache_events().labels(
                         outcome="miss").inc()
                     TIMELINE.record(
@@ -310,6 +332,12 @@ class JaxEngine:
                     + (bucket - n) / bucket
                 self._slots_total += bucket
                 self._padded_slots_total += bucket - n
+            if first_dispatch:
+                # Sanitizer feed, OUTSIDE the stats lock: a recompile
+                # violation's counter+pin work must not convoy the
+                # other executor workers behind telemetry.
+                compile_cache.note_compilation(self.sanitize_source,
+                                               flops_key)
         return result
 
     async def predict(self, inputs: Any) -> Any:
@@ -369,6 +397,14 @@ class JaxEngine:
                 self.compile_count += 1
                 self._record_flops(b, batch)
         dt = time.perf_counter() - start
+        # Full-grid warmup closes this engine's shape set: arm the
+        # sanitizer's recompile assertion.  A minimal warmup
+        # deliberately leaves programs to load on demand — those
+        # late loads are the chosen trade, not violations, so the
+        # source stays unarmed.
+        if not minimal:
+            compile_cache.declare_warmup_complete(
+                self.sanitize_source)
         # Warmup executes exactly-full batches of every program; leaving
         # them in the traffic counters would report phantom bucket hits
         # and dilute slot_pad_waste toward 0 on short runs.  Timing /

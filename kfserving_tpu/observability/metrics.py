@@ -598,3 +598,22 @@ def param_cache_total():
         "kfserving_tpu_param_cache_total",
         "mmap param-cache lookups and stores, by outcome "
         "(hit|miss|store|error)")
+
+
+# -- device-discipline sanitizer (KFS_SANITIZE=1) ----------------------
+def sanitizer_violations_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_sanitizer_violations_total",
+        "Runtime device-discipline violations by kind "
+        "(forbidden_transfer: implicit host<->device transfer under "
+        "the armed guard; recompile: a compilation after a source's "
+        "declared warmup; loop_stall: the event loop failed to run a "
+        "watchdog tick within the threshold)")
+
+
+def sanitizer_armed():
+    return REGISTRY.gauge(
+        "kfserving_tpu_sanitizer_armed",
+        "1 while KFS_SANITIZE=1 has the runtime sanitizer active in "
+        "this process (transfer guard + recompile assertion + loop "
+        "watchdog)")

@@ -283,7 +283,15 @@ class ModelServer:
 
     async def _server_ready(self, req: Request) -> Response:
         ready = self.dataplane.server_ready()
-        return _json({"ready": ready}, status=200 if ready else 503)
+        body = {"ready": ready}
+        from kfserving_tpu.reliability import sanitizer
+
+        if sanitizer.enabled():
+            # Sanitize runs surface their discipline state where the
+            # probe already looks: armed sources, violation counts
+            # (all zero = the clean bill the smoke gate asserts).
+            body["sanitizer"] = sanitizer.status()
+        return _json(body, status=200 if ready else 503)
 
     async def _server_metadata(self, req: Request) -> Response:
         return _json(self.dataplane.server_metadata())
@@ -917,6 +925,30 @@ class ModelServer:
             self.register_model(model)
         for service in self.services:
             await service.start()
+        # Device-discipline sanitizer (KFS_SANITIZE=1): violations
+        # pin into this server's flight recorder, and the stall
+        # watchdog heartbeats the serving loop.  Disabled: two env
+        # reads, nothing armed.  Ownership matters: the watchdog is
+        # process-global, so only the server that started it stops
+        # it — a second in-process server must not tear down the
+        # first one's on ITS stop.
+        from kfserving_tpu.reliability import sanitizer
+
+        self._owns_sanitizer_watchdog = False
+        if sanitizer.enabled():
+            self._owns_sanitizer_watchdog = (
+                sanitizer.start_watchdog(
+                    asyncio.get_running_loop()) is not None)
+            if self._owns_sanitizer_watchdog:
+                # Only the owning server wires the process-global
+                # recorder attachment and armed gauge — a second
+                # in-process server must not steal the first one's
+                # pinned-violation feed or flip its telemetry.
+                sanitizer.attach_flight_recorder(
+                    self.monitoring.flight_recorder)
+                from kfserving_tpu.observability import metrics as obs
+
+                obs.sanitizer_armed().set(1)
         await self.http_server.start(host, self.http_port)
         self.http_port = self.http_server.port
         if self.grpc_port is not None:
@@ -962,6 +994,18 @@ class ModelServer:
         return False
 
     async def stop_async(self) -> None:
+        from kfserving_tpu.reliability import sanitizer
+
+        if getattr(self, "_owns_sanitizer_watchdog", False):
+            sanitizer.stop_watchdog()
+            # Detach our recorder too: a stopped server's buffer has
+            # no /debug surface left, and the global reference would
+            # pin this server's object graph for the process life.
+            sanitizer.attach_flight_recorder(None)
+            self._owns_sanitizer_watchdog = False
+            from kfserving_tpu.observability import metrics as obs
+
+            obs.sanitizer_armed().set(0)
         if self.grpc_server is not None:
             await self.grpc_server.stop()
             self.grpc_server = None

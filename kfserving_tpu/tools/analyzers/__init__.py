@@ -9,10 +9,13 @@ With no paths it analyzes the installed ``kfserving_tpu`` package.
 Exit 0 means: zero findings that are neither pragma-suppressed nor in
 the committed baseline, AND zero stale baseline entries.
 
-Rules (see ``asyncrules.py`` / ``discipline.py`` for the defect class
-each one encodes): ``async-blocking``, ``spin-loop``,
-``await-under-lock``, ``cancellation-safety``, ``fault-site``,
-``metric-name`` (the last two are the serving-discipline pair).
+Rules (see ``asyncrules.py`` / ``discipline.py`` / ``devicerules.py``
+for the defect class each one encodes): the concurrency four
+(``async-blocking``, ``spin-loop``, ``await-under-lock``,
+``cancellation-safety``), the serving-discipline pair
+(``fault-site``, ``metric-name``), and the XLA/JAX device tier
+(``host-sync``, ``jit-recompile-hazard``, ``blocking-dispatch``,
+``prng-key-reuse``).
 
 Suppression: ``# kfslint: disable=<rule>[,<rule>]  <justification>``
 on the finding's line.  Known legacy findings live in
@@ -39,6 +42,12 @@ from kfserving_tpu.tools.analyzers.core import (
     load_baseline,
     save_baseline,
 )
+from kfserving_tpu.tools.analyzers.devicerules import (
+    BlockingDispatchRule,
+    HostSyncRule,
+    JitRecompileHazardRule,
+    PrngKeyReuseRule,
+)
 from kfserving_tpu.tools.analyzers.discipline import (
     FaultSiteRule,
     MetricNameRule,
@@ -48,7 +57,7 @@ __all__ = [
     "Finding", "Rule", "analyze_paths", "analyze_snippets",
     "analyze_source", "apply_baseline", "load_baseline",
     "save_baseline", "default_rules", "rule_ids",
-    "default_baseline_path", "default_target",
+    "default_baseline_path", "default_target", "default_targets",
 ]
 
 
@@ -57,7 +66,9 @@ def default_rules() -> List[Rule]:
     instances across runs)."""
     return [AsyncBlockingRule(), SpinLoopRule(), AwaitUnderLockRule(),
             CancellationSafetyRule(), FaultSiteRule(),
-            MetricNameRule()]
+            MetricNameRule(), HostSyncRule(),
+            JitRecompileHazardRule(), BlockingDispatchRule(),
+            PrngKeyReuseRule()]
 
 
 def rule_ids() -> List[str]:
@@ -72,3 +83,23 @@ def default_target() -> str:
     """The installed package root — what a bare `kfs-lint` analyzes."""
     import kfserving_tpu
     return os.path.dirname(os.path.abspath(kfserving_tpu.__file__))
+
+
+def default_targets() -> List[str]:
+    """Everything a bare `kfs-lint` (and the fast-tier gate) scans:
+    the package tree plus the `benchmarks/` and `tests/` trees living
+    next to it when present — bench drivers and tests run the same
+    event-loop/device disciplines the package does, and a spin-loop
+    in a test hangs CI exactly like one in the scheduler would."""
+    pkg = default_target()
+    roots = [pkg]
+    repo = os.path.dirname(pkg)
+    # Only a repo checkout carries its pyproject next to the package;
+    # in site-packages a sibling `tests/` dir is some OTHER
+    # distribution's packaging accident, not ours to lint.
+    if os.path.isfile(os.path.join(repo, "pyproject.toml")):
+        for extra in ("benchmarks", "tests"):
+            path = os.path.join(repo, extra)
+            if os.path.isdir(path):
+                roots.append(path)
+    return roots

@@ -16,7 +16,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "paths", nargs="*",
         help="files/directories to analyze (default: the installed "
-             "kfserving_tpu package)")
+             "kfserving_tpu package plus the benchmarks/ and tests/ "
+             "trees next to it)")
     parser.add_argument(
         "--baseline", default=None,
         help="baseline JSON path (default: the committed "
@@ -37,8 +38,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="print rule ids and descriptions, then exit")
     parser.add_argument(
         "--json", action="store_true", dest="as_json",
-        help="emit findings as JSON")
+        help="emit findings as JSON (alias for --format json)")
+    parser.add_argument(
+        "--format", choices=("text", "json", "github"),
+        default=None, dest="fmt",
+        help="output mode: text (default), json, or github "
+             "workflow-annotation lines (::error file=...,line=...) "
+             "so CI surfaces findings inline on the PR diff")
     args = parser.parse_args(argv)
+    fmt = args.fmt or ("json" if args.as_json else "text")
 
     if args.list_rules:
         for rule in analyzers.default_rules():
@@ -56,7 +64,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"wrote {path}")
         return 0
 
-    paths = args.paths or [analyzers.default_target()]
+    paths = args.paths or analyzers.default_targets()
     try:
         findings = analyzers.analyze_paths(paths,
                                            analyzers.default_rules())
@@ -75,11 +83,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         else analyzers.load_baseline(baseline_path)
     new, stale = analyzers.apply_baseline(findings, baseline)
 
-    if args.as_json:
+    if fmt == "json":
         print(json.dumps({
             "findings": [vars(f) for f in new],
             "stale_baseline": stale,
         }, indent=2))
+    elif fmt == "github":
+        # One workflow-annotation line per finding: GitHub renders
+        # these inline on the PR diff.  Newlines would start a new
+        # (malformed) annotation, so flatten the message.
+        for f in sorted(new, key=lambda f: (f.path, f.line, f.rule)):
+            msg = " ".join(f.message.split())
+            print(f"::error file={f.path},line={f.line},"
+                  f"title=kfslint {f.rule}::{msg}")
+        for entry in stale:
+            print(f"::error file={entry.get('path')},line=1,"
+                  f"title=kfslint stale-baseline::stale baseline "
+                  f"entry [{entry.get('rule')}] "
+                  f"{entry.get('snippet')!r} — the finding no longer "
+                  f"exists; remove it from {baseline_path}")
     else:
         for f in sorted(new, key=lambda f: (f.path, f.line, f.rule)):
             print(f.render())

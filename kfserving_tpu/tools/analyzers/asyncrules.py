@@ -36,6 +36,7 @@ from kfserving_tpu.tools.analyzers.core import (
     Rule,
     contains_await,
     dotted_name,
+    is_test_function,
     iter_body_nodes,
 )
 
@@ -129,11 +130,31 @@ class AsyncBlockingRule(Rule):
     EXACTLY ONCE in the scanned tree (a `load` defined 18 times tells
     us nothing; a `_persist_credentials` defined once tells us
     everything), which keeps the interprocedural pass from guessing.
+
+    Two shapes are exempt from the helper pass:
+
+    - executor offloads — `loop.run_in_executor(...)` /
+      `asyncio.to_thread(...)` schedule work off-loop, and
+      `functools.partial(...)` only binds arguments; none of the
+      three blocks even when the scanned tree contains a same-named
+      fake (a test double's `run_in_executor` calling the fn inline
+      must not poison every real offload in the tree).  A blocking
+      callable passed BY REFERENCE through them never fires; a call
+      evaluated in the argument list (`to_thread(self._load())`)
+      still does.
+    - awaited calls — `await call(payload)` proves the callee is a
+      coroutine function, so matching it to a same-named *sync* def
+      elsewhere in the tree is definitionally wrong (the PR 14
+      `retry.call` false-positive class).
     """
 
     id = "async-blocking"
     description = ("blocking call (time.sleep, requests.*, file/"
                    "subprocess/socket I/O) on an event-loop path")
+
+    # Offload/binding vocabulary: these schedule or curry, never
+    # block, whatever a same-named def in the scanned tree does.
+    _OFFLOAD_NAMES = {"run_in_executor", "to_thread", "partial"}
 
     def __init__(self):
         # bare def name -> count across the scanned tree (sync+async)
@@ -163,12 +184,19 @@ class AsyncBlockingRule(Rule):
                         if p and primitive is None:
                             primitive = p
                         bare = _bare_call_name(n)
-                        if bare:
+                        if bare and bare not in self._OFFLOAD_NAMES:
                             calls.add(bare)
                 if node.name not in self._sync_defs \
                         or primitive is not None:
                     self._sync_defs[node.name] = (primitive, calls)
         for fn in iter_async_functions(tree):
+            if is_test_function(fn.name):
+                # A test's loop has no other traffic to stall; see
+                # core.is_test_function for the scoping policy.
+                continue
+            awaited = {id(n.value) for n in iter_body_nodes(fn.body)
+                       if isinstance(n, ast.Await)
+                       and isinstance(n.value, ast.Call)}
             for node in iter_body_nodes(fn.body):
                 if not isinstance(node, ast.Call):
                     continue
@@ -181,7 +209,11 @@ class AsyncBlockingRule(Rule):
                         f"loop (run it in an executor)")
                     continue
                 bare = _bare_call_name(node)
-                if bare:
+                # An awaited callable is a coroutine function — a
+                # same-named SYNC def elsewhere cannot be this
+                # callee.  Offload/binding calls never block.
+                if bare and id(node) not in awaited \
+                        and bare not in self._OFFLOAD_NAMES:
                     line = node.lineno
                     self._candidates.append(
                         (ctx.path, line, ctx.snippet(line), fn.name,

@@ -1,0 +1,863 @@
+"""kfslint device tier — XLA/JAX hot-path discipline rules.
+
+The repo's whole perf story rests on two invariants nothing enforced
+until now: decode waves never synchronize with the host implicitly,
+and jitted programs are compiled per *bucket*, never per request.
+Each rule here encodes a defect class that silently destroys MFU
+instead of crashing:
+
+- `host-sync`: an implicit device→host transfer (`float()`/`int()`/
+  `bool()`/`.item()`/`.tolist()`/`np.asarray` on a value data-flowed
+  from a `jax.*` call or a jitted dispatch) inside an `async def` or
+  a wave/dispatch-named sync function joins the device stream on the
+  spot — one stray `float(logits[0])` turns an async pipeline into a
+  lock-step one.  The *sanctioned* fetch points (`_fetch_wave`, the
+  engine's result fetch) carry line-tight pragmas naming themselves
+  sanctioned; everything else must fetch on the executor.
+- `jit-recompile-hazard`: a request-derived Python size (`len(...)`,
+  `.size`, `.shape[i]`) reaching a jitted callable — directly or as
+  an array-constructor dimension — without passing through a
+  bucketing call compiles one executable per distinct request shape
+  (the recompile storm `engine/buckets.py` exists to prevent).  Also
+  flags f-strings and unhashable literals in `static_argnums`
+  positions: every distinct value is its own cache entry (or a
+  TypeError at trace time).
+- `blocking-dispatch`: device work (a jitted callable,
+  `block_until_ready`, `device_put`, or `jax.jit` itself) invoked in
+  an `async def` body stalls the event loop for device/compile time —
+  the device twin of `async-blocking`; the same calls under a held
+  `threading` lock convoy every worker behind a dispatch (the
+  `await-under-lock` class extended to device work).
+- `prng-key-reuse`: the same `jax.random` key consumed by two sample
+  calls without an intervening `split`/`fold_in` silently correlates
+  the draws — two "independent" sampling noises become identical.
+
+Dataflow is per-function and deliberately shallow (assignment-chain
+taint, no cross-function propagation): deep inference would guess,
+and a rule that guesses trains people to ignore it.  Two conventions
+make the shallow analysis precise where it matters: device handles
+passed between wave helpers are named `*_h` (taint sources), and
+sync hot-path helpers carry a wave/dispatch/prefill/decode/fetch name
+segment (scope markers).
+"""
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from kfserving_tpu.tools.analyzers.asyncrules import (
+    _classify_locks,
+    _import_aliases,
+    _lockish_name,
+    _resolve,
+)
+from kfserving_tpu.tools.analyzers.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    is_test_function,
+)
+
+# Sync functions with one of these whole snake_case segments in their
+# name are hot-path device code (they run on the engine's enqueue/
+# fetch executors): `_fetch_wave`, `_execute_sync`,
+# `_enqueue_prefill_group`.  `decoder_tiny` ("decoder") is not.
+_HOT_SEGMENTS = {"wave", "waves", "dispatch", "execute", "prefill",
+                 "decode", "fetch"}
+
+# Attribute access that yields host METADATA of a device array, not
+# its contents — `int(x.shape[0])` is free and must not taint.
+_METADATA_ATTRS = {"shape", "dtype", "ndim", "size", "sharding",
+                   "device", "devices"}
+
+_DEVICE_HANDLE_PARAM = re.compile(r"_h\d*$")
+
+
+def _taint_target(tainted: Set[str], target: ast.AST) -> None:
+    """Record an assignment target (Name, self-attribute, or any
+    nesting of tuple/list/starred unpacking) into a taint set."""
+    if isinstance(target, ast.Name):
+        tainted.add(target.id)
+    elif isinstance(target, ast.Attribute):
+        tainted.add(target.attr)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            _taint_target(tainted, elt)
+    elif isinstance(target, ast.Starred):
+        _taint_target(tainted, target.value)
+
+
+def _untaint_target(tainted: Set[str], target: ast.AST) -> None:
+    """Reassignment from a clean RHS KILLS taint — `toks = await
+    loop.run_in_executor(ex, fetch, toks)` refetches through the
+    executor into the same name, and the name is host-clean after."""
+    if isinstance(target, ast.Name):
+        tainted.discard(target.id)
+    elif isinstance(target, ast.Attribute):
+        tainted.discard(target.attr)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            _untaint_target(tainted, elt)
+    elif isinstance(target, ast.Starred):
+        _untaint_target(tainted, target.value)
+
+
+def _hot_sync_name(name: str) -> bool:
+    return any(seg in _HOT_SEGMENTS for seg in name.lower().split("_"))
+
+
+_is_test_function = is_test_function
+
+
+def _call_parts(call: ast.Call) -> Tuple[Optional[str], List[str]]:
+    name = dotted_name(call.func)
+    return name, (name.split(".") if name else [])
+
+
+def _is_device_call(call: ast.Call, aliases: Dict[str, str],
+                    jitted: Set[str]) -> bool:
+    """Does this call produce (or consume into) device values — a
+    `jax.*`/`jnp.*` op, a jitted callable, or a device placement?"""
+    name, parts = _call_parts(call)
+    if name is None:
+        return False
+    resolved = _resolve(name, aliases)
+    if resolved == "jax" or resolved.startswith("jax."):
+        return True
+    # `self._jnp.asarray(...)` / `self._jax.device_put(...)`: the
+    # engine's stashed module handles.
+    if any(p in ("jax", "jnp", "_jax", "_jnp") for p in parts[:-1]):
+        return True
+    bare = parts[-1]
+    return bare in jitted or bare in ("device_put",
+                                      "block_until_ready")
+
+
+def collect_jitted(tree: ast.Module, aliases: Dict[str, str]
+                   ) -> Dict[str, Tuple[int, ...]]:
+    """{bare callable name: static_argnums positions} for every
+    jit-wrapped callable the file creates — `f = jax.jit(g, ...)`
+    assignments (Name or attribute targets: `self._decode = ...`) and
+    `@jax.jit` / `@partial(jax.jit, ...)` decorated defs."""
+
+    def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+        if not isinstance(node, ast.Call):
+            return None
+        name = dotted_name(node.func)
+        if name is None:
+            return None
+        resolved = _resolve(name, aliases)
+        if resolved in ("jax.jit", "jax.pjit", "pjit.pjit"):
+            return node
+        # `partial(jax.jit, static_argnums=...)` decorator spelling:
+        # the partial call carries the static positions.
+        if resolved.rsplit(".", 1)[-1] == "partial" and node.args:
+            inner_name = dotted_name(node.args[0])
+            if inner_name and _resolve(inner_name, aliases) in (
+                    "jax.jit", "jax.pjit"):
+                return node
+        return None
+
+    def _static_positions(call: ast.Call) -> Tuple[int, ...]:
+        for kw in call.keywords:
+            if kw.arg in ("static_argnums", "static_argnames"):
+                v = kw.value
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    out = []
+                    for elt in v.elts:
+                        if isinstance(elt, ast.Constant) \
+                                and isinstance(elt.value, int):
+                            out.append(elt.value)
+                    return tuple(out)
+        return ()
+
+    jitted: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            call = _jit_call(node.value)
+            if call is None:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    jitted[target.id] = _static_positions(call)
+                elif isinstance(target, ast.Attribute):
+                    jitted[target.attr] = _static_positions(call)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                call = _jit_call(dec)
+                if call is not None:
+                    jitted[node.name] = _static_positions(call)
+                    continue
+                name = dotted_name(dec)
+                if name and _resolve(name, aliases) in ("jax.jit",
+                                                        "jax.pjit"):
+                    jitted[node.name] = ()
+    return jitted
+
+
+def _iter_scoped_functions(tree: ast.Module
+                           ) -> Iterator[Tuple[ast.AST, str]]:
+    """Every function the device rules scope to: all `async def`s plus
+    sync defs with a hot-path name segment.  Each is scanned
+    independently; the statement walkers below never descend into
+    nested defs (they get their own visit if in scope)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                or _is_test_function(node.name):
+            continue
+        if isinstance(node, ast.AsyncFunctionDef):
+            yield node, f"async def {node.name}"
+        elif _hot_sync_name(node.name):
+            yield node, f"def {node.name}"
+
+
+# -- rule 1: host-sync -------------------------------------------------------
+
+_SCALAR_SINKS = {"float", "int", "bool"}
+_METHOD_SINKS = {"item", "tolist"}
+_FETCH_FNS = {"numpy.asarray", "numpy.array", "jax.device_get"}
+
+
+class _TaintScan:
+    """One function body's device-value taint walk (source order,
+    branch bodies share the taint set — a value tainted on any path
+    stays tainted; over-approximation is the right failure mode for a
+    transfer rule backed by line-tight pragmas)."""
+
+    def __init__(self, rule: "HostSyncRule", fn, where: str,
+                 ctx: FileContext, aliases: Dict[str, str],
+                 jitted: Set[str], findings: List[Finding]):
+        self.rule = rule
+        self.fn = fn
+        self.where = where
+        self.ctx = ctx
+        self.aliases = aliases
+        self.jitted = jitted
+        self.findings = findings
+        self.tainted: Set[str] = set()
+        for arg in (list(fn.args.posonlyargs) + list(fn.args.args)
+                    + list(fn.args.kwonlyargs)):
+            # Device-handle naming convention: `toks_h`, `lp_h` — a
+            # handle passed between wave helpers is still on device.
+            if _DEVICE_HANDLE_PARAM.search(arg.arg):
+                self.tainted.add(arg.arg)
+
+    # -- expression taint --------------------------------------------------
+    def expr_tainted(self, expr: Optional[ast.AST]) -> bool:
+        if expr is None:
+            return False
+        for node in self._walk_expr(expr):
+            if isinstance(node, ast.Name) and node.id in self.tainted:
+                return True
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in self.tainted \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in ("self", "cls"):
+                return True
+            if isinstance(node, ast.Call) \
+                    and _is_device_call(node, self.aliases,
+                                        self.jitted):
+                return True
+        return False
+
+    @staticmethod
+    def _walk_expr(expr: ast.AST) -> Iterator[ast.AST]:
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            # `.shape[0]` / `.dtype` etc. are host metadata — a sink
+            # over them is free, so taint must not flow through.
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _METADATA_ATTRS:
+                continue
+            if isinstance(node, ast.Lambda):
+                continue  # examined separately via _lambda_args
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- sinks -------------------------------------------------------------
+    def _sink(self, call: ast.Call) -> Optional[str]:
+        """If `call` is a host-materialization, name the sink."""
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in _SCALAR_SINKS:
+            if any(self.expr_tainted(a) for a in call.args):
+                return f"{call.func.id}()"
+            return None
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr in _METHOD_SINKS:
+            # Checked before dotted-name resolution: a subscripted
+            # receiver (`toks[0].item()`) has no dotted name.
+            if self.expr_tainted(call.func.value):
+                return f".{call.func.attr}()"
+            return None
+        name, parts = _call_parts(call)
+        if name is None:
+            return None
+        resolved = _resolve(name, self.aliases)
+        if (resolved in _FETCH_FNS
+                or parts[-1] == "asarray"
+                and any(p in ("np", "numpy") for p in parts[:-1])):
+            if any(self.expr_tainted(a) for a in call.args):
+                return f"{name}()"
+        return None
+
+    def _fire(self, node: ast.AST, sink: str) -> None:
+        self.findings.append(self.ctx.finding(
+            self.rule.id, node,
+            f"implicit device->host sync: {sink} on a value from "
+            f"jax/engine dispatch inside '{self.where}' joins the "
+            f"device stream on the spot — fetch on the executor, or "
+            f"pragma the line as a sanctioned fetch site"))
+
+    def _scan_call(self, call: ast.Call) -> None:
+        sink = self._sink(call)
+        if sink is not None:
+            self._fire(call, sink)
+            return
+        # `tree.map(lambda a: np.asarray(a), out)`: a lambda applied
+        # over a tainted argument fetches every leaf — scan the
+        # lambda body with its params tainted.
+        lambdas = [a for a in call.args
+                   if isinstance(a, ast.Lambda)]
+        if lambdas and any(self.expr_tainted(a) for a in call.args
+                           if not isinstance(a, ast.Lambda)):
+            for lam in lambdas:
+                inner = set(self.tainted)
+                inner.update(a.arg for a in lam.args.args)
+                saved, self.tainted = self.tainted, inner
+                for sub in ast.walk(lam.body):
+                    if isinstance(sub, ast.Call):
+                        s = self._sink(sub)
+                        if s is not None:
+                            self._fire(sub, s)
+                self.tainted = saved
+
+    # -- statements --------------------------------------------------------
+    def _taint_target(self, target: ast.AST) -> None:
+        _taint_target(self.tainted, target)
+
+    def scan(self, stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate execution context, separate visit
+            # An awaited value crossed back through the event loop
+            # (the executor already fetched it): `await fut` results
+            # are host values, so strip Await before taint analysis.
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                value = stmt.value
+                awaited = isinstance(value, ast.Await)
+                if awaited:
+                    value = value.value
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                if not awaited and self.expr_tainted(value):
+                    for t in targets:
+                        self._taint_target(t)
+                elif not isinstance(stmt, ast.AugAssign):
+                    # Clean (or awaited — already fetched) RHS: the
+                    # reassigned name is host-clean now.  AugAssign
+                    # keeps old taint (x += clean stays device).
+                    for t in targets:
+                        _untaint_target(self.tainted, t)
+            if isinstance(stmt, (ast.For, ast.AsyncFor)) \
+                    and self.expr_tainted(stmt.iter):
+                self._taint_target(stmt.target)
+            # Comprehension targets over tainted iterables are
+            # tainted too: `tuple(np.asarray(h) for h in lp_h)` is a
+            # fetch per element.
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.GeneratorExp, ast.ListComp,
+                                     ast.SetComp, ast.DictComp)):
+                    for gen in node.generators:
+                        if self.expr_tainted(gen.iter):
+                            self._taint_target(gen.target)
+            for call in self._stmt_calls(stmt):
+                self._scan_call(call)
+            for body in self._child_bodies(stmt):
+                self.scan(body)
+
+    @staticmethod
+    def _stmt_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+        """Call nodes belonging to THIS statement (not to child
+        blocks or nested defs)."""
+        bodies = set()
+        for body in _TaintScan._child_bodies(stmt):
+            for s in body:
+                bodies.add(s)
+        stack: List[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            if node in bodies or isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _child_bodies(stmt: ast.stmt) -> Iterator[List[ast.stmt]]:
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(stmt, field, None)
+            if isinstance(block, list) and block \
+                    and isinstance(block[0], ast.stmt):
+                yield block
+        for handler in getattr(stmt, "handlers", []):
+            yield handler.body
+
+
+class HostSyncRule(Rule):
+    id = "host-sync"
+    description = ("implicit device->host transfer (float/int/bool/"
+                   ".item/.tolist/np.asarray on a jax value) in an "
+                   "async def or wave/dispatch function")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Finding]:
+        aliases = _import_aliases(tree)
+        jitted = set(collect_jitted(tree, aliases))
+        findings: List[Finding] = []
+        for fn, where in _iter_scoped_functions(tree):
+            scan = _TaintScan(self, fn, where, ctx, aliases, jitted,
+                              findings)
+            scan.scan(fn.body)
+        return iter(findings)
+
+
+# -- rule 2: jit-recompile-hazard -------------------------------------------
+
+_ARRAY_CTORS = {"zeros", "ones", "full", "empty", "arange"}
+_CLEANSE_SEGMENTS = {"fit", "bucket", "buckets"}
+
+
+def _cleansing_call(call: ast.Call) -> bool:
+    """A call through the bucketing vocabulary quantizes its input:
+    `policy.fit(n)`, `self._bucket_for(n)`, `pow2_buckets(n)`."""
+    name, parts = _call_parts(call)
+    if name is None:
+        return False
+    segs = set()
+    for part in parts:
+        segs.update(part.lower().split("_"))
+    return bool(segs & _CLEANSE_SEGMENTS)
+
+
+class _SizeScan:
+    """Raw request-derived sizes (len()/.size/.shape[i]) flowing to
+    jitted callables, per function, source order."""
+
+    def __init__(self, rule: "JitRecompileHazardRule", fn, where: str,
+                 ctx: FileContext, aliases: Dict[str, str],
+                 jitted: Dict[str, Tuple[int, ...]],
+                 findings: List[Finding]):
+        self.rule = rule
+        self.where = where
+        self.ctx = ctx
+        self.aliases = aliases
+        self.jitted = jitted
+        self.findings = findings
+        self.tainted: Set[str] = set()
+
+    def _size_source(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            if _cleansing_call(expr):
+                return False
+            name, _parts = _call_parts(expr)
+            if name is not None \
+                    and _resolve(name, self.aliases) == "len":
+                return True
+            # int()/round() launder nothing: int(len(x)) is still a
+            # request-derived size.
+            if isinstance(expr.func, ast.Name) \
+                    and expr.func.id in ("int", "round", "abs",
+                                         "min", "max"):
+                return any(self.expr_tainted(a) for a in expr.args)
+            return False
+        if isinstance(expr, ast.Attribute) and expr.attr == "size":
+            return True
+        if isinstance(expr, ast.Subscript) \
+                and isinstance(expr.value, ast.Attribute) \
+                and expr.value.attr == "shape":
+            return True
+        return False
+
+    def expr_tainted(self, expr: Optional[ast.AST]) -> bool:
+        if expr is None:
+            return False
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if self._size_source(node):
+                return True
+            if isinstance(node, ast.Name) and node.id in self.tainted:
+                return True
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) \
+                        and node.func.id in ("int", "round", "abs",
+                                             "min", "max"):
+                    stack.extend(node.args)
+                elif self._ctor_with_tainted_shape(node):
+                    return True
+                # Other calls launder: their result's SHAPE is the
+                # callee's contract, not the argument's value.
+                continue
+            if isinstance(node, (ast.List, ast.Tuple, ast.Set,
+                                 ast.Dict)):
+                # `[n]` has static shape len-1: the VALUE is dynamic
+                # but the trace signature is not.  (A display used AS
+                # a constructor's shape argument is handled by
+                # _ctor_with_tainted_shape, which iterates the elts
+                # itself.)
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        return False
+
+    def _ctor_with_tainted_shape(self, call: ast.Call) -> bool:
+        """`np.zeros((b, n))` with a raw-size `n`: the array's SHAPE
+        is request-derived — exactly what recompiles."""
+        name, parts = _call_parts(call)
+        if name is None or not call.args:
+            return False
+        if parts[-1] not in _ARRAY_CTORS:
+            return False
+        shape = call.args[0]
+        if isinstance(shape, (ast.Tuple, ast.List)):
+            return any(self.expr_tainted(e) for e in shape.elts)
+        return self.expr_tainted(shape)
+
+    def _check_jit_call(self, call: ast.Call) -> None:
+        name, parts = _call_parts(call)
+        if name is None:
+            return
+        bare = parts[-1]
+        if bare not in self.jitted:
+            return
+        for arg in call.args:
+            if self.expr_tainted(arg):
+                self.findings.append(self.ctx.finding(
+                    self.rule.id, arg,
+                    f"request-derived size reaches jitted "
+                    f"'{bare}' in '{self.where}' without passing "
+                    f"through a bucket fit — every distinct value "
+                    f"compiles a new executable (route it through "
+                    f"engine/buckets.py)"))
+                break
+        for pos in self.jitted.get(bare, ()):
+            if pos >= len(call.args):
+                continue
+            arg = call.args[pos]
+            if isinstance(arg, ast.JoinedStr):
+                self.findings.append(self.ctx.finding(
+                    self.rule.id, arg,
+                    f"f-string in static_argnums position {pos} of "
+                    f"jitted '{bare}' — every distinct rendering is "
+                    f"its own compile-cache entry (recompile storm)"))
+            elif isinstance(arg, (ast.List, ast.Dict, ast.Set)):
+                self.findings.append(self.ctx.finding(
+                    self.rule.id, arg,
+                    f"unhashable {type(arg).__name__.lower()} literal "
+                    f"in static_argnums position {pos} of jitted "
+                    f"'{bare}' — static args must be hashable (use a "
+                    f"tuple)"))
+
+    def scan(self, stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+                value = stmt.value
+                if isinstance(value, ast.Await):
+                    value = value.value
+                if self.expr_tainted(value):
+                    targets = (stmt.targets
+                               if isinstance(stmt, ast.Assign)
+                               else [stmt.target])
+                    for t in targets:
+                        _taint_target(self.tainted, t)
+            for call in _TaintScan._stmt_calls(stmt):
+                self._check_jit_call(call)
+            for body in _TaintScan._child_bodies(stmt):
+                self.scan(body)
+
+
+class JitRecompileHazardRule(Rule):
+    id = "jit-recompile-hazard"
+    description = ("request-derived size reaches a jitted callable "
+                   "without bucketing, or a non-hashable/f-string "
+                   "value sits in a static_argnums position")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Finding]:
+        aliases = _import_aliases(tree)
+        jitted = collect_jitted(tree, aliases)
+        if not jitted:
+            return iter(())
+        findings: List[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                kind = ("async def"
+                        if isinstance(node, ast.AsyncFunctionDef)
+                        else "def")
+                scan = _SizeScan(self, node, f"{kind} {node.name}",
+                                 ctx, aliases, jitted, findings)
+                scan.scan(node.body)
+        return iter(findings)
+
+
+# -- rule 3: blocking-dispatch ----------------------------------------------
+
+def _dispatch_call(call: ast.Call, aliases: Dict[str, str],
+                   jitted: Set[str]) -> Optional[str]:
+    """Name the device dispatch/sync this call performs, if any."""
+    name, parts = _call_parts(call)
+    if name is None:
+        return None
+    bare = parts[-1]
+    if bare in jitted:
+        return f"jitted '{bare}'"
+    if bare == "block_until_ready":
+        return "block_until_ready()"
+    resolved = _resolve(name, aliases)
+    if resolved in ("jax.jit", "jax.pjit"):
+        return "jax.jit() (trace+compile)"
+    if resolved == "jax.device_put" or (
+            bare == "device_put"
+            and any(p in ("jax", "_jax") for p in parts[:-1])):
+        return "device_put()"
+    return None
+
+
+class BlockingDispatchRule(Rule):
+    id = "blocking-dispatch"
+    description = ("device dispatch (jitted call, block_until_ready, "
+                   "device_put, jax.jit) on the event loop or under "
+                   "a held threading lock")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Finding]:
+        aliases = _import_aliases(tree)
+        jitted = set(collect_jitted(tree, aliases))
+        lock_kinds = _classify_locks(tree, aliases)
+
+        def is_threadlock(with_item: ast.withitem) -> Optional[str]:
+            expr = with_item.context_expr
+            if isinstance(expr, ast.Call):
+                expr = expr.func
+            base = None
+            if isinstance(expr, ast.Attribute):
+                base = expr.attr
+            elif isinstance(expr, ast.Name):
+                base = expr.id
+            if base is None:
+                return None
+            kinds = lock_kinds.get(base, set())
+            if kinds == {"threading"} or (not kinds
+                                          and _lockish_name(base)):
+                return base
+            return None
+
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        # Map each With statement to its enclosing function so the
+        # test*-scoping policy applies to the lock branch too.
+        with_owner: Dict[int, ast.AST] = {}
+        for fn in funcs:
+            for sub in _iter_own_nodes(fn.body):
+                if isinstance(sub, ast.With):
+                    with_owner[id(sub)] = fn
+
+        # Lock pass first (emitted second): a dispatch under a held
+        # lock gets the lock diagnosis, and the async pass skips it
+        # rather than double-reporting the same call.
+        lock_findings: List[Finding] = []
+        covered: Set[int] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.With):
+                continue
+            fn = with_owner.get(id(node))
+            if fn is not None and _is_test_function(fn.name):
+                continue
+            for item in node.items:
+                lock = is_threadlock(item)
+                if lock is None:
+                    continue
+                for sub in _iter_own_nodes(node.body):
+                    if isinstance(sub, ast.Call):
+                        what = _dispatch_call(sub, aliases, jitted)
+                        if what is not None:
+                            covered.add(id(sub))
+                            lock_findings.append(ctx.finding(
+                                self.id, sub,
+                                f"{what} under held lock `{lock}` — "
+                                f"a dispatch (worse: a compile) "
+                                f"convoys every thread waiting on "
+                                f"the lock; dispatch outside the "
+                                f"hold"))
+                break
+        for node in funcs:
+            if not isinstance(node, ast.AsyncFunctionDef) \
+                    or _is_test_function(node.name):
+                continue
+            for sub in _iter_own_nodes(node.body):
+                if isinstance(sub, ast.Call) \
+                        and id(sub) not in covered:
+                    what = _dispatch_call(sub, aliases, jitted)
+                    if what is not None:
+                        yield ctx.finding(
+                            self.id, sub,
+                            f"{what} inside 'async def "
+                            f"{node.name}' stalls the event loop "
+                            f"for device/compile time — dispatch "
+                            f"on the enqueue executor")
+        for finding in lock_findings:
+            yield finding
+
+
+def _iter_own_nodes(stmts: List[ast.stmt]) -> Iterator[ast.AST]:
+    """All nodes in these statements, not descending into nested
+    function/class bodies (different execution context)."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- rule 4: prng-key-reuse --------------------------------------------------
+
+_KEY_MAKERS = {"jax.random.PRNGKey", "jax.random.key",
+               "jax.random.split", "jax.random.fold_in"}
+_NON_CONSUMING = {"PRNGKey", "key", "split", "fold_in",
+                  "wrap_key_data", "key_data", "key_impl"}
+
+
+class PrngKeyReuseRule(Rule):
+    id = "prng-key-reuse"
+    description = ("a jax.random key consumed by two sample calls "
+                   "without an intervening split/fold_in (the draws "
+                   "correlate)")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Finding]:
+        aliases = _import_aliases(tree)
+        findings: List[Finding] = []
+        seen_lines: Set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self._scan_fn(node, ctx, aliases, findings,
+                              seen_lines)
+        return iter(findings)
+
+    def _resolved(self, call: ast.Call,
+                  aliases: Dict[str, str]) -> Optional[str]:
+        name = dotted_name(call.func)
+        if name is None:
+            return None
+        resolved = _resolve(name, aliases)
+        # `self._jax.random.uniform` → normalize the stashed-module
+        # spelling onto jax.random.
+        parts = resolved.split(".")
+        if "random" in parts[:-1] and any(
+                p in ("jax", "_jax") for p in parts):
+            return "jax.random." + parts[-1]
+        if resolved.startswith("jax.random."):
+            return resolved
+        return None
+
+    def _scan_fn(self, fn, ctx: FileContext,
+                 aliases: Dict[str, str], findings: List[Finding],
+                 seen_lines: Set[int]) -> None:
+        # key var -> line of first consume.  Mutable container so the
+        # If special-case below can swap branch-local copies in.
+        used: Dict[str, int] = {}
+
+        def fresh(targets: List[ast.AST]) -> None:
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    used.pop(t.id, None)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    fresh(list(t.elts))
+                elif isinstance(t, ast.Starred):
+                    fresh([t.value])
+
+        def scan(stmts: List[ast.stmt], twice_for_loops: bool) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    value = stmt.value
+                    if isinstance(value, ast.Call):
+                        resolved = self._resolved(value, aliases)
+                        if resolved in _KEY_MAKERS:
+                            targets = (stmt.targets
+                                       if isinstance(stmt, ast.Assign)
+                                       else [stmt.target])
+                            fresh(targets)
+                for call in _TaintScan._stmt_calls(stmt):
+                    resolved = self._resolved(call, aliases)
+                    if resolved is None:
+                        continue
+                    bare = resolved.rsplit(".", 1)[-1]
+                    if bare in _NON_CONSUMING:
+                        continue
+                    if not call.args or not isinstance(call.args[0],
+                                                       ast.Name):
+                        continue
+                    key = call.args[0].id
+                    if key in used:
+                        if call.lineno not in seen_lines:
+                            seen_lines.add(call.lineno)
+                            findings.append(ctx.finding(
+                                self.id, call,
+                                f"key '{key}' already consumed by a "
+                                f"jax.random call at line "
+                                f"{used[key]} in '{fn.name}' — "
+                                f"split/fold_in before sampling "
+                                f"again, or the two draws correlate"))
+                    else:
+                        used[key] = call.lineno
+                if isinstance(stmt, ast.If):
+                    # Mutually exclusive branches: one draw per call
+                    # whichever branch runs, so each scans against a
+                    # private copy of the entry state; the exits
+                    # merge (a key consumed on EITHER path counts as
+                    # consumed after the If).
+                    entry = dict(used)
+                    branch_states = []
+                    for body in (stmt.body, stmt.orelse):
+                        used.clear()
+                        used.update(entry)
+                        scan(body, twice_for_loops)
+                        branch_states.append(dict(used))
+                    used.clear()
+                    for state in branch_states:
+                        for key, line in state.items():
+                            used.setdefault(key, line)
+                    continue
+                for body in _TaintScan._child_bodies(stmt):
+                    # Loop bodies run twice so a key consumed once
+                    # per iteration without a re-split is caught.
+                    if isinstance(stmt, (ast.For, ast.AsyncFor,
+                                         ast.While)) \
+                            and twice_for_loops:
+                        scan(body, False)
+                    scan(body, twice_for_loops)
+
+        scan(fn.body, True)

@@ -197,6 +197,12 @@ async def smoke() -> List[str]:
         model="metrics-probe").observe(5)
     obs.request_cache_saved_tokens().labels(
         model="metrics-probe").observe(256)
+    # Device-discipline sanitizer families (ISSUE 14): the violation
+    # counter (one sample per kind) and the armed gauge, touched with
+    # representative values so names/labels/suffixes always lint.
+    for kind in ("forbidden_transfer", "recompile", "loop_stall"):
+        obs.sanitizer_violations_total().labels(kind=kind).inc()
+    obs.sanitizer_armed().set(1)
     problems: List[str] = []
     if resp.status != 200:
         problems.append(

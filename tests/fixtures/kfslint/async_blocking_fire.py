@@ -30,3 +30,9 @@ def _read_config():
 
 async def via_helper():
     return _read_config()           # FIRE: unique sync helper blocks
+
+
+async def offload_arg_evaluated(loop):
+    # The offload itself is exempt, but its ARGUMENTS evaluate on the
+    # loop before the submit — a call expression there still blocks.
+    await loop.run_in_executor(None, _read_config())  # FIRE: evaluated

@@ -1,0 +1,20 @@
+"""kfslint golden fixture: blocking-dispatch must NOT fire (never
+executed)."""
+import threading
+
+import jax
+
+step = jax.jit(lambda params, x: x)
+_lock = threading.Lock()
+
+
+async def handler(loop, params, batch):
+    # Dispatch belongs on the enqueue executor: passed by reference,
+    # never invoked on the loop.
+    return await loop.run_in_executor(None, step, params, batch)
+
+
+def flush(params, table):
+    with _lock:
+        row = table.copy()           # host work under the lock is fine
+    return step(params, row)         # dispatch outside the hold

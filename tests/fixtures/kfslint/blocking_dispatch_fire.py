@@ -1,0 +1,21 @@
+"""kfslint golden fixture: blocking-dispatch MUST fire on every
+marked line (never executed, only parsed)."""
+import threading
+
+import jax
+
+step = jax.jit(lambda params, x: x)
+_lock = threading.Lock()
+
+
+async def handler(params, batch):
+    out = step(params, batch)        # FIRE: jitted call on the loop
+    jax.block_until_ready(out)       # FIRE: device sync on the loop
+    moved = jax.device_put(batch)    # FIRE: transfer on the loop
+    hot = jax.jit(lambda x: x)       # FIRE: trace+compile on the loop
+    return moved, hot
+
+
+def flush(params, batch):
+    with _lock:
+        return step(params, batch)   # FIRE: dispatch under held lock

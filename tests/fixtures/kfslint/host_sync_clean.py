@@ -1,0 +1,32 @@
+"""kfslint golden fixture: host-sync must NOT fire (never
+executed)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+async def scheduler(engine):
+    # Awaited results crossed the loop boundary: the executor already
+    # fetched them to host — int()/np.asarray over them is free.
+    fetched, lp = await engine.next_wave()
+    first = int(fetched[0])
+    arr = np.asarray(lp)
+    return first, arr
+
+
+async def shape_only(feed):
+    # Metadata access is host-side bookkeeping, not a transfer.
+    toks = jnp.argmax(feed, -1)
+    return int(toks.shape[0]), str(toks.dtype)
+
+
+def fetch_wave(toks_h, guard):
+    with guard():
+        # kfslint: disable=host-sync — sanctioned fetch site (fixture
+        # twin of the real _fetch_wave waiver).
+        return np.asarray(toks_h)
+
+
+def prepare_dispatch(batch):
+    # Plain numpy in a hot-named function: nothing came off device.
+    arr = np.asarray(batch, np.float32)
+    return float(np.mean(arr))

@@ -1,0 +1,27 @@
+"""kfslint golden fixture: host-sync MUST fire on every marked line
+(never executed, only parsed)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+async def decode_step(params, feed):
+    toks = jnp.argmax(feed, -1)
+    first = float(toks[0])           # FIRE: float() joins the stream
+    count = int(jnp.sum(toks))       # FIRE: int() on inline dispatch
+    host = np.asarray(toks)          # FIRE: np.asarray fetch
+    listed = toks.tolist()           # FIRE: .tolist() fetch
+    one = toks[0].item()             # FIRE: .item() fetch
+    return first, count, host, listed, one
+
+
+def fetch_wave(toks_h, lp_h):
+    # The *_h naming convention marks device handles crossing helpers.
+    tokens = np.asarray(toks_h)      # FIRE: handle fetch in a wave fn
+    lp = tuple(np.asarray(h) for h in lp_h)  # FIRE: comprehension fetch
+    return tokens, lp
+
+
+def execute_fetch(tree_map, params, batch):
+    out = jnp.tanh(batch)
+    return tree_map(lambda a: np.asarray(a), out)  # FIRE: lambda fetch

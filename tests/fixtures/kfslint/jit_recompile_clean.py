@@ -1,0 +1,19 @@
+"""kfslint golden fixture: jit-recompile-hazard must NOT fire (never
+executed)."""
+import jax
+import numpy as np
+
+step = jax.jit(lambda params, x: x)
+render = jax.jit(lambda x, mode: x, static_argnums=(1,))
+
+
+def dispatch_request(params, req, buckets):
+    n = len(req.tokens)
+    b = buckets.fit(n)               # bucketed: the size is quantized
+    step(params, b)
+    x = np.zeros((b, 128), np.float32)
+    step(params, x)
+    ids = np.asarray([n], np.int32)  # dynamic VALUE, static shape
+    step(params, ids)
+    render(x, "greedy")              # hashable static args are fine
+    render(x, ("chunk", 128))

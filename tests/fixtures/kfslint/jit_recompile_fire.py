@@ -1,0 +1,20 @@
+"""kfslint golden fixture: jit-recompile-hazard MUST fire on every
+marked line (never executed, only parsed)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+step = jax.jit(lambda params, x: x)
+render = jax.jit(lambda x, mode: x, static_argnums=(1,))
+
+
+def dispatch_request(params, req, clean):
+    n = len(req.tokens)
+    step(params, n)                  # FIRE: raw size to jitted callable
+    x = np.zeros((n, 128), np.float32)
+    step(params, x)                  # FIRE: unbucketed shape
+    m = int(req.ids.size)
+    y = jnp.zeros((4, m), jnp.int32)
+    step(params, y)                  # FIRE: .size-derived dimension
+    render(clean, f"mode-{n}")       # FIRE: f-string static arg
+    render(clean, [1, 2])            # FIRE: unhashable static arg

@@ -1,0 +1,25 @@
+"""kfslint golden fixture: prng-key-reuse must NOT fire (never
+executed)."""
+import jax
+
+
+def sample_pair(shape):
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.uniform(k2, shape)
+    return a, b
+
+
+def folded(base, shape):
+    # Per-iteration fold_in is the sanctioned streaming pattern.
+    return [jax.random.normal(jax.random.fold_in(base, i), shape)
+            for i in range(4)]
+
+
+def resplit(shape):
+    key = jax.random.PRNGKey(0)
+    for _ in range(3):
+        key, sub = jax.random.split(key)
+        jax.random.normal(sub, shape)
+    return key

@@ -1,0 +1,18 @@
+"""kfslint golden fixture: prng-key-reuse MUST fire on every marked
+line (never executed, only parsed)."""
+import jax
+
+
+def sample_pair(shape):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # FIRE: key consumed twice
+    return a, b
+
+
+def loop_reuse(shape):
+    key = jax.random.PRNGKey(1)
+    out = []
+    for _ in range(4):
+        out.append(jax.random.normal(key, shape))  # FIRE: every pass
+    return out

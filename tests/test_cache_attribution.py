@@ -164,6 +164,8 @@ async def test_eviction_causes_discriminating_sequence(tiny):
         # entry.
         with eng._block_lock:
             held = []
+            # kfslint: disable=spin-loop — bounded drain of the
+            # free-block deque under the lock; nothing refills it.
             while eng._free_blocks:
                 held.append(eng._free_blocks.popleft())
             victim = eng._alloc_block_locked()

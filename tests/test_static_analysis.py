@@ -48,6 +48,13 @@ RULE_FIXTURES = [
     ("cancellation-safety", "cancellation"),
     ("fault-site", "fault_site"),
     ("metric-name", "metric_name"),
+    # Device tier (ISSUE 14) — see tests/test_device_discipline.py
+    # for the per-rule edge cases; the golden contract lives here
+    # with the others.
+    ("host-sync", "host_sync"),
+    ("jit-recompile-hazard", "jit_recompile"),
+    ("blocking-dispatch", "blocking_dispatch"),
+    ("prng-key-reuse", "prng_reuse"),
 ]
 
 
@@ -268,6 +275,20 @@ def test_write_baseline_then_clean_run(tmp_path, capsys):
     assert "clean" in capsys.readouterr().out
 
 
+def test_finding_paths_cwd_independent_inside_checkout(
+        tmp_path, monkeypatch):
+    # The committed baseline keys on repo-root-relative paths
+    # ('benchmarks/...'); a bare `kfs-lint` run from ANY cwd must
+    # produce the same identities or the baseline false-fails.
+    target = os.path.abspath(
+        os.path.join(FIXTURES, "spin_loop_fire.py"))
+    at_root = {f.path for f in _analyze(target)}
+    monkeypatch.chdir(tmp_path)
+    elsewhere = {f.path for f in _analyze(target)}
+    assert at_root == elsewhere \
+        == {"tests/fixtures/kfslint/spin_loop_fire.py"}
+
+
 def test_finding_paths_invocation_independent():
     # Absolute and relative spellings of the same target must agree
     # on finding paths, or a committed baseline never matches CI.
@@ -361,7 +382,8 @@ def test_naming_rules_shared_with_check_metrics():
 
 # ------------------------------------------------- the fast-tier gate
 def test_live_tree_is_clean_modulo_baseline():
-    findings = analyzers.analyze_paths([REPO_PKG],
+    # Full default scope (ISSUE 14): package + benchmarks/ + tests/.
+    findings = analyzers.analyze_paths(analyzers.default_targets(),
                                        analyzers.default_rules())
     baseline = analyzers.load_baseline(
         analyzers.default_baseline_path())
@@ -369,6 +391,18 @@ def test_live_tree_is_clean_modulo_baseline():
     assert new == [], "kfslint findings:\n" + "\n".join(
         f.render() for f in new)
     assert stale == [], f"stale baseline entries: {stale}"
+
+
+def test_default_targets_cover_benchmarks_and_tests():
+    targets = analyzers.default_targets()
+    names = {os.path.basename(t) for t in targets}
+    assert {"kfserving_tpu", "benchmarks", "tests"} <= names
+    # The golden fixtures fire by design and must be pruned from the
+    # directory walk (their tests analyze them file-by-file).
+    from kfserving_tpu.tools.analyzers.core import iter_python_files
+    scanned = list(iter_python_files(targets))
+    assert not any("fixtures" in p for p in scanned)
+    assert any(p.endswith("test_static_analysis.py") for p in scanned)
 
 
 @pytest.mark.slow
