@@ -167,7 +167,22 @@ def _compact_configs(results: dict) -> dict:
                 "tokens_per_s")
         elif name == "multimodel":
             c.update(pick(r, "load_all_s", "swap_cycle_ms",
+                          "swap_warm_host_ms",
+                          "swap_cold_materialize_ms",
                           "round_robin_req_per_s"))
+        elif name == "multimodel_density":
+            sr = (r.get("single_replica") or {})
+            ss = sr.get("steady_state") or {}
+            c.update({
+                "warm_fault_p99_ms": ss.get("warm_fault_p99_ms"),
+                "req_per_s": ss.get("req_per_s"),
+                "evictions_total": sr.get("evictions_total"),
+                "busy_victim_skips": (sr.get("admission_aware")
+                                      or {}).get("busy_victim_skips"),
+                "affinity_over_rr_req_per_s": (
+                    r.get("router_ab") or {}).get(
+                    "affinity_over_rr_req_per_s"),
+            })
         elif name == "longctx":
             c["tokens_per_s"] = cl.get("tokens_per_s")
         out[name] = c
@@ -190,6 +205,7 @@ def main():
         "iris": C.bench_iris,
         "bert": C.bench_bert,
         "multimodel": C.bench_multimodel,
+        "multimodel_density": C.bench_multimodel_density,
         "chain": C.bench_chain,
         "longctx": C.bench_longctx,
         "overload": C.bench_overload,

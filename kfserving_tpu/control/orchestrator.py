@@ -223,8 +223,29 @@ class InProcessOrchestrator:
                     component_id, revision[:8], replica.host)
         return replica
 
+    async def _load_or_register(self, model) -> None:
+        """Off-loop load (single model) or catalog registration
+        (multi-model repository) for a freshly built replica.  Callers
+        that inject storage credentials run this inside the credential
+        scope — the repository sweep's per-model downloads need them
+        exactly like a single model's load does."""
+        from kfserving_tpu.model.repository import ModelRepository
+
+        if model is None:
+            return
+        loop = asyncio.get_running_loop()
+        if isinstance(model, ModelRepository):
+            register_all = getattr(model, "register_all", None)
+            if register_all is not None:
+                # Registration of a model set runs off-loop (file I/O
+                # per model directory).
+                await loop.run_in_executor(None, register_all)
+        elif not model.ready:
+            await loop.run_in_executor(None, model.load)
+
     async def _build_replica(self, component_id: str, revision: str,
                              spec, placement=None) -> Replica:
+        from kfserving_tpu.model.repository import ModelRepository
         from kfserving_tpu.server.app import ModelServer
 
         if self.credentials is not None:
@@ -241,9 +262,11 @@ class InProcessOrchestrator:
                 os.environ.update(env)
                 try:
                     model = self.model_factory(component_id, spec)
-                    if model is not None and not model.ready:
-                        loop = asyncio.get_running_loop()
-                        await loop.run_in_executor(None, model.load)
+                    # Registration/load runs INSIDE the credential
+                    # scope: a multi-model catalog sweep downloads
+                    # per-model artifacts with the same service
+                    # account as a single model's load would.
+                    await self._load_or_register(model)
                 finally:
                     for k, old in saved.items():
                         if old is None:
@@ -252,12 +275,19 @@ class InProcessOrchestrator:
                             os.environ[k] = old
         else:
             model = self.model_factory(component_id, spec)
-            if model is not None and not model.ready:
-                loop = asyncio.get_running_loop()
-                await loop.run_in_executor(None, model.load)
+            await self._load_or_register(model)
+        # A factory may return a whole ModelRepository instead of one
+        # model: the multi-model replica shape (TrainedModel-style
+        # repositories with demand-paged HBM residency) — the server
+        # fronts the repository instead of a model list.
+        repository = model if isinstance(model, ModelRepository) \
+            else None
+        if repository is not None:
+            model = None
         self._inject_predictor_host(model, spec)
         server = ModelServer(
             http_port=0, enable_docs=False,
+            registered_models=repository,
             container_concurrency=getattr(
                 spec, "container_concurrency", 0) or 0)
         await server.start_async([model] if model is not None else [],
@@ -320,6 +350,24 @@ def default_model_factory(component_id: str, spec):
 
     isvc_name = component_id.split("/")[1]
     if isinstance(spec, PredictorSpec):
+        if spec.multi_model:
+            if spec.framework != "jax":
+                raise ValueError(
+                    f"multi-model predictors serve the jax repository "
+                    f"shape, not {spec.framework!r}")
+            from kfserving_tpu.engine.hbm import HBMManager
+            from kfserving_tpu.predictors.jaxserver import (
+                JaxModelRepository,
+            )
+
+            # storage_uri is the model CATALOG root (one subdir per
+            # TrainedModel); every model registers host-side at boot
+            # and HBM residency is demand-paged under the spec's
+            # per-replica budget — the TrainedModel CRD + agent-puller
+            # economics with millisecond activation.
+            return JaxModelRepository(
+                models_dir=spec.storage_uri,
+                hbm=HBMManager(budget_bytes=spec.hbm_budget_bytes))
         if spec.framework == "jax":
             from kfserving_tpu.predictors.jax_model import JaxModel
 

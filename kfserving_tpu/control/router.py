@@ -14,12 +14,15 @@ reduced to its observable effect.
 """
 
 import asyncio
+import bisect
+import hashlib
 import itertools
 import json
 import logging
+import os
 import random
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import aiohttp
 
@@ -57,13 +60,23 @@ REVISION_HEADER = "x-kfs-revision"
 
 
 class IngressRouter:
+    # Virtual nodes per replica on the consistent-hash ring.  Arc
+    # balance decides whether a replica's model share fits its HBM
+    # budget, so small fleets need MANY vnodes: at 2 replicas, 32
+    # vnodes can split a 20-model catalog 13/7 (the heavy replica
+    # thrashes its arc), while 128 keeps splits near-even.  Ring
+    # build is O(replicas * vnodes * log) and cached per replica set.
+    AFFINITY_VNODES = 128
+
     def __init__(self, controller, http_port: int = 0, seed: int = 0,
                  upstream_timeout_s: Optional[float] = None,
                  buffer_deadline_s: Optional[float] = None,
                  breaker_factory: Optional[
                      Callable[[str], CircuitBreaker]] = None,
                  swap_hold_max: int = 1024,
-                 brownout=None):
+                 brownout=None,
+                 affinity: Optional[str] = None,
+                 affinity_spill: Optional[int] = None):
         self.controller = controller  # Controller (store + reconciler)
         self.http_port = http_port
         self.upstream_timeout_s = upstream_timeout_s or ACTIVATOR_TIMEOUT_S
@@ -88,6 +101,28 @@ class IngressRouter:
         # whose per-model levels the predictive control loop sets.
         # None = every request admitted (the pre-brownout behavior).
         self.brownout = brownout
+        # Model-affinity routing (ISSUE 15): "model" hashes the
+        # requested model name onto a consistent ring over the
+        # component's replicas, so a fleet fronting a multi-model
+        # repository PARTITIONS the model set — each replica's HBM
+        # working set shrinks to its ring arc instead of every replica
+        # thrashing the whole catalog.  The breaker/health machinery
+        # stays the escape hatch: an unhealthy or overloaded primary
+        # spills to the next ring position, and a ring that yields
+        # nothing (or an injected `router.affinity_pick` fault) falls
+        # back to plain round-robin.  Default "none" keeps the blind
+        # round-robin spray (single-model services gain nothing from
+        # pinning every request to one replica).
+        self.affinity = (affinity if affinity is not None
+                         else os.environ.get("KFS_ROUTER_AFFINITY",
+                                             "none"))
+        # Per-host in-flight ceiling before an affinity pick spills to
+        # the next ring position (0 disables spilling-on-load).
+        self.affinity_spill = (
+            affinity_spill if affinity_spill is not None
+            else int(os.environ.get("KFS_ROUTER_AFFINITY_SPILL", "8")))
+        self._host_inflight: Dict[str, int] = {}
+        self._ring_cache: Dict[tuple, List[Tuple[int, str]]] = {}
         self._rng = random.Random(seed)
         self._rr = {}  # component_id -> round-robin counter
         self.router = Router()
@@ -220,6 +255,13 @@ class IngressRouter:
         if breaker.state != "closed":
             self._ensure_reprobe(host)
 
+    def _host_release(self, host: str) -> None:
+        n = self._host_inflight.get(host, 0) - 1
+        if n <= 0:
+            self._host_inflight.pop(host, None)
+        else:
+            self._host_inflight[host] = n
+
     def _record_success(self, host: str) -> None:
         # Success == no failure history worth keeping (record_success
         # clears the rolling window anyway), so drop the entry: the
@@ -287,6 +329,36 @@ class IngressRouter:
             return False
 
     # -- routing core ------------------------------------------------------
+    def _lookup_service(self, name: str):
+        """Resolve a request's model name to its InferenceService.  A
+        name that is not an isvc may be a TrainedModel under a
+        multi-model parent (the reference's TrainedModel URL shape,
+        `<isvc-url>/v1/models/<tm>:predict`) — route to the parent's
+        predictor fleet; the replica's repository serves the model by
+        name.  Returns (isvc, affinity_key): the TRAINED-MODEL name is
+        the affinity key, so the parent's fleet partitions the model
+        set.  A direct isvc hit gets NO affinity key unless its
+        predictor is multi-model: pinning a single-model service's
+        whole traffic to one ring home would idle the rest of its
+        replicas below the spill ceiling."""
+        isvc = self.controller.get(name)
+        if isvc is not None:
+            multi = bool(getattr(
+                getattr(isvc, "predictor", None), "multi_model",
+                False))
+            return isvc, (name if multi else None)
+        tms = getattr(self.controller, "trained_models", None)
+        if not tms:
+            return None, None
+        tm = tms.get(f"default/{name}")
+        if tm is None:
+            tm = next((t for t in tms.values() if t.name == name),
+                      None)
+        if tm is None:
+            return None, None
+        return self.controller.get(tm.inference_service,
+                                   tm.namespace), name
+
     def _entry_component(self, isvc, verb: str) -> str:
         if verb == "explain":
             if isvc.explainer is not None:
@@ -317,8 +389,54 @@ class IngressRouter:
                 self.controller.reconciler.orchestrator.replicas(cid)
                 if r.revision == revision and r.host not in exclude]
 
+    def _ring(self, hosts: Tuple[str, ...]) -> List[Tuple[int, str]]:
+        """Consistent-hash ring over a replica set (cached per set:
+        replica churn builds a new ring, stable fleets reuse it)."""
+        ring = self._ring_cache.get(hosts)
+        if ring is None:
+            ring = sorted(
+                (int(hashlib.md5(f"{host}#{v}".encode())
+                     .hexdigest()[:8], 16), host)
+                for host in hosts
+                for v in range(self.AFFINITY_VNODES))
+            if len(self._ring_cache) >= 64:  # bounded under churn
+                self._ring_cache.clear()
+            self._ring_cache[hosts] = ring
+        return ring
+
+    def _affinity_pick(self, affinity_key: str, replicas, gate
+                       ) -> Optional[str]:
+        """Walk the ring clockwise from the model's hash point: the
+        first position is the model's home replica; overload (host
+        in-flight at the spill ceiling) or a breaker veto spills to
+        the next DISTINCT host.  None = every host vetoed (caller
+        falls back to round-robin)."""
+        hosts = tuple(sorted(r.host for r in replicas))
+        ring = self._ring(hosts)
+        point = int(hashlib.md5(affinity_key.encode())
+                    .hexdigest()[:8], 16)
+        idx = bisect.bisect_left(ring, (point, ""))
+        seen = set()
+        for i in range(len(ring)):
+            host = ring[(idx + i) % len(ring)][1]
+            if host in seen:
+                continue
+            primary = not seen
+            seen.add(host)
+            if 0 < self.affinity_spill <= \
+                    self._host_inflight.get(host, 0):
+                continue
+            breaker = gate(host)
+            if breaker is not None and not breaker.allow():
+                continue
+            obs.router_affinity_total().labels(
+                outcome="ring" if primary else "spill").inc()
+            return host
+        return None
+
     def _pick_replica(self, cid: str, revision: str,
-                      exclude=()) -> Optional[str]:
+                      exclude=(), affinity_key: Optional[str] = None
+                      ) -> Optional[str]:
         # A host whose breaker is open is skipped exactly like an
         # excluded one: traffic flows to the healthy replicas while
         # the background reprobe decides when the sick one returns.
@@ -343,6 +461,14 @@ class IngressRouter:
             replicas.append(r)
         if not replicas:
             return None
+        if affinity_key is not None and len(replicas) > 1:
+            host = self._affinity_pick(affinity_key, replicas, gate)
+            if host is not None:
+                return host
+            # Ring exhausted (every host overloaded or breaker-vetoed):
+            # the round-robin escape hatch below still applies.
+            obs.router_affinity_total().labels(
+                outcome="fallback").inc()
         for _ in range(len(replicas)):
             idx = self._rr.get(cid, 0)
             self._rr[cid] = idx + 1
@@ -374,11 +500,19 @@ class IngressRouter:
             return True
 
     async def _mark_failed_and_evict(self, name: str, cname: str,
-                                     host: str, failed: set) -> None:
+                                     host: str, failed: set,
+                                     resolved=None) -> None:
         """Shared failure bookkeeping for the retry loop: exclude the
-        host from further attempts and evict its replica."""
+        host from further attempts and evict its replica.  Resolves
+        through _lookup_service (or the caller's already-resolved
+        pair): `name` may be a TrainedModel (the affinity path), and
+        its crashed PARENT replica must be evicted and
+        standby-promoted exactly like a direct isvc request would —
+        otherwise the dead host stays the TM's ring home, eating a
+        connect error per request until its breaker trips."""
         failed.add(host)
-        isvc = self.controller.get(name)
+        isvc, _ = (resolved if resolved is not None
+                   else self._lookup_service(name))
         if isvc is not None:
             cid = self.controller.reconciler.component_id(isvc, cname)
             await self._evict_replica(cid, host)
@@ -410,11 +544,17 @@ class IngressRouter:
 
     async def _resolve(self, name: str, verb: str,
                        component: Optional[str] = None,
-                       exclude=(), deadline: Optional[Deadline] = None
+                       exclude=(), deadline: Optional[Deadline] = None,
+                       resolved=None
                        ) -> Tuple[Optional[str], Optional[str],
                                   Optional[str], Optional[str]]:
-        """Returns (host, component_name, revision, error)."""
-        isvc = self.controller.get(name)
+        """Returns (host, component_name, revision, error).  `resolved`
+        carries a (isvc, affinity_key) pair the caller already looked
+        up — the dispatch loop resolves once per REQUEST, not once per
+        failover attempt (the TrainedModel fallback scans the catalog
+        for non-default namespaces)."""
+        isvc, affinity_key = (resolved if resolved is not None
+                              else self._lookup_service(name))
         if isvc is None:
             return None, None, None, \
                 f"inference service {name} not found"
@@ -430,7 +570,22 @@ class IngressRouter:
             return None, cname, None, \
                 f"no traffic targets for {name}/{cname}"
         cid = self.controller.reconciler.component_id(isvc, cname)
-        host = self._pick_replica(cid, revision, exclude=exclude)
+        if self.affinity != "model" or verb == "health":
+            affinity_key = None
+        if affinity_key is not None and faults.configured(
+                fault_sites.ROUTER_AFFINITY_PICK):
+            try:
+                await faults.inject(fault_sites.ROUTER_AFFINITY_PICK,
+                                    key=f"{name} {cname}")
+            except FaultInjected:
+                # Chaos-proven escape hatch: a broken affinity pick
+                # degrades to the blind round-robin spray, never to an
+                # unroutable request.
+                obs.router_affinity_total().labels(
+                    outcome="fallback").inc()
+                affinity_key = None
+        host = self._pick_replica(cid, revision, exclude=exclude,
+                                  affinity_key=affinity_key)
         if host is None:
             # Distinguish "nothing registered" (scale-from-zero: spin
             # up and buffer) from "replicas exist but every breaker is
@@ -901,7 +1056,9 @@ class IngressRouter:
         when failover lands the request on the stable revision —
         otherwise an error-storming canary whose traffic always fails
         over would show a spotless per-revision series and never trip
-        a rollout gate."""
+        a rollout gate.  `name` is the OWNING isvc, not the requested
+        TrainedModel: revisions belong to the service, and rollout
+        cleanup prunes these series by isvc name."""
         if revision is None:
             return
         elapsed_ms = (time.perf_counter() - started) * 1000.0
@@ -969,6 +1126,8 @@ class IngressRouter:
 
         def on_close():
             self.inflight[gauge_cid] -= 1
+            if host is not None:
+                self._host_release(host)
             upstream.close()
 
         # Same response-header policy as the buffered path: trace-id
@@ -1097,14 +1256,25 @@ class IngressRouter:
         # retriable 503 + Retry-After, before occupying an upstream
         # slot.  Health probes are never shed: readiness gating must
         # keep seeing the truth during an overload.
+        # Traffic is BOOKED under the owning isvc, not the requested
+        # model name: a TrainedModel request (affinity path) must feed
+        # the same router/{isvc}/{component} series the autoscaler and
+        # predictive loop read — per-TM keys would leave a busy
+        # multi-model fleet looking idle (and scaled to zero).
+        resolved = self._lookup_service(name)
+        svc = resolved[0]
+        svc_name = svc.name if svc is not None else name
         if verb != "health":
-            isvc = self.controller.get(name)
-            if isvc is not None:
-                entry = component or self._entry_component(isvc, verb)
-                offered_key = f"router/{name}/{entry}"
+            if svc is not None:
+                entry = component or self._entry_component(svc, verb)
+                offered_key = f"router/{svc_name}/{entry}"
                 self.offered_count[offered_key] = \
                     self.offered_count.get(offered_key, 0) + 1
-            shed = await self._brownout_gate(name, req, deadline)
+            # The brownout gate is keyed by the OWNING isvc too: the
+            # predictive loop sets levels per service (off the
+            # router/{svc}/... series above), so a TrainedModel
+            # request must be shed under its parent's level.
+            shed = await self._brownout_gate(svc_name, req, deadline)
             if shed is not None:
                 return shed
 
@@ -1119,7 +1289,7 @@ class IngressRouter:
                         status=504)
                 host, cname, revision, err = await self._resolve(
                     name, verb, component, exclude=failed,
-                    deadline=deadline)
+                    deadline=deadline, resolved=resolved)
                 info["revision"] = revision
                 if err is not None:
                     # Unknown service/component is a true 404; replica
@@ -1153,7 +1323,7 @@ class IngressRouter:
                 if gauge_cid is None:
                     # Per-component gauge: the autoscaler must see
                     # transformer and predictor traffic separately.
-                    gauge_cid = f"router/{name}/{cname}"
+                    gauge_cid = f"router/{svc_name}/{cname}"
                     self.inflight[gauge_cid] = \
                         self.inflight.get(gauge_cid, 0) + 1
                     self.request_count[gauge_cid] = \
@@ -1166,6 +1336,11 @@ class IngressRouter:
                     request_kwargs["timeout"] = aiohttp.ClientTimeout(
                         total=None, sock_connect=10.0,
                         sock_read=self.upstream_timeout_s)
+                # Per-host in-flight count: the affinity ring's
+                # overload signal (spill past a loaded home replica).
+                self._host_inflight[host] = \
+                    self._host_inflight.get(host, 0) + 1
+                held_host: Optional[str] = host
                 try:
                     # Chaos hook: an injected error exercises the same
                     # pre-dispatch failover path a refused connection
@@ -1205,7 +1380,7 @@ class IngressRouter:
                     if stream_ok and upstream.headers.get(
                             "content-type", "").startswith(
                                 "text/event-stream"):
-                        self._observe_attempt(name, revision,
+                        self._observe_attempt(svc_name, revision,
                                               upstream.status,
                                               attempt_started)
                         resp = self._stream_through(upstream,
@@ -1213,7 +1388,10 @@ class IngressRouter:
                                                     name=name,
                                                     cname=cname,
                                                     host=host)
-                        gauge_cid = None  # gauge now owned by stream
+                        # Gauge AND host-inflight slot now owned by
+                        # the stream's close hook.
+                        gauge_cid = None
+                        held_host = None
                         return resp
                     try:
                         body = await upstream.read()
@@ -1222,7 +1400,7 @@ class IngressRouter:
                         # ClientError branch below, and one physical
                         # attempt must land exactly ONE sample in the
                         # per-revision series the rollout gates on.
-                        self._observe_attempt(name, revision,
+                        self._observe_attempt(svc_name, revision,
                                               upstream.status,
                                               attempt_started)
                         resp_headers = {
@@ -1249,7 +1427,7 @@ class IngressRouter:
                     # feeding every request into a 60s timeout.
                     logger.warning("proxy to %s timed out", url)
                     self._record_failure(host)
-                    self._observe_attempt(name, revision, 504,
+                    self._observe_attempt(svc_name, revision, 504,
                                           attempt_started)
                     return Response(
                         body=b'{"error": "upstream timeout"}',
@@ -1264,10 +1442,11 @@ class IngressRouter:
                     logger.warning("proxy to %s failed (attempt %d): %s",
                                    url, attempt + 1, e)
                     self._record_failure(host)
-                    self._observe_attempt(name, revision, 503,
+                    self._observe_attempt(svc_name, revision, 503,
                                           attempt_started)
                     await self._mark_failed_and_evict(
-                        name, cname, host, failed)
+                        name, cname, host, failed,
+                        resolved=resolved)
                 except aiohttp.ClientError as e:
                     # Mid-request/-response failure (reset after
                     # dispatch, truncated read).  Disambiguate with a
@@ -1291,7 +1470,7 @@ class IngressRouter:
                     logger.warning("proxy to %s failed mid-request: %s",
                                    url, e)
                     self._record_failure(host)
-                    self._observe_attempt(name, revision, 502,
+                    self._observe_attempt(svc_name, revision, 502,
                                           attempt_started)
                     if await self._replica_alive(host):
                         return Response(
@@ -1302,7 +1481,11 @@ class IngressRouter:
                         "replica %s dead after mid-request failure: "
                         "evicting and retrying", host)
                     await self._mark_failed_and_evict(
-                        name, cname, host, failed)
+                        name, cname, host, failed,
+                        resolved=resolved)
+                finally:
+                    if held_host is not None:
+                        self._host_release(held_host)
             return Response(
                 body=b'{"error": "upstream unavailable"}', status=503)
         finally:

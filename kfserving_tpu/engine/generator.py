@@ -959,12 +959,10 @@ class GenerationEngine:
                     "pool_blocks": self.num_blocks,
                     # Canonical names, matching the timeline pool
                     # counter samples (_record_pool_sample).  The
-                    # blocks_* spellings below are DEPRECATED aliases
-                    # kept for one release (ISSUE 13 satellite).
+                    # deprecated blocks_free/blocks_reclaimable aliases
+                    # (ISSUE 13's one-release grace) are gone.
                     "free_blocks": len(self._free_blocks),
                     "reclaimable_blocks": len(self._reclaimable),
-                    "blocks_free": len(self._free_blocks),
-                    "blocks_reclaimable": len(self._reclaimable),
                     "prefix_hits": self.prefix_hits,
                     "prefix_misses": self.prefix_misses,
                     "prefill_tokens_saved": self.prefill_tokens_saved,

@@ -14,6 +14,7 @@ LRU policy picks eviction victims when a load would overflow.
 """
 
 import logging
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -47,7 +48,11 @@ def device_hbm_in_use(device=None) -> Optional[int]:
 
 
 class InsufficientHBM(Exception):
-    pass
+    """No room for an admission.  `permanent` distinguishes "can
+    NEVER fit" (bigger than the whole budget) from the transient
+    no-evictable-victim case a waiting fault-in may retry."""
+
+    permanent = False
 
 
 @dataclass
@@ -61,25 +66,51 @@ class Residency:
 class HBMManager:
     """Bin-packing accountant for model residency on one device/mesh.
 
-    budget_bytes: capacity to pack into (defaults to 90% of reported HBM, or
-    a conservative 12 GiB if the backend doesn't report — v5e has 16 GiB).
+    budget_bytes: capacity to pack into (defaults to `KFS_HBM_BUDGET`
+    when set, else 90% of reported HBM, or a conservative 12 GiB if the
+    backend doesn't report — v5e has 16 GiB).
     evict_cb: called with a model name when the manager decides to evict; the
-    callback must actually free the model (engine.close()).
+    callback must actually free the model (engine.close() / offload()).
+    victim_ok: optional admission-aware veto, consulted in LRU order
+    while planning an eviction (called UNDER the ledger lock; the
+    residency manager uses it to claim a victim atomically against a
+    racing fault-in and to protect models with queued/in-flight work).
+    A vetoed candidate is skipped — never evicted — and counted in
+    `kfserving_tpu_hbm_eviction_skips_total`.
+    victim_release: called for claimed-but-uncommitted victims when the
+    admission plan fails after claiming them (undoes victim_ok's claim).
     """
 
     DEFAULT_BUDGET = 12 * 1024**3
 
     def __init__(self, budget_bytes: Optional[int] = None,
                  evict_cb: Optional[Callable[[str], None]] = None,
-                 headroom: float = 0.10):
+                 headroom: float = 0.10,
+                 victim_ok: Optional[Callable[[str], bool]] = None):
+        if budget_bytes is None:
+            env = os.environ.get("KFS_HBM_BUDGET", "")
+            if env:
+                budget_bytes = int(float(env))
         if budget_bytes is None:
             total = device_hbm_bytes()
             budget_bytes = (int(total * (1 - headroom)) if total
                             else self.DEFAULT_BUDGET)
         self.budget_bytes = budget_bytes
         self.evict_cb = evict_cb
+        self.victim_ok = victim_ok
+        self.victim_release: Optional[Callable[[str], None]] = None
         self._resident: "OrderedDict[str, Residency]" = OrderedDict()
         self._lock = threading.Lock()
+        # Lifetime eviction / admission-skip counts per model — the
+        # ledger-side evidence the multimodel_density bench commits.
+        self.evictions: Dict[str, int] = {}
+        self.eviction_skips: Dict[str, int] = {}
+        # Busy candidates already counted for a still-waiting
+        # admission (admitted name -> candidates): a fault-in retries
+        # admit every ~20 ms while its victims are busy, and the skip
+        # metric counts each candidate once per admission episode,
+        # not once per retry.
+        self._skips_counted: Dict[str, set] = {}
         obs.hbm_budget_bytes().set(float(budget_bytes))
 
     @property
@@ -102,46 +133,155 @@ class HBMManager:
         Returns the list of models evicted to make room.  Raises
         InsufficientHBM if the model can never fit (bigger than budget) or
         eviction is disabled and there is no room.
+
+        Three phases: RESERVE (plan victims and book `name`'s bytes
+        under the lock — victims stay accounted), physical EVICTION
+        (evict_cb outside the lock), COMMIT (victims leave the
+        ledger).  Victims' bytes are not marked free until they are
+        physically out of HBM: a concurrent admission on the other
+        fault-in worker planning against freed-but-still-placed bytes
+        would device_put straight into a transient overcommit/OOM.
+        During the eviction window `used_bytes` therefore counts BOTH
+        the victims and the incoming model — deliberately
+        conservative.
         """
-        with self._lock:
-            if nbytes > self.budget_bytes:
-                raise InsufficientHBM(
-                    f"model {name} needs {nbytes} bytes; budget is "
-                    f"{self.budget_bytes}")
-            # Plan admission against a scratch copy so a failed admit leaves
-            # the books untouched (nothing is physically evicted until the
-            # plan commits — evict_cb runs only on success).  A reload of
-            # `name` replaces its old entry rather than double-counting it.
-            plan = OrderedDict(
-                (k, v) for k, v in self._resident.items() if k != name)
-            victims: List[str] = []
-            while True:
-                plan_free = self.budget_bytes - sum(
-                    r.bytes for r in plan.values())
-                if nbytes <= plan_free:
-                    break
-                if not evict:
-                    raise InsufficientHBM(
-                        f"model {name} needs {nbytes} bytes; only "
-                        f"{plan_free} free and eviction disabled")
-                victim = next(iter(plan), None)  # LRU order
-                if victim is None:
-                    raise InsufficientHBM(
-                        f"model {name} needs {nbytes} bytes; nothing "
-                        f"left to evict")
-                plan.pop(victim)
-                victims.append(victim)
-            now = time.time()
-            plan[name] = Residency(name, nbytes, now, now)
-            self._resident = plan
+        victims: List[str] = []
+        skipped: List[str] = []
+        claimed: List[str] = []
+        victim_entries: Dict[str, Residency] = {}
+        try:
+            with self._lock:
+                if nbytes > self.budget_bytes:
+                    err = InsufficientHBM(
+                        f"model {name} needs {nbytes} bytes; budget is "
+                        f"{self.budget_bytes}")
+                    err.permanent = True
+                    raise err
+                # Plan admission against a scratch copy so a failed
+                # admit leaves the books untouched (nothing is
+                # physically evicted unless the plan fully reserves —
+                # evict_cb never runs for a failed plan).  A reload of
+                # `name` replaces its old entry rather than
+                # double-counting it.
+                plan = OrderedDict(
+                    (k, v) for k, v in self._resident.items()
+                    if k != name)
+                while True:
+                    plan_free = self.budget_bytes - sum(
+                        r.bytes for r in plan.values())
+                    if nbytes <= plan_free:
+                        break
+                    if not evict:
+                        raise InsufficientHBM(
+                            f"model {name} needs {nbytes} bytes; only "
+                            f"{plan_free} free and eviction disabled")
+                    # LRU order, admission-aware: victim_ok vetoes (and
+                    # counts) candidates with queued/in-flight work; a
+                    # passing candidate is CLAIMED under this lock, so
+                    # a fault-in racing this eviction serializes on the
+                    # ledger instead of serving a half-evicted model.
+                    victim = None
+                    for cand in plan:
+                        if cand in skipped:
+                            continue
+                        if self.victim_ok is None or self.victim_ok(cand):
+                            victim = cand
+                            break
+                        skipped.append(cand)
+                    if victim is None:
+                        raise InsufficientHBM(
+                            f"model {name} needs {nbytes} bytes; no "
+                            f"evictable victim ({len(skipped)} "
+                            f"candidate(s) busy, nothing else to evict)")
+                    plan.pop(victim)
+                    victims.append(victim)
+                    claimed.append(victim)
+                # RESERVE: book the incoming bytes now; victims remain
+                # in the ledger (claimed, so no other plan can take
+                # them) until their physical offload lands below.
+                now = time.time()
+                self._resident.pop(name, None)
+                self._resident[name] = Residency(name, nbytes, now, now)
+                victim_entries = {v: self._resident[v] for v in victims}
+                claimed = []  # reserved: this plan owns the victims now
+        except BaseException:
+            # Failed plan: undo victim_ok's claims so the candidates
+            # rejoin the evictable set (books untouched by design).
+            if self.victim_release is not None:
+                for cand in claimed:
+                    self.victim_release(cand)
+            self._count_skips(name, skipped, done=False)
+            raise
+        self._count_skips(name, skipped, done=True)
         for victim in victims:
             logger.info("evicting model %s to fit %s", victim, name)
-            obs.hbm_evictions_total().labels(model=victim).inc()
-            obs.hbm_resident_bytes().prune(model=victim)
             if self.evict_cb:
-                self.evict_cb(victim)
+                # Per-victim isolation: the plan is reserved, and the
+                # callback's own cleanup demotes the record state —
+                # one victim's failed physical offload must not strand
+                # the REMAINING victims in their claimed ('evicting')
+                # state with no offload ever coming, which would hang
+                # every future fault-in of those models.
+                try:
+                    self.evict_cb(victim)
+                except Exception:
+                    logger.exception(
+                        "evict callback failed for %s (entry released "
+                        "anyway)", victim)
+        if victims:
+            # COMMIT: victims leave the ledger only now that they are
+            # physically out of HBM.  Identity-checked pop: a victim
+            # whose offload completed may have already been faulted
+            # BACK in by a racing request (its record went host ->
+            # faulting -> resident with a fresh ledger entry) — that
+            # new residency must survive this commit.  Counter updates
+            # stay under the lock (two fault-in workers race these
+            # read-modify-writes).
+            popped: List[str] = []
+            with self._lock:
+                for victim, entry in victim_entries.items():
+                    if self._resident.get(victim) is entry:
+                        self._resident.pop(victim)
+                        popped.append(victim)
+                    self.evictions[victim] = \
+                        self.evictions.get(victim, 0) + 1
+            for victim in victims:
+                obs.hbm_evictions_total().labels(model=victim).inc()
+            # Prune only victims that actually LEFT the ledger: one
+            # re-admitted mid-eviction has a live entry (and a freshly
+            # set gauge) this commit preserved.
+            for victim in popped:
+                obs.hbm_resident_bytes().prune(model=victim)
         obs.hbm_resident_bytes().labels(model=name).set(float(nbytes))
         return victims
+
+    def _count_skips(self, name: str, skipped: List[str],
+                     done: bool) -> None:
+        """Count busy candidates an admission plan passed over — once
+        per admission EPISODE, not per ~20 ms retry of a waiting
+        fault-in.  `done` (plan committed) closes the episode."""
+        with self._lock:
+            counted = self._skips_counted.setdefault(name, set())
+            fresh = [c for c in skipped if c not in counted]
+            counted.update(fresh)
+            if done:
+                self._skips_counted.pop(name, None)
+            for cand in fresh:
+                self.eviction_skips[cand] = \
+                    self.eviction_skips.get(cand, 0) + 1
+        for cand in fresh:
+            obs.hbm_eviction_skips_total().labels(
+                model=cand, reason="busy").inc()
+
+    def end_skip_episode(self, name: str) -> None:
+        """Close a waiting admission's skip-dedup episode without a
+        commit: the residency manager calls this when a fault-in
+        exhausts its admit wait (or fails outright), so a LATER
+        independent admission of the same model counts its busy
+        victims afresh instead of being suppressed by the dead
+        episode's memory."""
+        with self._lock:
+            self._skips_counted.pop(name, None)
 
     def touch(self, name: str) -> None:
         """Mark a model as recently used (moves it to MRU position)."""
@@ -187,6 +327,8 @@ class HBMManager:
             "used_bytes": self.used_bytes,
             "free_bytes": self.free_bytes,
             "resident_models": len(self._resident),
+            "evictions_total": sum(self.evictions.values()),
+            "eviction_skips_total": sum(self.eviction_skips.values()),
         }
 
     def debug(self) -> Dict[str, Any]:
@@ -204,4 +346,6 @@ class HBMManager:
             "budget_bytes": self.budget_bytes,
             "used_bytes": sum(r["bytes"] for r in residents),
             "resident": residents,
+            "evictions": dict(self.evictions),
+            "eviction_skips": dict(self.eviction_skips),
         }

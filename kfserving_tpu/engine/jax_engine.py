@@ -83,6 +83,18 @@ def _params_on_single_device(jax, params) -> bool:
         return False
 
 
+def _all_host_leaves(jax, params) -> bool:
+    """True when every param leaf is a plain host ndarray (the
+    mmap-view trees param_cache serves) — the precondition for
+    offload()/restore() keeping a zero-copy restore source."""
+    try:
+        leaves = jax.tree.leaves(params)
+        return bool(leaves) and all(
+            isinstance(leaf, np.ndarray) for leaf in leaves)
+    except Exception:
+        return False
+
+
 def _resize_seq(arr: np.ndarray, seq: int) -> np.ndarray:
     """Clip or tile a single instance's leading (sequence) axis to `seq`
     for warmup shape synthesis."""
@@ -120,6 +132,16 @@ class JaxEngine:
 
         self._jax = jax
         self.params = params
+        # Host-side restore source for demand-paged residency
+        # (engine/residency.py): when the param tree is entirely host
+        # arrays (the mmap-backed views param_cache.load serves), keep
+        # the reference — offload() can then drop the device copies and
+        # restore() re-place them with one device_put, no re-
+        # materialization and no recompile (jit caches by shape/dtype,
+        # which a restore never changes).  Mesh-sharded trees are not
+        # offloadable (jit owns their SPMD placement).
+        self._host_params = (params if _all_host_leaves(jax, params)
+                             else None)
         self.batch_buckets = batch_buckets or BucketPolicy.pow2(32)
         self.seq_buckets = seq_buckets
         self.dtype = dtype
@@ -241,6 +263,14 @@ class JaxEngine:
         # batcher clears it; per-request budgets were settled at the
         # queue edge).
         check_deadline("engine dispatch")
+        if self.params is None:
+            # Offloaded by the residency manager and not faulted back
+            # in: fail loudly — a half-loaded model must never serve
+            # (the predict path's ensure_resident() gate is the only
+            # legitimate way back to device residency).
+            raise RuntimeError(
+                "engine params are offloaded from the device "
+                "(model is not HBM-resident)")
         with tracer.span("engine.execute") as span:
             t0 = time.perf_counter()
             padded, n = self._prepare(inputs)
@@ -454,6 +484,54 @@ class JaxEngine:
         """Total parameter bytes (HBM residency of this model's weights)."""
         leaves = self._jax.tree.leaves(self.params)
         return sum(getattr(x, "nbytes", 0) for x in leaves)
+
+    def host_param_bytes(self) -> int:
+        """Bytes the host-resident restore source would occupy in HBM
+        (0 when this engine keeps no host tree — not offloadable)."""
+        if self._host_params is None:
+            return 0
+        return sum(leaf.nbytes
+                   for leaf in self._jax.tree.leaves(self._host_params))
+
+    @property
+    def offloadable(self) -> bool:
+        return self._host_params is not None
+
+    def offload(self) -> bool:
+        """Drop the device param copies; the host (mmap-backed) tree
+        stays as the restore source.  Returns False when this engine
+        keeps no host tree (mesh-sharded params — never a residency
+        victim).  The caller (residency manager) guarantees no
+        execution is queued or in flight; a straggler that slips past
+        fails fast on the params-None guard instead of dereferencing
+        freed HBM."""
+        if self._host_params is None:
+            return False
+        params, self.params = self.params, None
+        if params is not None and params is not self._host_params:
+            for leaf in self._jax.tree.leaves(params):
+                delete = getattr(leaf, "delete", None)
+                if delete is not None:
+                    try:
+                        delete()
+                    except Exception:  # already deleted / host array
+                        pass
+        return True
+
+    def restore(self) -> float:
+        """Fault the params back into HBM off the host tree: one
+        device_put of zero-copy mmap views, synchronized so the
+        returned seconds are the true transfer cost.  No recompile —
+        the jit cache keys on shapes/dtypes, which a restore never
+        changes."""
+        if self._host_params is None:
+            raise RuntimeError(
+                "engine keeps no host params to restore from")
+        t0 = time.perf_counter()
+        params = self._jax.device_put(self._host_params)
+        params = self._jax.block_until_ready(params)
+        self.params = params
+        return time.perf_counter() - t0
 
     def close(self, wait: bool = True):
         """Release device references so HBM can be reclaimed.

@@ -284,7 +284,16 @@ def load_or_materialize(architecture: str, arch_kwargs: Optional[Dict],
         logger.warning("no checkpoint at %s; serving random init",
                        ckpt_path)
     # Jax arrays (init output) convert to host np arrays inside
-    # store(); the running process keeps serving its own copies.
+    # store().  After a successful store, serve the MAPPED bytes we
+    # just wrote rather than the in-process copies: the residency
+    # manager needs a host-side (mmap) restore source to demand-page
+    # this model in and out of HBM, and the page cache shares the
+    # bytes with every successor.  A failed re-load (racing writer,
+    # disabled cache) falls back to the in-process copies — the load
+    # itself must never depend on the cache.
     if isinstance(variables, dict) and store(key, variables):
         startup.mark("param_cache_store")
+        mapped = load(key)
+        if mapped is not None:
+            return mapped, source
     return variables, source

@@ -1,0 +1,431 @@
+"""Demand-paged model residency: host-resident always, HBM on demand.
+
+KFServing's multi-model story packs many models onto one scarce
+accelerator (TrainedModel CRD + agent puller, PAPER.md §control
+plane); on TPU "loaded" means *resident in HBM*, the resource
+`engine/hbm.py` accounts.  This manager makes that residency
+demand-paged, TF-Serving-aspired-versions style generalized from two
+versions of one model to N models (arxiv 1712.06139):
+
+- REGISTRATION is declarative and cheap: a registered model is
+  addressable and `ready` but owns no device memory.  Host params are
+  mmap-backed (engine/param_cache.py — PR 7 made them free to keep),
+  so the whole repository stays host-resident.
+- HBM residency is a managed LRU cache over the HBMManager ledger.  A
+  request to a non-resident model transparently FAULTS it in: the
+  first activation pays the cold build (download + materialize +
+  compile); every later fault is one device_put off the mmap views —
+  milliseconds, no recompile (the jit cache keys on shapes).
+- Fault-ins are SINGLE-FLIGHT: concurrent requests to the same
+  non-resident model coalesce onto one transfer (counted as
+  `outcome="coalesced"`).
+- Eviction is ADMISSION-AWARE: a model with queued or in-flight work
+  is never a victim (`HBMManager.victim_ok` veto, counted in
+  `kfserving_tpu_hbm_eviction_skips_total`); victims come from the
+  ledger's LRU order, which the predict path touches on every request.
+  A victim is *claimed* under the ledger lock, so a fault-in racing an
+  eviction of the same model serializes instead of serving a
+  half-evicted model.
+- A failed fault-in (chaos site `engine.residency_swap`, storage
+  errors, OOM) leaves the incumbent resident set serving: the
+  admission plan is transactional, the faulting model returns to its
+  prior state, and the error surfaces to the requester alone.
+
+States: registered -> (cold fault) -> resident <-> (evict/warm fault)
+host.  Observability: `kfserving_tpu_residency_*` families, timeline
+events (`residency.fault_in` / `residency.evict`), and a
+flight-recorder pin when evictions storm (`KFS_RESIDENCY_STORM_*`
+knobs) — thrash evidence must survive the healthy traffic that
+follows.
+"""
+
+import asyncio
+import concurrent.futures
+import contextlib
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from kfserving_tpu.engine.hbm import HBMManager, InsufficientHBM
+from kfserving_tpu.observability import metrics as obs
+from kfserving_tpu.observability.profiling import TIMELINE
+from kfserving_tpu.reliability import fault_sites
+from kfserving_tpu.reliability.faults import FaultInjected, faults
+
+logger = logging.getLogger("kfserving_tpu.residency")
+
+STATE_CODES = {"registered": 0, "host": 1, "faulting": 2,
+               "resident": 3, "evicting": 3}  # evicting is still in HBM
+
+# A fault-in that finds every eviction candidate busy waits for one to
+# free instead of failing the request (the admission-aware veto makes
+# "no victim" a transient condition, not an error).
+DEFAULT_ADMIT_WAIT_S = 5.0
+# Eviction-storm pin: > threshold evictions inside the window pins a
+# flight-recorder entry with the ledger snapshot (thrash evidence).
+DEFAULT_STORM_WINDOW_S = 10.0
+DEFAULT_STORM_THRESHOLD = 8
+
+
+class _Record:
+    __slots__ = ("name", "model", "state", "inflight", "nbytes",
+                 "fault", "fault_counts", "last_fault_ms")
+
+    def __init__(self, name: str, model: Any):
+        self.name = name
+        self.model = model
+        # "registered" (no engine yet) | "host" (engine built, params
+        # offloaded) | "faulting" | "resident" | "evicting" (claimed by
+        # an admission plan, still physically in HBM)
+        self.state = "registered" if not getattr(model, "ready", False) \
+            or getattr(model, "engine", None) is None else "resident"
+        self.inflight = 0
+        self.nbytes = 0
+        self.fault: Optional[asyncio.Task] = None
+        self.fault_counts = {"cold": 0, "warm": 0, "coalesced": 0,
+                             "error": 0}
+        self.last_fault_ms = 0.0
+
+
+class ResidencyManager:
+    """Owns the host<->HBM lifecycle for N registered models over one
+    HBMManager.  Managed models must provide: blocking ``load()``
+    (cold build; admits its own HBM), blocking ``fault_in()`` (warm
+    device restore), ``offload()`` (drop device residency),
+    ``host_bytes()`` and ``offloadable`` (see JaxModel)."""
+
+    def __init__(self, hbm: HBMManager,
+                 admit_wait_s: Optional[float] = None,
+                 storm_window_s: Optional[float] = None,
+                 storm_threshold: Optional[int] = None):
+        self.hbm = hbm
+        hbm.evict_cb = self._evict
+        hbm.victim_ok = self._victim_ok
+        hbm.victim_release = self._victim_release
+        self.admit_wait_s = admit_wait_s if admit_wait_s is not None \
+            else float(os.environ.get("KFS_RESIDENCY_ADMIT_WAIT_S",
+                                      DEFAULT_ADMIT_WAIT_S))
+        self.storm_window_s = storm_window_s if storm_window_s is not None \
+            else float(os.environ.get("KFS_RESIDENCY_STORM_WINDOW_S",
+                                      DEFAULT_STORM_WINDOW_S))
+        self.storm_threshold = int(
+            storm_threshold if storm_threshold is not None
+            else float(os.environ.get("KFS_RESIDENCY_STORM_THRESHOLD",
+                                      DEFAULT_STORM_THRESHOLD)))
+        self._models: Dict[str, _Record] = {}
+        # Dedicated fault-in workers: a fault must not queue behind N
+        # resident models' engine executions on the shared default
+        # executor — that queueing delay would land INSIDE the
+        # measured fault-in latency (and the <100 ms warm bar).
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="residency")
+        # Guards record state/inflight transitions.  Lock order: the
+        # HBM ledger lock is OUTER (victim_ok/release run under it);
+        # nothing here takes the ledger lock while holding this one.
+        self._lock = threading.Lock()
+        self._flight_recorder = None
+        self._evict_times: deque = deque(maxlen=256)
+        self._storm_pinned_at = 0.0
+        # Bounded recent warm fault-in latencies (bench/debug p99).
+        self.fault_ms: Dict[str, deque] = {
+            "warm": deque(maxlen=512), "cold": deque(maxlen=512)}
+
+    # -- registration ------------------------------------------------------
+    def register(self, name: str, model: Any) -> None:
+        """Declarative registration: the model joins the managed set in
+        whatever state it is already in (a pre-loaded model registers
+        as resident; a host-prepped one as registered — first predict
+        cold-faults it)."""
+        rec = self._models.get(name)
+        if rec is not None and rec.model is model:
+            return
+        rec = _Record(name, model)
+        if rec.state == "resident":
+            rec.nbytes = self._host_bytes(model)
+        self._models[name] = rec
+        self._publish_state(rec)
+
+    def deregister(self, name: str) -> None:
+        self._models.pop(name, None)
+        obs.residency_state().prune(model=name)
+
+    def registered(self):
+        return list(self._models)
+
+    def state_of(self, name: str) -> Optional[str]:
+        rec = self._models.get(name)
+        return rec.state if rec is not None else None
+
+    @staticmethod
+    def _host_bytes(model) -> int:
+        fn = getattr(model, "host_bytes", None)
+        return int(fn()) if fn is not None else 0
+
+    def _publish_state(self, rec: _Record) -> None:
+        obs.residency_state().labels(model=rec.name).set(
+            float(STATE_CODES.get(rec.state, 0)))
+
+    def attach_flight_recorder(self, recorder) -> None:
+        """Eviction-storm pins land here (the serving ModelServer
+        attaches its monitoring recorder at start)."""
+        self._flight_recorder = recorder
+
+    def close(self) -> None:
+        """Release the fault-in workers (server shutdown)."""
+        self._executor.shutdown(wait=False)
+
+    # -- request gate ------------------------------------------------------
+    @contextlib.asynccontextmanager
+    async def serving(self, name: str):
+        """The predict-path gate: counts the request as in-flight
+        (protecting the model from eviction — queued work included,
+        the counter is held across the batcher wait), faults the model
+        in when non-resident, and touches the LRU ledger so victims
+        reflect USE order, not load order."""
+        rec = self._models.get(name)
+        if rec is None:
+            yield
+            return
+        with self._lock:
+            rec.inflight += 1
+        try:
+            await self.ensure_resident(name)
+            yield
+        finally:
+            with self._lock:
+                rec.inflight -= 1
+
+    async def ensure_resident(self, name: str) -> None:
+        """Fault `name` into HBM if needed (single-flight); fast path
+        is one lock acquisition + an LRU touch."""
+        rec = self._models.get(name)
+        if rec is None:
+            return
+        with self._lock:
+            resident = rec.state == "resident"
+        if resident:
+            self.hbm.touch(name)
+            return
+        loop = asyncio.get_running_loop()
+        fault = rec.fault
+        if fault is None or fault.done():
+            fault = rec.fault = loop.create_task(self._fault_in(rec))
+        else:
+            rec.fault_counts["coalesced"] += 1
+            obs.residency_fault_ins_total().labels(
+                model=name, outcome="coalesced").inc()
+        # shield: one cancelled requester must not kill the transfer
+        # its coalesced peers are waiting on.
+        await asyncio.shield(fault)
+        self.hbm.touch(name)
+
+    async def _fault_in(self, rec: _Record) -> None:
+        loop = asyncio.get_running_loop()
+        # Claim the record for the fault.  Only an UNCLAIMED state
+        # (registered/host) can transition to faulting: a concurrent
+        # admit that claimed this model as a victim (state=evicting)
+        # owns the device until its physical offload lands — waiting
+        # here is what makes fault-in-vs-eviction of the same model
+        # ordered instead of interleaving restore with offload.
+        source = None
+        while source is None:
+            with self._lock:
+                if rec.state == "resident":
+                    return  # an earlier fault (or load) already won
+                if rec.state in ("registered", "host"):
+                    source = ("cold" if rec.state == "registered"
+                              else "warm")
+                    rec.state = "faulting"
+            if source is None:
+                await asyncio.sleep(0.005)
+        self._publish_state(rec)
+        t0 = time.perf_counter()
+        try:
+            if faults.configured(fault_sites.ENGINE_RESIDENCY_SWAP):
+                await faults.inject(
+                    fault_sites.ENGINE_RESIDENCY_SWAP,
+                    key=f"{rec.name} source:{source}")
+            work = (rec.model.load if source == "cold"
+                    else lambda: self._admit_and_restore(rec))
+            # Admission-aware eviction can transiently find every
+            # candidate busy — wait for one to free, bounded.
+            until = loop.time() + self.admit_wait_s
+            while True:
+                try:
+                    await loop.run_in_executor(self._executor, work)
+                    break
+                except InsufficientHBM as e:
+                    # Permanent = bigger than the whole budget: no
+                    # eviction will ever make it fit — waiting out the
+                    # admit window would burn an executor worker per
+                    # predict for nothing.
+                    if e.permanent or loop.time() >= until:
+                        raise
+                    await asyncio.sleep(0.02)
+            with self._lock:
+                rec.state = "resident"
+            rec.nbytes = self._host_bytes(rec.model) or rec.nbytes
+        except BaseException as e:
+            # The incumbent resident set is untouched (the admission
+            # plan is transactional and the injection site sits before
+            # it); only THIS model returns to its prior state.  The
+            # fault's admission episode is over: close its skip-dedup
+            # window so a later retry counts busy victims afresh.
+            self.hbm.end_skip_episode(rec.name)
+            with self._lock:
+                rec.state = "registered" if source == "cold" else "host"
+            rec.fault_counts["error"] += 1
+            obs.residency_fault_ins_total().labels(
+                model=rec.name, outcome="error").inc()
+            self._publish_state(rec)
+            if isinstance(e, (FaultInjected, InsufficientHBM)):
+                logger.warning("fault-in of %s failed (%s); incumbent "
+                               "resident set keeps serving", rec.name, e)
+            else:
+                logger.exception("fault-in of %s failed", rec.name)
+            raise
+        finally:
+            rec.fault = None
+        dur_s = time.perf_counter() - t0
+        rec.last_fault_ms = dur_s * 1e3
+        rec.fault_counts[source] += 1
+        self.fault_ms[source].append(dur_s * 1e3)
+        obs.residency_fault_in_ms().labels(source=source).observe(
+            dur_s * 1e3)
+        obs.residency_fault_ins_total().labels(
+            model=rec.name, outcome=source).inc()
+        TIMELINE.record("host", "residency.fault_in", dur_s=dur_s,
+                        attrs={"model": rec.name, "source": source})
+        self._publish_state(rec)
+        logger.info("faulted %s into HBM (%s, %.1f ms)",
+                    rec.name, source, dur_s * 1e3)
+
+    def _admit_and_restore(self, rec: _Record) -> None:
+        """Warm fault body (executor thread): claim the bytes in the
+        ledger (evicting admission-approved victims), then re-place
+        the mmap views on device.  A failed restore releases the
+        claim."""
+        nbytes = rec.nbytes or self._host_bytes(rec.model)
+        self.hbm.admit(rec.name, nbytes)
+        try:
+            rec.model.fault_in()
+        except BaseException:
+            self.hbm.release(rec.name)
+            raise
+
+    # -- eviction (HBMManager callbacks) -----------------------------------
+    def _victim_ok(self, name: str) -> bool:
+        """Admission-aware veto + claim, called UNDER the ledger lock:
+        only an idle, fully-resident, offloadable model can be a
+        victim, and saying yes claims it (state -> evicting) so a
+        racing fault-in/predict serializes on the ledger."""
+        rec = self._models.get(name)
+        if rec is None:
+            return True  # unmanaged entry (staging keys, legacy path)
+        with self._lock:
+            if rec.inflight > 0 or rec.state != "resident":
+                return False
+            rec.state = "evicting"
+            return True
+
+    def _victim_release(self, name: str) -> None:
+        rec = self._models.get(name)
+        if rec is None:
+            return
+        with self._lock:
+            if rec.state == "evicting":
+                rec.state = "resident"
+
+    def _evict(self, name: str) -> None:
+        """Physical offload of a committed victim (ledger already
+        updated by admit).  Offloadable models keep their warm engine
+        shell + host mmap params (warm re-fault in milliseconds); a
+        model without a host restore source (param cache disabled,
+        mesh-sharded) is demoted all the way to registered — its next
+        fault is a cold rebuild."""
+        rec = self._models.get(name)
+        if rec is None:
+            return
+        offloaded = False
+        try:
+            if getattr(rec.model, "offloadable", False):
+                rec.model.offload()
+                offloaded = True
+            else:
+                demote = getattr(rec.model, "demote", None)
+                if demote is not None:
+                    demote()
+        finally:
+            with self._lock:
+                rec.state = "host" if offloaded else "registered"
+            self._publish_state(rec)
+        TIMELINE.record("host", "residency.evict",
+                        attrs={"model": name, "bytes": rec.nbytes,
+                               "warm": offloaded})
+        logger.info("evicted %s from HBM (%s)", name,
+                    "host params retained" if offloaded
+                    else "demoted to registered")
+        self._note_eviction()
+
+    def _note_eviction(self) -> None:
+        now = time.monotonic()
+        self._evict_times.append(now)
+        recent = sum(1 for t in self._evict_times
+                     if now - t <= self.storm_window_s)
+        if recent <= self.storm_threshold:
+            return
+        recorder = self._flight_recorder
+        # One pin per storm window, not one per eviction in it.
+        if recorder is None or \
+                now - self._storm_pinned_at < self.storm_window_s:
+            return
+        self._storm_pinned_at = now
+        recorder.record({
+            "kind": "residency_eviction_storm",
+            "evictions_in_window": recent,
+            "window_s": self.storm_window_s,
+            "hbm": self.hbm.debug(),
+            "residency": self.debug(),
+        }, pin="eviction_storm")
+        logger.warning(
+            "HBM eviction storm: %d evictions in %.0fs (working set "
+            "exceeds the budget — flight-recorder entry pinned)",
+            recent, self.storm_window_s)
+
+    # -- introspection -----------------------------------------------------
+    def debug(self) -> Dict[str, Any]:
+        """The `/debug/cache` residency block, federated by the router
+        under the replica label."""
+        def pct(values, q):
+            if not values:
+                return None
+            ordered = sorted(values)
+            return round(ordered[min(len(ordered) - 1,
+                                     int(len(ordered) * q))], 3)
+
+        with self._lock:
+            models = {
+                name: {"state": rec.state, "inflight": rec.inflight,
+                       "nbytes": rec.nbytes,
+                       "fault_ins": dict(rec.fault_counts),
+                       "last_fault_ms": round(rec.last_fault_ms, 3)}
+                for name, rec in self._models.items()}
+        warm = list(self.fault_ms["warm"])
+        cold = list(self.fault_ms["cold"])
+        return {
+            "registered": len(models),
+            "resident": sum(1 for m in models.values()
+                            if m["state"] in ("resident", "evicting")),
+            "models": models,
+            "fault_in_ms": {
+                "warm_p50": pct(warm, 0.50), "warm_p99": pct(warm, 0.99),
+                "cold_p50": pct(cold, 0.50), "cold_p99": pct(cold, 0.99),
+                "warm_count": len(warm), "cold_count": len(cold),
+            },
+            "evictions_total": sum(self.hbm.evictions.values()),
+            "eviction_skips_total": sum(
+                self.hbm.eviction_skips.values()),
+        }

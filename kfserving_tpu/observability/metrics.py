@@ -218,6 +218,41 @@ def hbm_evictions_total():
         "fit an admission, labeled by the evicted model")
 
 
+def hbm_eviction_skips_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_hbm_eviction_skips_total",
+        "LRU eviction candidates the admission plan passed over, by "
+        "skipped model and reason (busy = the residency manager vetoed "
+        "a victim with queued or in-flight work — the admission-aware "
+        "guarantee that a serving model is never yanked from HBM)")
+
+
+# -- model residency (engine/residency.py) ------------------------------
+def residency_state():
+    return REGISTRY.gauge(
+        "kfserving_tpu_residency_state",
+        "Per-model residency state (0=registered, 1=host-resident "
+        "mmap-backed, 2=fault-in in flight, 3=HBM-resident serving); "
+        "series are pruned when the model deregisters")
+
+
+def residency_fault_in_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_residency_fault_in_ms",
+        "Fault-in latency of a predict that found its model outside "
+        "HBM, by source (warm = host mmap params re-placed on device; "
+        "cold = first activation paying download/materialize/compile)")
+
+
+def residency_fault_ins_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_residency_fault_ins_total",
+        "Residency fault-ins by model and outcome (warm|cold = one "
+        "physical transfer; coalesced = a concurrent request rode an "
+        "already-in-flight fault instead of issuing its own; error = "
+        "the fault failed and the incumbent resident set kept serving)")
+
+
 # -- per-request cost attribution (observability/attribution.py) --------
 def request_device_ms():
     return REGISTRY.histogram(
@@ -584,6 +619,16 @@ def router_swap_hold_ms():
         "Time requests were held at the router across an announced "
         "drain->activate swap window before being served",
         buckets=LATENCY_BUCKETS_MS)
+
+
+def router_affinity_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_router_affinity_total",
+        "Model-affinity replica picks by outcome (ring = served at the "
+        "model's primary ring position; spill = overload/breaker moved "
+        "it to the next ring position; fallback = the ring yielded no "
+        "host or an injected affinity-pick fault dropped the request "
+        "to plain round-robin)")
 
 
 def router_stream_failover_total():

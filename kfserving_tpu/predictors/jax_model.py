@@ -109,27 +109,67 @@ class JaxModel(Model):
     def __init__(self, name: str, model_dir: str,
                  config: Optional[JaxModelConfig] = None,
                  hbm: Optional[HBMManager] = None,
-                 config_overrides: Optional[Dict[str, Any]] = None):
+                 config_overrides: Optional[Dict[str, Any]] = None,
+                 residency=None):
         super().__init__(name)
         self.model_dir = model_dir
         self.config = config
         self.hbm = hbm
+        # ResidencyManager (engine/residency.py): when set, this model
+        # is demand-paged — register() makes it addressable with no
+        # device memory, predict faults it into HBM transparently, and
+        # eviction offloads (host mmap params stay) instead of
+        # unloading.
+        self.residency = residency
         self.config_overrides = dict(config_overrides or {})
         self.engine: Optional[JaxEngine] = None
         self.batcher: Optional[DynamicBatcher] = None
+        # Cached admission estimate: a cold fault whose admission finds
+        # every victim busy retries load() every ~20 ms (residency
+        # admit-wait) — the eval_shape trace must not be re-paid per
+        # attempt.
+        self._admit_nbytes: Optional[int] = None
         self._local_dir: Optional[str] = None
         # How this model's params were materialized at load: "mmap"
         # (param-cache hit), "checkpoint", or "init".
         self.param_source: Optional[str] = None
 
     # -- lifecycle ---------------------------------------------------------
+    def register(self) -> bool:
+        """Declarative registration (residency mode): host-side prep
+        only — artifact download + config parse, no device memory, no
+        compile.  The model becomes `ready` (addressable; the predict
+        path cold-faults the engine in on first use).  Registration of
+        N models is O(N) file reads, not N compile grids."""
+        from kfserving_tpu import startup
+
+        startup.mark("load_start")
+        self._local_dir = Storage.download(self.model_dir)
+        startup.mark("download")
+        if self.config is None:
+            self.config = JaxModelConfig.from_file(
+                os.path.join(self._local_dir, DEFAULT_CONFIG_NAME),
+                overrides=self.config_overrides)
+        if self.residency is not None:
+            self.residency.register(self.name, self)
+        self.ready = True
+        return True
+
     def load(self) -> bool:
         from kfserving_tpu.models import create_model, init_params
 
         from kfserving_tpu import startup
 
         startup.mark("load_start")
-        self._local_dir = Storage.download(self.model_dir)
+        if self.residency is not None and self._local_dir:
+            # Residency-managed cold fault: register() already pulled
+            # the artifact, and the admit-wait loop retries load()
+            # every ~20 ms — re-downloading a REMOTE storage_uri into
+            # a fresh temp dir per retry would turn a busy-victim wait
+            # into a download storm.
+            pass
+        else:
+            self._local_dir = Storage.download(self.model_dir)
         startup.mark("download")
         cfg = self.config
         if cfg is None:
@@ -155,10 +195,14 @@ class JaxModel(Model):
 
             from kfserving_tpu.engine.hbm import InsufficientHBM
 
-            abstract = jax.eval_shape(lambda: init_params(spec, seed=0))
-            nbytes = sum(
-                int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
-                for leaf in jax.tree.leaves(abstract))
+            if self._admit_nbytes is None:
+                abstract = jax.eval_shape(
+                    lambda: init_params(spec, seed=0))
+                self._admit_nbytes = sum(
+                    int(np.prod(leaf.shape)) *
+                    np.dtype(leaf.dtype).itemsize
+                    for leaf in jax.tree.leaves(abstract))
+            nbytes = self._admit_nbytes
             if old_engine is None:
                 self.hbm.admit(self.name, nbytes)
             else:
@@ -197,6 +241,11 @@ class JaxModel(Model):
                 # concurrent admit could claim).
                 self.hbm.commit(staging_key, self.name,
                                 engine.param_bytes())
+        if self.residency is not None:
+            # Idempotent for the cold-fault path (the manager already
+            # holds this model's record); a direct eager load joins the
+            # managed set as resident.
+            self.residency.register(self.name, self)
         return True
 
     def _build_engine(self, spec, cfg):
@@ -278,6 +327,7 @@ class JaxModel(Model):
 
         seq_buckets = (BucketPolicy(cfg.seq_buckets)
                        if cfg.seq_buckets else None)
+        residency_managed = self.residency is not None
         engine = JaxEngine(
             serve_fn, variables,
             batch_buckets=(BucketPolicy(cfg.batch_buckets)
@@ -286,6 +336,12 @@ class JaxModel(Model):
             seq_buckets=seq_buckets,
             pipeline_depth=cfg.pipeline_depth,
             param_source=param_source)
+        if residency_managed and engine.offloadable:
+            # Pin the params in HBM explicitly (one device_put of the
+            # mmap views) so residency accounting matches physical
+            # placement; the host tree stays as the restore source for
+            # every later evict -> fault-in cycle.
+            engine.restore()
         try:
             if cfg.warmup:
                 example = self._example_instance(spec)
@@ -331,6 +387,8 @@ class JaxModel(Model):
         return ex.astype(cfg.input_dtype)
 
     def unload(self) -> None:
+        if self.residency is not None:
+            self.residency.deregister(self.name)
         if self.engine is not None:
             self.engine.close()
             self.engine = None
@@ -338,6 +396,47 @@ class JaxModel(Model):
             self.hbm.release(self.name)
         self.batcher = None
         self.ready = False
+
+    # -- residency hooks (engine/residency.py contract) --------------------
+    @property
+    def offloadable(self) -> bool:
+        """Can this model leave HBM without losing its warm state?
+        True once the engine keeps a host-side (mmap-backed) restore
+        source — mesh-sharded models return False and are never
+        eviction victims."""
+        return self.engine is not None and self.engine.offloadable
+
+    def offload(self) -> None:
+        """Eviction body: drop device params, keep everything else
+        (engine shell, compiled executables, batcher, host mmap
+        params).  The model stays `ready` — the next predict faults it
+        back in, in milliseconds."""
+        if self.engine is not None:
+            self.engine.offload()
+
+    def demote(self) -> None:
+        """Eviction body for models without a host restore source
+        (param cache disabled, mesh-sharded params): drop the engine
+        entirely.  The model stays registered and addressable; its
+        next predict cold-faults a fresh build."""
+        if self.engine is not None:
+            self.engine.close()
+            self.engine = None
+        self.batcher = None
+
+    def fault_in(self) -> None:
+        """Warm fault body (blocking; residency executor): re-place
+        the host params on device."""
+        if self.engine is None:
+            raise InferenceError(
+                f"model {self.name} has no engine to fault in")
+        self.engine.restore()
+
+    def host_bytes(self) -> int:
+        """HBM bytes a fault-in of this model will claim."""
+        if self.engine is None:
+            return 0
+        return self.engine.host_param_bytes() or self.engine.param_bytes()
 
     @property
     def wire_dtype(self):
@@ -440,6 +539,17 @@ class JaxModel(Model):
     async def predict(self, request: Any) -> Any:
         if self.predictor_host:
             return await super().predict(request)
+        if self.residency is not None:
+            # Demand-paged residency gate: count this request as
+            # in-flight (never evict a model with queued work), fault
+            # the model into HBM if needed (single-flight, transparent
+            # to the caller), and touch the LRU ledger so victims
+            # reflect use order.
+            async with self.residency.serving(self.name):
+                return await self._predict_resident(request)
+        return await self._predict_resident(request)
+
+    async def _predict_resident(self, request: Any) -> Any:
         if self.batcher is None:
             raise InferenceError(f"model {self.name} not loaded")
         if isinstance(request, InferRequest) or (

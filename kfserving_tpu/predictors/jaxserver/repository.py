@@ -6,41 +6,117 @@ predictor, with the addition that loads/unloads go through one shared
 HBMManager: "loaded" on TPU means resident in HBM, so admission can evict
 LRU models (SURVEY.md §7 hard parts — the reference's disk-based
 load/unload in pkg/agent/puller.go:120-183 had no such constraint).
+
+With residency (the default), the repository is DEMAND-PAGED
+(engine/residency.py): `load` is declarative registration — host-side
+prep only, the model becomes addressable with no device memory — and
+the predict path transparently faults models into HBM, with
+admission-aware LRU eviction making room.  Hundreds of models register
+against one device; the HBM budget bounds how many serve concurrently.
+`residency=False` restores the eager load-is-resident behavior
+(each load admits immediately and eviction unloads the victim).
 """
 
+import logging
 import os
-from typing import Optional
+from typing import List, Optional
 
 from kfserving_tpu.engine.hbm import HBMManager
 from kfserving_tpu.model.repository import MODEL_MOUNT_DIRS, ModelRepository
-from kfserving_tpu.predictors.jax_model import JaxModel
+from kfserving_tpu.predictors.jax_model import DEFAULT_CONFIG_NAME, JaxModel
+
+logger = logging.getLogger("kfserving_tpu.jaxserver")
 
 
 class JaxModelRepository(ModelRepository):
     def __init__(self, models_dir: str = MODEL_MOUNT_DIRS,
-                 hbm: Optional[HBMManager] = None):
+                 hbm: Optional[HBMManager] = None,
+                 residency: bool = True):
         super().__init__(models_dir)
         self.hbm = hbm or HBMManager()
-        # The repository owns eviction: accounting decides *who*, the
-        # repository performs the unload that actually frees HBM.
-        self.hbm.evict_cb = self._evict
+        if residency:
+            from kfserving_tpu.engine.residency import ResidencyManager
+
+            # The manager owns eviction end to end: admission-aware
+            # victim choice against the ledger, physical offload of the
+            # victims (host mmap params retained for the warm fault
+            # back in).
+            self.residency: Optional[ResidencyManager] = \
+                ResidencyManager(self.hbm)
+        else:
+            self.residency = None
+            # Legacy eager mode: accounting decides *who*, the
+            # repository performs the unload that actually frees HBM.
+            self.hbm.evict_cb = self._evict
 
     def _evict(self, name: str) -> None:
         model = self.get_model(name)
         if model is not None:
             model.unload()
 
-    async def load(self, name: str) -> bool:
-        """Load <models_dir>/<name> as a JaxModel (agent puller load path:
-        POST /v2/repository/models/{name}/load after download)."""
+    def _catalog_dir(self) -> str:
+        """Resolve the catalog root once: models_dir may arrive as a
+        storage URI (the isvc spec's storage_uri, e.g. `file://...`) —
+        resolve it through Storage so both the boot registration sweep
+        and per-model load address a real directory.  Blocking for
+        remote schemes; callers already run off-loop."""
+        if not os.path.isdir(self.models_dir):
+            from kfserving_tpu.storage import Storage
+
+            self.models_dir = Storage.download(self.models_dir)
+        return self.models_dir
+
+    def _model_for(self, name: str) -> Optional[JaxModel]:
         model = self.get_model(name)
         if model is None:
-            model_dir = os.path.join(self.models_dir, name)
+            model_dir = os.path.join(self._catalog_dir(), name)
             if not os.path.isdir(model_dir):
-                return False
-            model = JaxModel(name, model_dir, hbm=self.hbm)
+                return None
+            model = JaxModel(name, model_dir, hbm=self.hbm,
+                             residency=self.residency)
             self.update(model)
+        return model
+
+    async def load(self, name: str) -> bool:
+        """Make <models_dir>/<name> servable (agent puller load path:
+        POST /v2/repository/models/{name}/load after download).  Under
+        residency this is declarative registration — host prep only,
+        first predict faults the model in; eager mode builds and
+        admits the engine here."""
+        model = self._model_for(name)
+        if model is None:
+            return False
+        if self.residency is not None:
+            return bool(await _to_thread(model.register))
         return bool(await _to_thread(model.load))
+
+    def register_all(self) -> List[str]:
+        """Declaratively register every model directory under
+        models_dir (blocking; callers run it off-loop).  The
+        multi-model replica boot path: N models become addressable in
+        O(N) file reads, no device work."""
+        if self.residency is None:
+            raise RuntimeError(
+                "register_all requires residency mode")
+        names = []
+        root = self._catalog_dir()
+        for name in sorted(os.listdir(root)):
+            if not os.path.exists(os.path.join(
+                    root, name, DEFAULT_CONFIG_NAME)):
+                continue
+            # Per-model isolation, the TrainedModel contract: one
+            # corrupt config.json must not make the other N-1 models
+            # unservable (the bad entry just stays unregistered).
+            try:
+                model = self._model_for(name)
+                if model is not None and model.register():
+                    names.append(name)
+            except Exception:
+                logger.exception(
+                    "registration of model %r failed; continuing "
+                    "catalog sweep", name)
+                self.models.pop(name, None)
+        return names
 
 
 async def _to_thread(fn):

@@ -57,6 +57,19 @@ SITES: Dict[str, tuple] = {
         "engine name — an injected error forces the whole plan to "
         "MISS (cache-miss storm on demand), proving the lookup "
         "telemetry counts it"),
+    "ENGINE_RESIDENCY_SWAP": (
+        "engine.residency_swap",
+        "ResidencyManager fault-in, keyed by `<model> "
+        "source:<warm|cold>` — an injected error fails the swap "
+        "BEFORE the admission plan runs, proving a failed fault-in "
+        "keeps the incumbent resident set serving (no half-loaded "
+        "model ever serves)"),
+    "ROUTER_AFFINITY_PICK": (
+        "router.affinity_pick",
+        "IngressRouter model-affinity ring pick, keyed by `<model> "
+        "<component>` — an injected error drops the request to "
+        "plain round-robin (counted as outcome=fallback), the "
+        "blind-spray escape hatch chaos must prove"),
 }
 
 
@@ -76,3 +89,5 @@ ORCHESTRATOR_STANDBY_ACTIVATE = "orchestrator.standby_activate"
 AUTOSCALER_TICK = "autoscaler.tick"
 ROUTER_ADMISSION = "router.admission"
 GENERATOR_PREFIX_LOOKUP = "generator.prefix_lookup"
+ENGINE_RESIDENCY_SWAP = "engine.residency_swap"
+ROUTER_AFFINITY_PICK = "router.affinity_pick"

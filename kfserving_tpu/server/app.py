@@ -864,7 +864,18 @@ class ModelServer:
                          status=400)
         models: Dict[str, Any] = {}
         hbm = None
+        residency = None
         seen_managers = set()
+        res_manager = getattr(self.repository, "residency", None)
+        if res_manager is not None:
+            try:
+                # Demand-paged residency snapshot (states, fault-in
+                # p50/p99, eviction/skip totals) beside the HBM ledger
+                # it acts on — one scrape answers "who is resident,
+                # how fast do faults land, is anything thrashing".
+                residency = res_manager.debug()
+            except Exception:
+                logger.exception("residency debug failed")
         for model in self.repository.get_models():
             debug = getattr(getattr(model, "engine", None),
                             "cache_debug", None)
@@ -888,7 +899,8 @@ class ModelServer:
                         hbm["used_bytes"] += snap["used_bytes"]
                 except Exception:
                     logger.exception("hbm debug failed")
-        return _json({"models": models, "hbm": hbm})
+        return _json({"models": models, "hbm": hbm,
+                      "residency": residency})
 
     async def _profiler_start(self, req: Request) -> Response:
         from kfserving_tpu.tracing import profiler
@@ -925,6 +937,13 @@ class ModelServer:
             self.register_model(model)
         for service in self.services:
             await service.start()
+        # Residency-managed repositories pin eviction storms into THIS
+        # server's flight recorder (thrash evidence beside the request
+        # evidence, federated at /debug/flightrecorder).
+        residency = getattr(self.repository, "residency", None)
+        if residency is not None:
+            residency.attach_flight_recorder(
+                self.monitoring.flight_recorder)
         # Device-discipline sanitizer (KFS_SANITIZE=1): violations
         # pin into this server's flight recorder, and the stall
         # watchdog heartbeats the serving loop.  Disabled: two env
@@ -1013,6 +1032,9 @@ class ModelServer:
             close = getattr(model, "close", None)
             if close is not None:
                 await close()
+        residency = getattr(self.repository, "residency", None)
+        if residency is not None:
+            residency.close()
         for service in reversed(self.services):
             await service.stop()
         await self.http_server.stop()

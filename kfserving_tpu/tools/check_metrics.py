@@ -197,6 +197,21 @@ async def smoke() -> List[str]:
         model="metrics-probe").observe(5)
     obs.request_cache_saved_tokens().labels(
         model="metrics-probe").observe(256)
+    # Model residency & affinity routing families (ISSUE 15): the
+    # residency state/fault-in telemetry, the admission-aware
+    # eviction-skip counter, and the router's affinity-pick outcomes —
+    # representative samples so names, label shapes, and unit suffixes
+    # always lint.
+    obs.residency_state().labels(model="metrics-probe").set(3.0)
+    for source, ms in (("warm", 12.0), ("cold", 850.0)):
+        obs.residency_fault_in_ms().labels(source=source).observe(ms)
+    for outcome in ("warm", "cold", "coalesced", "error"):
+        obs.residency_fault_ins_total().labels(
+            model="metrics-probe", outcome=outcome).inc()
+    obs.hbm_eviction_skips_total().labels(
+        model="metrics-probe", reason="busy").inc()
+    for outcome in ("ring", "spill", "fallback"):
+        obs.router_affinity_total().labels(outcome=outcome).inc()
     # Device-discipline sanitizer families (ISSUE 14): the violation
     # counter (one sample per kind) and the armed gauge, touched with
     # representative values so names/labels/suffixes always lint.

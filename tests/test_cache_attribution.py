@@ -79,18 +79,19 @@ async def _settle_pool(eng, timeout_s=10.0):
 
 
 async def test_stats_keys_unified_with_pool_counter_sample(tiny):
-    """Satellite: stats() and the timeline counter-sample path agree
-    on ONE canonical name (free_blocks/reclaimable_blocks), with the
-    old blocks_* spellings kept as deprecated aliases for one
-    release."""
+    """Satellite (ISSUE 13, finished in ISSUE 15): stats() and the
+    timeline counter-sample path agree on ONE canonical name
+    (free_blocks/reclaimable_blocks); the deprecated blocks_*
+    aliases served their one-release grace and are GONE from both."""
     from kfserving_tpu.observability.profiling import TIMELINE
 
     eng = make_paged(tiny)
     try:
         await eng.complete([5, 9, 2], max_new_tokens=2)
         st = eng.stats()["paged"]
-        assert st["free_blocks"] == st["blocks_free"]
-        assert st["reclaimable_blocks"] == st["blocks_reclaimable"]
+        assert "free_blocks" in st and "reclaimable_blocks" in st
+        assert "blocks_free" not in st
+        assert "blocks_reclaimable" not in st
         TIMELINE.clear()
         eng._record_pool_sample()
         samples = [e for e in TIMELINE.snapshot()

@@ -420,9 +420,9 @@ async def test_cancel_mid_prefill_releases_blocks(tiny):
         for _ in range(200):
             await asyncio.sleep(0.05)
             st = eng.stats()["paged"]
-            if st["blocks_free"] + st["blocks_reclaimable"] == total:
+            if st["free_blocks"] + st["reclaimable_blocks"] == total:
                 break
-        assert st["blocks_free"] + st["blocks_reclaimable"] == total
+        assert st["free_blocks"] + st["reclaimable_blocks"] == total
     finally:
         await eng.close()
 

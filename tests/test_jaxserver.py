@@ -185,12 +185,16 @@ def test_seq_too_long_rejected(tmp_path):
 
 
 def test_repository_load_unload_and_hbm_eviction(tmp_path):
-    """Two models, a budget that fits only one: loading the second evicts
-    the first (LRU), reference load/unload contract preserved."""
+    """Legacy eager mode (residency=False): two models, a budget that
+    fits only one — loading the second evicts AND UNLOADS the first
+    (LRU), the pre-residency reference load/unload contract.  The
+    demand-paged default (load = declarative registration, eviction
+    offloads instead of unloading) is covered in test_residency.py."""
     _write_model_dir(tmp_path, name="m1")
     _write_model_dir(tmp_path, name="m2")
     hbm = HBMManager(budget_bytes=1000)  # tiny MLP params ~700 bytes
-    repo = JaxModelRepository(models_dir=str(tmp_path), hbm=hbm)
+    repo = JaxModelRepository(models_dir=str(tmp_path), hbm=hbm,
+                              residency=False)
 
     async def run():
         assert await repo.load("m1")

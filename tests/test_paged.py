@@ -160,9 +160,9 @@ async def test_prefix_blocks_linger_and_get_evicted_under_pressure(
         for _ in range(30):
             await asyncio.sleep(0.1)
             st = eng.stats()["paged"]
-            if st["blocks_reclaimable"] >= 1:
+            if st["reclaimable_blocks"] >= 1:
                 break
-        assert st["blocks_reclaimable"] >= 1
+        assert st["reclaimable_blocks"] >= 1
         # A re-run of the same prompt hits the lingering block.
         hits0 = st["prefix_hits"]
         await eng.complete(prompt_a, max_new_tokens=2)
@@ -245,9 +245,9 @@ async def test_paged_cancel_releases_blocks(tiny):
         for _ in range(100):
             await asyncio.sleep(0.1)
             st = eng.stats()["paged"]
-            if st["blocks_free"] + st["blocks_reclaimable"] == total:
+            if st["free_blocks"] + st["reclaimable_blocks"] == total:
                 break
-        assert st["blocks_free"] + st["blocks_reclaimable"] == total
+        assert st["free_blocks"] + st["reclaimable_blocks"] == total
     finally:
         await eng.close()
 
@@ -462,9 +462,9 @@ async def test_prefill_enqueue_failure_releases_planned_blocks(tiny):
         for _ in range(100):
             await asyncio.sleep(0.05)
             st = eng.stats()["paged"]
-            if st["blocks_free"] == st["pool_blocks"]:
+            if st["free_blocks"] == st["pool_blocks"]:
                 break
-        assert st["blocks_free"] == st["pool_blocks"], st
+        assert st["free_blocks"] == st["pool_blocks"], st
         assert eng._prefix_index == {}
         # The SAME prefix now serves correctly (previously: the stale
         # chain would hit an unwritten block).

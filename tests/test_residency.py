@@ -1,0 +1,563 @@
+"""Demand-paged model residency (engine/residency.py) + model-affinity
+routing (ISSUE 15): declarative registration, transparent fault-in,
+single-flight coalescing, admission-aware eviction ordered under the
+ledger lock, chaos at `engine.residency_swap` / `router.affinity_pick`,
+and the consistent-ring replica pick.  Hermetic on the CPU backend;
+fast tier."""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kfserving_tpu.engine.hbm import HBMManager, InsufficientHBM
+from kfserving_tpu.predictors.jaxserver import JaxModelRepository
+from kfserving_tpu.reliability import fault_sites
+from kfserving_tpu.reliability.faults import faults
+
+X = {"instances": np.ones((1, 8)).tolist()}
+# Tiny MLP ~780 bytes of params.
+MLP_BYTES = 1000
+
+
+def _write_models(tmp_path, n, prefix="m"):
+    for i in range(n):
+        d = os.path.join(str(tmp_path), f"{prefix}{i}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "config.json"), "w") as f:
+            json.dump({"architecture": "mlp",
+                       "arch_kwargs": {"input_dim": 8, "features": [16],
+                                       "num_classes": 3},
+                       "max_latency_ms": 2, "warmup": False}, f)
+
+
+def _repo(tmp_path, budget=2 * MLP_BYTES, **kwargs):
+    hbm = HBMManager(budget_bytes=budget)
+    return JaxModelRepository(models_dir=str(tmp_path), hbm=hbm,
+                              **kwargs), hbm
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    yield
+    faults.reset()
+
+
+# ------------------------------------------- declarative registration
+
+
+def test_load_is_declarative_registration(tmp_path):
+    """POST load registers host-side only: the model is ready
+    (addressable) with NO engine and NO HBM claim; the first predict
+    cold-faults it in transparently."""
+    _write_models(tmp_path, 2)
+    repo, hbm = _repo(tmp_path)
+
+    async def run():
+        assert await repo.load("m0")
+        m0 = repo.get_model("m0")
+        assert m0.ready
+        assert m0.engine is None                 # no device memory
+        assert hbm.resident_models() == []       # no HBM claim
+        assert repo.residency.state_of("m0") == "registered"
+        resp = await m0.predict(X)               # transparent fault-in
+        assert len(resp["predictions"]) == 1
+        assert repo.residency.state_of("m0") == "resident"
+        assert hbm.resident_models() == ["m0"]
+        counts = repo.residency.debug()["models"]["m0"]["fault_ins"]
+        assert counts["cold"] == 1
+
+    asyncio.run(run())
+
+
+def test_register_all_scans_catalog(tmp_path):
+    _write_models(tmp_path, 5)
+    # A non-model directory is skipped, not an error.
+    os.makedirs(os.path.join(str(tmp_path), "not-a-model"))
+    repo, hbm = _repo(tmp_path)
+    names = repo.register_all()
+    assert names == [f"m{i}" for i in range(5)]
+    assert all(repo.is_model_ready(n) for n in names)
+    assert hbm.resident_models() == []
+
+
+def test_register_all_isolates_a_corrupt_model(tmp_path):
+    """One corrupt config.json must not make the other N-1 models
+    unservable: the bad entry stays unregistered, the sweep
+    continues."""
+    _write_models(tmp_path, 3)
+    with open(os.path.join(str(tmp_path), "m1", "config.json"),
+              "w") as f:
+        f.write("{not json")
+    repo, _ = _repo(tmp_path)
+    assert repo.register_all() == ["m0", "m2"]
+    assert repo.get_model("m1") is None
+    assert repo.is_model_ready("m0") and repo.is_model_ready("m2")
+
+
+# ------------------------------------------- demand paging & eviction
+
+
+def test_eviction_offloads_and_warm_fault_restores(tmp_path):
+    """Budget for two: the third predict evicts the LRU victim, which
+    keeps its warm engine shell + host mmap params; a later predict
+    faults it back in (warm) and serves BIT-IDENTICAL predictions —
+    no half-loaded model ever serves."""
+    _write_models(tmp_path, 3)
+    repo, hbm = _repo(tmp_path, budget=2 * MLP_BYTES)
+
+    async def run():
+        repo.register_all()
+        first = await repo.get_model("m0").predict(X)
+        await repo.get_model("m1").predict(X)
+        await repo.get_model("m2").predict(X)    # evicts m0 (LRU)
+        assert hbm.resident_models() == ["m1", "m2"]
+        assert repo.residency.state_of("m0") == "host"
+        m0 = repo.get_model("m0")
+        assert m0.ready and m0.engine is not None  # warm shell kept
+        again = await m0.predict(X)              # warm fault-in
+        assert np.allclose(first["predictions"], again["predictions"])
+        counts = repo.residency.debug()["models"]["m0"]["fault_ins"]
+        assert counts == {"cold": 1, "warm": 1, "coalesced": 0,
+                          "error": 0}
+        assert sum(hbm.evictions.values()) >= 2
+
+    asyncio.run(run())
+
+
+def test_predict_touches_lru_order(tmp_path):
+    """Victims come from USE order, not load order: re-using the
+    oldest-loaded model moves it to MRU, so the admission evicts the
+    actually-idle one."""
+    _write_models(tmp_path, 3)
+    repo, hbm = _repo(tmp_path, budget=2 * MLP_BYTES)
+
+    async def run():
+        repo.register_all()
+        await repo.get_model("m0").predict(X)
+        await repo.get_model("m1").predict(X)
+        await repo.get_model("m0").predict(X)    # touch: m0 -> MRU
+        await repo.get_model("m2").predict(X)    # must evict m1
+        assert hbm.resident_models() == ["m0", "m2"]
+
+    asyncio.run(run())
+
+
+# ------------------------------------------- fault-in races
+
+
+def test_concurrent_fault_ins_coalesce_single_flight(tmp_path):
+    """Two concurrent requests to the same non-resident model issue
+    exactly ONE device transfer; the loser rides the winner's fault
+    (outcome=coalesced)."""
+    _write_models(tmp_path, 2)
+    repo, hbm = _repo(tmp_path)
+
+    async def run():
+        repo.register_all()
+        m0 = repo.get_model("m0")
+        await m0.predict(X)                      # cold build
+        # Evict via admission so m0 is warm-offloaded.
+        await repo.get_model("m1").predict(X)
+        hbm.admit("filler", MLP_BYTES)           # forces m0 out
+        assert repo.residency.state_of("m0") == "host"
+        restores = 0
+        real = m0.engine.restore
+
+        def counting_restore():
+            nonlocal restores
+            restores += 1
+            return real()
+
+        m0.engine.restore = counting_restore
+        r1, r2 = await asyncio.gather(m0.predict(X), m0.predict(X))
+        assert len(r1["predictions"]) == len(r2["predictions"]) == 1
+        assert restores == 1                     # one physical transfer
+        counts = repo.residency.debug()["models"]["m0"]["fault_ins"]
+        assert counts["warm"] == 1
+        assert counts["coalesced"] >= 1
+
+    asyncio.run(run())
+
+
+def test_inflight_model_is_never_a_victim(tmp_path):
+    """Admission-aware eviction ordered under the ledger lock: while a
+    request holds m0 in flight, an admission that would evict it must
+    skip it (counted) and fail when nothing else is evictable; the
+    moment the request finishes, the same admission succeeds."""
+    _write_models(tmp_path, 1)
+    repo, hbm = _repo(tmp_path, budget=MLP_BYTES)
+
+    async def run():
+        repo.register_all()
+        m0 = repo.get_model("m0")
+        await m0.predict(X)
+        assert hbm.resident_models() == ["m0"]
+        async with repo.residency.serving("m0"):
+            # m0 has in-flight work: the plan must veto it.
+            with pytest.raises(InsufficientHBM, match="busy"):
+                hbm.admit("intruder", MLP_BYTES)
+            assert hbm.resident_models() == ["m0"]   # books untouched
+            assert repo.residency.state_of("m0") == "resident"
+            assert hbm.eviction_skips.get("m0", 0) >= 1
+        # Idle again: the same admission now evicts it.
+        hbm.admit("intruder", MLP_BYTES)
+        assert hbm.resident_models() == ["intruder"]
+        assert repo.residency.state_of("m0") == "host"
+
+    asyncio.run(run())
+
+
+def test_fault_in_waits_for_busy_victims_to_free(tmp_path):
+    """A fault-in that finds every candidate busy WAITS (bounded)
+    instead of failing the request: the admission-aware veto makes
+    no-victim a transient condition."""
+    _write_models(tmp_path, 2)
+    repo, hbm = _repo(tmp_path, budget=MLP_BYTES)
+
+    async def run():
+        repo.register_all()
+        m0, m1 = repo.get_model("m0"), repo.get_model("m1")
+        await m0.predict(X)
+        await m1.predict(X)       # evicts m0; m1 resident
+        gate = repo.residency.serving("m1")
+        await gate.__aenter__()   # m1 busy: m0's fault can't evict it
+        try:
+            task = asyncio.ensure_future(m0.predict(X))
+            await asyncio.sleep(0.2)
+            assert not task.done()           # parked on the veto
+        finally:
+            await gate.__aexit__(None, None, None)
+        resp = await asyncio.wait_for(task, timeout=10)
+        assert len(resp["predictions"]) == 1
+        assert hbm.resident_models() == ["m0"]
+
+    asyncio.run(run())
+
+
+# ------------------------------------------- chaos
+
+
+def test_failed_fault_in_keeps_incumbents_serving(tmp_path):
+    """Chaos at engine.residency_swap: the injected failure surfaces
+    to the faulting request alone — the incumbent resident set is
+    untouched and keeps serving, and the NEXT fault-in succeeds."""
+    _write_models(tmp_path, 2)
+    repo, hbm = _repo(tmp_path, budget=MLP_BYTES)
+
+    async def run():
+        repo.register_all()
+        m0, m1 = repo.get_model("m0"), repo.get_model("m1")
+        await m1.predict(X)                  # m1 is the incumbent
+        faults.configure({fault_sites.ENGINE_RESIDENCY_SWAP: {
+            "fail_first": 1, "match": "m0"}})
+        with pytest.raises(Exception, match="injected"):
+            await m0.predict(X)
+        # Incumbent set untouched; the failed model fell back cleanly.
+        assert hbm.resident_models() == ["m1"]
+        assert repo.residency.state_of("m0") == "registered"
+        assert len((await m1.predict(X))["predictions"]) == 1
+        # Retry succeeds (fail_first exhausted) and evicts the idle m1.
+        resp = await m0.predict(X)
+        assert len(resp["predictions"]) == 1
+        counts = repo.residency.debug()["models"]["m0"]["fault_ins"]
+        assert counts["error"] == 1 and counts["cold"] == 1
+
+    asyncio.run(run())
+
+
+def test_eviction_storm_pins_flight_recorder(tmp_path):
+    _write_models(tmp_path, 2)
+    repo, hbm = _repo(tmp_path, budget=MLP_BYTES)
+    mgr = repo.residency
+    mgr.storm_threshold = 2
+    mgr.storm_window_s = 60.0
+
+    class _Recorder:
+        entries = []
+
+        def record(self, entry, pin=None):
+            self.entries.append((entry, pin))
+
+    rec = _Recorder()
+    mgr.attach_flight_recorder(rec)
+
+    async def run():
+        repo.register_all()
+        m0, m1 = repo.get_model("m0"), repo.get_model("m1")
+        for _ in range(3):                    # thrash: m0<->m1 swaps
+            await m0.predict(X)
+            await m1.predict(X)
+
+    asyncio.run(run())
+    pins = [e for e, pin in rec.entries if pin == "eviction_storm"]
+    assert pins, "eviction storm never pinned"
+    assert pins[0]["kind"] == "residency_eviction_storm"
+    assert pins[0]["hbm"]["resident"]         # ledger snapshot embedded
+
+
+# ------------------------------------------- hbm unit coverage
+
+
+def test_hbm_victim_release_on_failed_plan():
+    """A plan that claims victims and then fails must release the
+    claims (victim_release) and leave the books untouched."""
+    hbm = HBMManager(budget_bytes=100)
+    claimed, released = [], []
+    hbm.victim_ok = lambda name: (claimed.append(name) or True)
+    hbm.victim_release = released.append
+    hbm.admit("a", 60)
+    hbm.admit("b", 40)
+    # c needs 90: evicting a (60) is not enough, b is vetoed after a
+    # was claimed -> plan fails -> a must be released.
+    hbm.victim_ok = lambda name: name == "a" and \
+        (claimed.append(name) or True)
+    with pytest.raises(InsufficientHBM):
+        hbm.admit("c", 90)
+    assert released == ["a"]
+    assert hbm.resident_models() == ["a", "b"]
+    assert hbm.eviction_skips.get("b") == 1
+    # A waiting fault-in retries admit every ~20 ms: the same busy
+    # candidate counts once per admission EPISODE, not per retry.
+    with pytest.raises(InsufficientHBM):
+        hbm.admit("c", 90)
+    assert hbm.eviction_skips.get("b") == 1
+    # A permanently-abandoned episode is closed explicitly (the
+    # residency manager's give-up path): a LATER independent
+    # admission of the same model counts its busy victims afresh.
+    hbm.end_skip_episode("c")
+    with pytest.raises(InsufficientHBM):
+        hbm.admit("c", 90)
+    assert hbm.eviction_skips.get("b") == 2
+
+
+def test_hbm_victim_bytes_accounted_until_physical_offload():
+    """Victims' bytes stay in the ledger until their physical offload
+    (evict_cb) completes: a concurrent admission planning against
+    freed-but-still-placed bytes would device_put straight into a
+    transient overcommit.  During the eviction window BOTH the victim
+    and the incoming model are booked — deliberately conservative."""
+    hbm = HBMManager(budget_bytes=100)
+    seen = {}
+
+    def evict_cb(name):
+        seen["used"] = hbm.used_bytes
+        seen["resident"] = set(hbm.resident_models())
+
+    hbm.evict_cb = evict_cb
+    hbm.admit("a", 60)
+    assert hbm.admit("b", 60) == ["a"]
+    assert seen["used"] == 120                  # a still booked + b reserved
+    assert seen["resident"] == {"a", "b"}
+    assert hbm.resident_models() == ["b"]       # commit after offload
+    assert hbm.used_bytes == 60
+
+
+def test_hbm_failed_evict_cb_does_not_strand_later_victims():
+    """One victim's failed physical offload must not strand the
+    REMAINING victims of the same plan in their claimed state with no
+    offload coming (a stuck 'evicting' record would hang every future
+    fault-in of that model)."""
+    hbm = HBMManager(budget_bytes=100)
+    offloaded = []
+
+    def evict_cb(name):
+        if name == "a":
+            raise RuntimeError("offload blew up")
+        offloaded.append(name)
+
+    hbm.evict_cb = evict_cb
+    hbm.admit("a", 60)
+    hbm.admit("b", 40)
+    victims = hbm.admit("c", 100)    # must evict BOTH a and b
+    assert victims == ["a", "b"]
+    assert offloaded == ["b"]        # b's offload ran despite a's crash
+    assert hbm.resident_models() == ["c"]
+
+
+def test_engine_offload_guard(tmp_path):
+    """A straggler hitting an offloaded engine fails fast instead of
+    dereferencing freed device memory."""
+    import jax.numpy as jnp
+
+    from kfserving_tpu.engine.jax_engine import JaxEngine
+
+    params = {"w": np.ones((4, 3), np.float32)}
+    eng = JaxEngine(lambda v, x: x @ v["w"], params)
+    out = eng.predict_sync(np.ones((2, 4), np.float32))
+    assert np.asarray(out).shape == (2, 3)
+    assert eng.offloadable
+    assert eng.host_param_bytes() == 4 * 3 * 4
+    assert eng.offload()
+    with pytest.raises(RuntimeError, match="offloaded"):
+        eng.predict_sync(np.ones((2, 4), np.float32))
+    dt = eng.restore()
+    assert dt >= 0.0
+    out2 = eng.predict_sync(np.ones((2, 4), np.float32))
+    assert np.allclose(np.asarray(out), np.asarray(out2))
+    eng.close()
+
+
+# ------------------------------------------- affinity routing
+
+
+def _fake_replicas(hosts):
+    from kfserving_tpu.control.orchestrator import Replica
+
+    return [Replica("default/svc/predictor", "rev", h) for h in hosts]
+
+
+def _bare_router(**kwargs):
+    from kfserving_tpu.control.router import IngressRouter
+
+    class _Ctl:
+        class reconciler:
+            class orchestrator:
+                state = {}
+        trained_models = {}
+
+        @staticmethod
+        def get(name, namespace="default"):
+            return None
+
+    return IngressRouter(_Ctl(), affinity="model", **kwargs)
+
+
+def test_affinity_ring_is_deterministic_and_partitions():
+    router = _bare_router()
+    replicas = _fake_replicas(
+        [f"127.0.0.1:{9000 + i}" for i in range(3)])
+    gate = lambda host: None  # noqa: E731 — no breakers
+    picks = {}
+    for model in (f"model-{i}" for i in range(40)):
+        first = router._affinity_pick(model, replicas, gate)
+        # Deterministic: the same model always lands the same host.
+        assert router._affinity_pick(model, replicas, gate) == first
+        picks.setdefault(first, 0)
+        picks[first] += 1
+    # The catalog partitions across the fleet, not onto one host.
+    assert len(picks) == 3
+
+
+def test_affinity_spills_on_overload_and_death():
+    router = _bare_router()
+    hosts = [f"127.0.0.1:{9000 + i}" for i in range(3)]
+    replicas = _fake_replicas(hosts)
+    gate = lambda host: None  # noqa: E731
+    home = router._affinity_pick("hot-model", replicas, gate)
+    # Overload the home replica past the spill ceiling.
+    router._host_inflight[home] = router.affinity_spill
+    spill = router._affinity_pick("hot-model", replicas, gate)
+    assert spill is not None and spill != home
+    # Same overload signal gone -> back to the home replica.
+    router._host_inflight.pop(home)
+    assert router._affinity_pick("hot-model", replicas, gate) == home
+    # Replica death: the home host disappears from the eligible set
+    # entirely (breaker/eviction path) — next ring position serves.
+    alive = [r for r in replicas if r.host != home]
+    moved = router._affinity_pick("hot-model", alive, gate)
+    assert moved is not None and moved != home
+
+
+def test_affinity_every_host_vetoed_returns_none():
+    router = _bare_router()
+    replicas = _fake_replicas(["127.0.0.1:9000", "127.0.0.1:9001"])
+    for r in replicas:
+        router._host_inflight[r.host] = router.affinity_spill
+    assert router._affinity_pick("m", replicas,
+                                 lambda host: None) is None
+
+
+# --------------------------------- end-to-end: fleet + trained models
+
+
+@pytest.mark.asyncio
+async def test_affinity_fleet_e2e_with_chaos_fallback(tmp_path):
+    """Full stack: a 2-replica multi-model isvc fronting a 4-model
+    catalog, TrainedModel names routed through the router.  Affinity
+    pins each model to one replica (federated /debug/cache proves the
+    partition); an injected `router.affinity_pick` fault degrades to
+    round-robin with requests still served."""
+    import aiohttp
+
+    from kfserving_tpu.control.controller import Controller
+    from kfserving_tpu.control.orchestrator import InProcessOrchestrator
+    from kfserving_tpu.control.router import IngressRouter
+    from kfserving_tpu.control.spec import (
+        InferenceService,
+        PredictorSpec,
+        TrainedModel,
+    )
+
+    _write_models(tmp_path, 4)
+    controller = Controller(InProcessOrchestrator())
+    isvc = InferenceService(
+        name="mms",
+        predictor=PredictorSpec(
+            framework="jax", storage_uri=str(tmp_path),
+            multi_model=True, hbm_budget_bytes=8 * MLP_BYTES,
+            min_replicas=2, max_replicas=2))
+    await controller.apply(isvc)
+    for i in range(4):
+        await controller.apply_trained_model(TrainedModel(
+            name=f"m{i}", inference_service="mms",
+            storage_uri=os.path.join(str(tmp_path), f"m{i}"),
+            memory_bytes=MLP_BYTES))
+    router = IngressRouter(controller, http_port=0, affinity="model")
+    await router.start_async()
+    try:
+        body = json.dumps(X).encode()
+        async with aiohttp.ClientSession() as session:
+            for i in range(4):
+                for _ in range(3):
+                    async with session.post(
+                            f"http://127.0.0.1:{router.http_port}"
+                            f"/v1/models/m{i}:predict",
+                            data=body) as resp:
+                        assert resp.status == 200, await resp.text()
+            orch = controller.reconciler.orchestrator
+            cid = "default/mms/predictor"
+            replicas = orch.replicas(cid)
+            assert len(replicas) == 2
+            # Partition evidence via the federated cache view: each
+            # model faulted in on exactly the replica its ring
+            # position names — never thrashed onto both.
+            async with session.get(
+                    f"http://127.0.0.1:{router.http_port}"
+                    f"/debug/cache") as resp:
+                assert resp.status == 200
+                fleet = await resp.json()
+            loaded = {}
+            for host, snap in fleet["replicas"].items():
+                res = snap.get("residency") or {}
+                for name, info in (res.get("models") or {}).items():
+                    total = (info["fault_ins"]["cold"]
+                             + info["fault_ins"]["warm"])
+                    if total:
+                        loaded.setdefault(name, []).append(host)
+            assert set(loaded) == {"m0", "m1", "m2", "m3"}
+            for name, on_hosts in loaded.items():
+                expected = router._affinity_pick(
+                    name, replicas, lambda h: None)
+                assert on_hosts == [expected], \
+                    f"{name} served on {on_hosts}, ring says {expected}"
+            # Chaos: affinity pick faults -> round-robin fallback,
+            # requests still serve.
+            faults.configure({fault_sites.ROUTER_AFFINITY_PICK: {
+                "error_rate": 1.0}})
+            for i in range(4):
+                async with session.post(
+                        f"http://127.0.0.1:{router.http_port}"
+                        f"/v1/models/m{i}:predict",
+                        data=body) as resp:
+                    assert resp.status == 200, await resp.text()
+            from kfserving_tpu.observability import metrics as obs
+
+            fallback = obs.router_affinity_total().labels(
+                outcome="fallback")
+            assert fallback.value >= 4
+    finally:
+        await router.stop_async()
+        await controller.reconciler.orchestrator.shutdown()
