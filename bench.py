@@ -159,6 +159,16 @@ def _compact_configs(results: dict) -> dict:
                           "tokens_saved_consistent"))
             c["tokens_saved"] = (r.get("shared") or {}).get(
                 "tokens_saved_total")
+        elif name == "kvtier":
+            c.update(pick(r, "ttft_p50_tier_over_drop",
+                          "tokens_saved_consistent",
+                          "drop_arm_saved_nothing"))
+            c["tier_ttft_p50_ms"] = (r.get("tier") or {}).get(
+                "ttft_p50_ms")
+            c["drop_ttft_p50_ms"] = (r.get("drop") or {}).get(
+                "ttft_p50_ms")
+            c["host_tier_tokens_saved"] = (r.get("tier") or {}).get(
+                "tokens_saved_total")
         elif name == "generate_stream_wire":
             c["grpc_over_sse"] = r.get("grpc_over_sse")
             c["grpc_tokens_per_s"] = (r.get("grpc") or {}).get(
@@ -216,6 +226,7 @@ def main():
         "generate_cold4k": C.bench_generate_cold4k,
         "generate_stream_wire": C.bench_generate_stream_wire,
         "cache": C.bench_cache,
+        "kvtier": C.bench_kvtier,
     }
     results = {}
     for name, fn in matrix.items():

@@ -979,7 +979,10 @@ class IngressRouter:
         qs = f"?top_k={top_k}" if top_k else ""
         replicas: Dict[str, dict] = {}
         totals = {"index_entries": 0, "prefix_hits": 0,
-                  "prefix_misses": 0, "prefill_tokens_saved": 0}
+                  "prefix_misses": 0, "prefill_tokens_saved": 0,
+                  "host_tier_blocks": 0, "host_tier_spills": 0,
+                  "host_tier_faulted_blocks": 0,
+                  "host_tier_tokens_saved": 0}
         for host, body in await self._scrape_json_all(
                 hosts, f"/debug/cache{qs}"):
             replicas[host] = body
@@ -993,6 +996,16 @@ class IngressRouter:
                     "prefix_misses", 0)
                 totals["prefill_tokens_saved"] += pool.get(
                     "prefill_tokens_saved", 0)
+                totals["host_tier_tokens_saved"] += pool.get(
+                    "host_tier_tokens_saved", 0)
+            # Host KV tiers (ISSUE 16): where evicted conversation
+            # state is parked, fleet-wide.
+            for tier in (body.get("host_tier") or {}).values():
+                totals["host_tier_blocks"] += tier.get(
+                    "used_blocks", 0)
+                totals["host_tier_spills"] += tier.get("spills", 0)
+                totals["host_tier_faulted_blocks"] += tier.get(
+                    "faulted_blocks", 0)
         return Response(json.dumps({
             "replicas": replicas,
             "fleet": totals,

@@ -62,6 +62,7 @@ import asyncio
 import concurrent.futures
 import itertools
 import logging
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -123,6 +124,15 @@ class _Request:
     blocks_held: int = 0          # peak slot-table blocks (paged)
     cache_hit_blocks: int = 0     # prompt blocks served by the index
     cache_saved_tokens: int = 0   # hit blocks x block_size
+    # Host KV tier (engine/kv_tier.py): prompt blocks faulted back
+    # from the host spill tier instead of re-prefilled — kept
+    # DISTINCT from the device prefix-cache fields above so the cost
+    # record shows which tier earned the savings (the two are
+    # additive).  Mutated on the enqueue executor at fault-back
+    # drain time; the loop thread awaits the drain before the
+    # request can reach any terminal path.
+    host_tier_hit_blocks: int = 0
+    host_tier_saved_tokens: int = 0
 
 
 @dataclass
@@ -177,6 +187,8 @@ class GenerationEngine:
                  block_size: Optional[int] = None,
                  cache_blocks: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
+                 host_tier_blocks: Optional[int] = None,
+                 host_tier_dir: Optional[str] = None,
                  adaptive_depth: bool = True,
                  rng_seed: int = 0,
                  logprob_topk: int = 5,
@@ -309,9 +321,12 @@ class GenerationEngine:
             self._chain_hits: Dict[bytes, int] = {}
             # Eviction accounting by cause (registry twin:
             # kfserving_tpu_generator_block_evictions_total).
+            # Capacity evictions split by fate: spilled (the chain
+            # survives in the host KV tier) vs dropped (the drop-on-
+            # evict baseline — no tier, no chain, or a failed spill).
             self.block_evictions: Dict[str, int] = {
-                "capacity": 0, "index_invalidation": 0,
-                "zombie_deferral": 0}
+                "capacity_dropped": 0, "capacity_spilled": 0,
+                "index_invalidation": 0, "zombie_deferral": 0}
             self.prefill_tokens_saved = 0
             # (release_at_decode_step, [block ids]) — see
             # _free_slot_state for why release is deferred.
@@ -322,7 +337,51 @@ class GenerationEngine:
             self._plan_regs: Dict[int, List[Tuple[bytes, int]]] = {}
             self.prefix_hits = 0
             self.prefix_misses = 0
+            # -- host KV tier (engine/kv_tier.py) ----------------------
+            # Capacity-evicted prefix blocks spill to a host-RAM mmap
+            # tier instead of being dropped; a returning turn's plan
+            # probes device index -> host tier -> re-prefill.  Off by
+            # default (host_tier_blocks=0); KFS_KV_TIER_BLOCKS is the
+            # env twin for server deployments.
+            if host_tier_blocks is None:
+                try:
+                    host_tier_blocks = int(os.environ.get(
+                        "KFS_KV_TIER_BLOCKS", "0"))
+                except ValueError:
+                    host_tier_blocks = 0
+            self.kv_tier = None
+            if host_tier_blocks and int(host_tier_blocks) > 0:
+                from kfserving_tpu.engine.kv_tier import HostKVTier
+
+                block_payload = (2 * n_layers * bs * cfg.num_heads
+                                 * cfg.head_dim
+                                 * np.dtype(cache_dtype).itemsize)
+                self.kv_tier = HostKVTier(
+                    block_bytes=block_payload,
+                    capacity_blocks=int(host_tier_blocks),
+                    directory=(host_tier_dir
+                               or os.environ.get("KFS_KV_TIER_DIR")),
+                    model=self.name)
+            # Spills awaiting their device gather: (chain, block).
+            # Appended under _block_lock at eviction time; drained on
+            # the enqueue executor BEFORE any dispatch that could
+            # rewrite the evicted block (same-thread FIFO is the
+            # ordering proof — the gather's snapshot always precedes
+            # the overwrite's dispatch).
+            self._spill_pending: List[Tuple[bytes, int]] = []
+            # Host-tier fault-backs awaiting their pool insert:
+            # (chain, block, request, primary).  primary=False rows
+            # are coalesced riders on the same chain's single read.
+            self._faultback_pending: List[Tuple[bytes, int, Any,
+                                                bool]] = []
+            # chain -> destination block of a PENDING (undrained)
+            # fault-back: a second plan in the same admission batch
+            # shares the block instead of reading the tier twice
+            # (single-flight).  Guarded by _block_lock.
+            self._faultback_by_chain: Dict[bytes, int] = {}
+            self.host_tier_tokens_saved = 0
         else:
+            self.kv_tier = None  # host tier is paged-mode only
             cache_shape = (self.max_slots, self.max_seq,
                            cfg.num_heads, cfg.head_dim)
             self._cache_shape = cache_shape
@@ -603,6 +662,18 @@ class GenerationEngine:
 
         self._insert = jax.jit(insert_fn, donate_argnums=(0,))
 
+        if paged and self.kv_tier is not None:
+            def gather_blocks_fn(caches, idx):
+                """Snapshot the k/v of pool blocks `idx` [N] as
+                standalone device arrays (NOT donating the caches):
+                the spill path fetches the snapshot on the fetch
+                executor while later dispatches keep mutating the
+                pool — the data dependency pins the pre-overwrite
+                contents."""
+                return [(k[idx], v[idx]) for k, v in caches]
+
+            self._gather_blocks = jax.jit(gather_blocks_fn)
+
         # Two executors with distinct roles: `_executor` owns blocking
         # D2H fetches (each ~an RTT) — TWO workers, because fetches
         # are submitted EAGERLY at enqueue time and a decode wave's
@@ -857,6 +928,8 @@ class GenerationEngine:
                 pass
         self._executor.shutdown(wait=True)
         self._enqueue_executor.shutdown(wait=True)
+        if self.kv_tier is not None:
+            self.kv_tier.close()
 
     def shutdown_nowait(self):
         """Synchronous best-effort teardown (repository unload runs
@@ -867,6 +940,8 @@ class GenerationEngine:
             self._wakeup.set()
         self._executor.shutdown(wait=False)
         self._enqueue_executor.shutdown(wait=False)
+        if self.kv_tier is not None:
+            self.kv_tier.close()
 
     def load_gauges(self) -> Dict[str, int]:
         """Instantaneous saturation signal for the autoscaler: a
@@ -974,6 +1049,10 @@ class GenerationEngine:
                     "evictions": dict(self.block_evictions),
                     "preemptions": self.preemptions,
                 }
+            if self.kv_tier is not None:
+                out["paged"]["host_tier_tokens_saved"] = \
+                    self.host_tier_tokens_saved
+                out["host_tier"] = self.kv_tier.debug()
             if self.prefill_chunk_tokens is not None:
                 out["chunked_prefill"] = {
                     "chunk_tokens": self.prefill_chunk_tokens,
@@ -1042,13 +1121,33 @@ class GenerationEngine:
                 # the chain at a different (still-resident) block.
                 self._prefix_index.pop(chain, None)
                 self._chain_hits.pop(chain, None)
-            self.block_evictions["capacity"] += 1
-            obs.generator_block_evictions_total().labels(
-                model=self.name, cause="capacity").inc()
-            TIMELINE.record("host", "cache.evict",
-                            attrs={"cause": "capacity", "block": blk})
+            # Fate of the evicted state: spill to the host tier when
+            # one is wired (the chain digest is the key; the device
+            # gather rides the enqueue executor BEFORE any dispatch
+            # can rewrite blk), otherwise — or for an unregistered
+            # block — it drops, the baseline.  Spill outcomes resolve
+            # asynchronously: the cause counter lands when the tier
+            # write commits (capacity_spilled) or fails
+            # (capacity_dropped), keeping the split honest under
+            # chaos injection.
+            if self.kv_tier is None or chain is None:
+                self._count_capacity_locked("capacity_dropped", blk)
+            elif self.kv_tier.contains(chain):
+                # Already host-resident (spilled on a previous
+                # eviction and faulted back since): the state is
+                # safe, no second copy needed.
+                self._count_capacity_locked("capacity_spilled", blk)
+            else:
+                self._spill_pending.append((chain, blk))
             return blk
         return None
+
+    def _count_capacity_locked(self, cause: str, blk: int) -> None:
+        self.block_evictions[cause] += 1
+        obs.generator_block_evictions_total().labels(
+            model=self.name, cause=cause).inc()
+        TIMELINE.record("host", "cache.evict",
+                        attrs={"cause": cause, "block": blk})
 
     def _ref_block_locked(self, blk: int) -> None:
         self._block_ref[blk] += 1
@@ -1139,6 +1238,214 @@ class GenerationEngine:
             obs.generator_block_evictions_total().labels(
                 model=self.name, cause="zombie_deferral").inc(released)
 
+    # -- host KV tier: spill & fault-back ----------------------------------
+    # Both paths ride the single-worker enqueue executor, whose only
+    # submitter is the scheduler loop: submission FIFO there IS device
+    # program order, so a gather dispatched before an overwriting
+    # insert snapshots pre-overwrite bytes (the XLA data dependency
+    # pins them) no matter when its D2H fetch completes, and a
+    # fault-back insert dispatched before the plan's own prefill is
+    # resident by the time anything reads the block.
+
+    def _drain_spills(self) -> None:
+        """Runs on the ENQUEUE executor, before any dispatch that
+        could rewrite a spill-pending block: one non-donating gather
+        dispatch per <=32-block group snapshots the pending blocks'
+        k/v, then the fetch executor D2Hs the snapshot and writes the
+        tier — the scheduler loop never touches mmap I/O."""
+        if self.kv_tier is None:
+            return
+        with self._block_lock:
+            if not self._spill_pending:
+                return
+            pending = self._spill_pending
+            self._spill_pending = []
+        jnp = self._jnp
+        for i in range(0, len(pending), 32):
+            grp = pending[i:i + 32]
+            padded = 1
+            while padded < len(grp):
+                padded *= 2
+            # Pad to a pow2 gather width (bounded compile count, same
+            # discipline as prefill row buckets); pad rows duplicate
+            # block 0 and are simply not written to the tier.
+            idx = np.asarray(
+                [b for _, b in grp]
+                + [grp[0][1]] * (padded - len(grp)), np.int32)
+            self._note_program("kv_gather", padded)
+            snap = self._gather_blocks(self._caches, jnp.asarray(idx))
+            self._executor.submit(self._spill_write, grp, snap)
+
+    def _spill_write(self, grp: List[Tuple[bytes, int]], snap) -> None:
+        """Fetch-executor side of a spill: D2H the gathered snapshot
+        (a sanctioned sync, same contract as wave fetches) and write
+        each block's payload into the host tier.  TRANSACTIONAL per
+        block: any failure — the `engine.kv_spill` chaos site, a full
+        tier, an mmap error — degrades THAT eviction to the
+        drop-on-evict baseline, and the tier index only publishes
+        after the full payload landed, so a half-spilled chain is
+        never readable.  The eviction-cause accounting deferred at
+        `_alloc_block_locked` lands here: capacity_spilled when the
+        tier committed, capacity_dropped otherwise — the split stays
+        honest under chaos."""
+        from kfserving_tpu.reliability import fault_sites
+        from kfserving_tpu.reliability.faults import (
+            FaultInjected,
+            faults,
+        )
+
+        outcomes: List[Tuple[int, str]] = []
+        try:
+            if faults.configured(fault_sites.ENGINE_KV_SPILL):
+                faults.inject_sync(fault_sites.ENGINE_KV_SPILL,
+                                   key=self.name)
+            with sanitizer.sanctioned_fetch():
+                # kfslint: disable=host-sync — sanctioned fetch site:
+                # the spill snapshot's D2H join, off-loop on the fetch
+                # executor.
+                host = [(np.asarray(k), np.asarray(v))
+                        for k, v in snap]
+            for row, (chain, blk) in enumerate(grp):
+                payload = b"".join(
+                    part for k, v in host
+                    for part in (k[row].tobytes(), v[row].tobytes()))
+                ok = self.kv_tier.put(chain, payload)
+                outcomes.append((blk, "capacity_spilled" if ok
+                                 else "capacity_dropped"))
+        except FaultInjected:
+            pass  # chaos: remaining blocks degrade to drops below
+        except Exception:
+            logger.exception("kv spill batch failed")
+        finally:
+            aborted = len(grp) - len(outcomes)
+            if aborted:
+                self.kv_tier.note_spill_failure(aborted)
+                outcomes.extend(
+                    (blk, "capacity_dropped")
+                    for _, blk in grp[len(outcomes):])
+            with self._block_lock:
+                for blk, cause in outcomes:
+                    self._count_capacity_locked(cause, blk)
+
+    def _drain_faultbacks(self) -> bool:
+        """Runs on the ENQUEUE executor, after planning and before the
+        plan's own dispatches: read every pending primary fault-back's
+        payload from the host tier and land it in the pool with one
+        insert dispatch per <=32-block group.  Returns False on ANY
+        failure (the `engine.kv_faultback` chaos site, an entry
+        evicted between probe and read, a read error) WITHOUT having
+        dispatched anything — the caller rolls the whole plan set back
+        and the requests re-admit as plain re-prefills (the chains are
+        dropped from the tier, so the replan misses it: transactional
+        degradation).  Spills drain FIRST: this very plan's fresh
+        dest allocations may have evicted spill-pending blocks, and
+        their gather must dispatch before the insert overwrites
+        them."""
+        self._drain_spills()
+        if self.kv_tier is None:
+            return True
+        with self._block_lock:
+            if not self._faultback_pending:
+                return True
+            pending = self._faultback_pending
+            self._faultback_pending = []
+        from kfserving_tpu.reliability import fault_sites
+        from kfserving_tpu.reliability.faults import (
+            FaultInjected,
+            faults,
+        )
+
+        primaries = [(ch, blk) for ch, blk, _r, prim in pending
+                     if prim]
+        riders = len(pending) - len(primaries)
+        t0 = time.perf_counter()
+        payloads: Dict[bytes, bytes] = {}
+        try:
+            if faults.configured(fault_sites.ENGINE_KV_FAULTBACK):
+                faults.inject_sync(fault_sites.ENGINE_KV_FAULTBACK,
+                                   key=self.name)
+            for ch, _blk in primaries:
+                payloads[ch] = self.kv_tier.read(ch)
+        except Exception as e:
+            # Transactional failure: nothing dispatched, no index
+            # entry published.  Drop the chains (their payloads are
+            # now suspect / proven unreadable) so the replanned turns
+            # MISS the tier and re-prefill from the prompt.
+            if not isinstance(e, (FaultInjected, KeyError)):
+                logger.warning("kv fault-back failed: %r", e)
+            self.kv_tier.note_fault_failure(len(pending))
+            with self._block_lock:
+                for ch, _blk in primaries:
+                    self._faultback_by_chain.pop(ch, None)
+            for ch, _blk in primaries:
+                self.kv_tier.drop(ch)
+                self.kv_tier.end_fault(ch)
+            return False
+        # Payloads in hand: land them with the same insert program
+        # prefill uses (B=1 row, -1 pads drop), then publish the
+        # chains to the prefix index — from here the blocks are
+        # ordinary shareable device-resident prefix state.
+        jnp = self._jnp
+        k0 = self._caches[0][0]
+        bs, H, D = (int(x) for x in k0.shape[1:])
+        dtype = np.dtype(k0.dtype)
+        per = bs * H * D * dtype.itemsize
+        for i in range(0, len(primaries), 32):
+            grp = primaries[i:i + 32]
+            padded = 1
+            while padded < len(grp):
+                padded *= 2
+            layers = [(np.zeros((1, padded * bs, H, D), dtype),
+                       np.zeros((1, padded * bs, H, D), dtype))
+                      for _ in self._caches]
+            dest = np.full((1, padded), -1, np.int32)
+            for j, (ch, blk) in enumerate(grp):
+                pay = payloads[ch]
+                dest[0, j] = blk
+                for li, (k_new, v_new) in enumerate(layers):
+                    off = li * 2 * per
+                    k_new[0, j * bs:(j + 1) * bs] = np.frombuffer(
+                        pay, dtype, count=bs * H * D,
+                        offset=off).reshape(bs, H, D)
+                    v_new[0, j * bs:(j + 1) * bs] = np.frombuffer(
+                        pay, dtype, count=bs * H * D,
+                        offset=off + per).reshape(bs, H, D)
+            self._note_program("kv_faultback", padded)
+            self._caches = self._insert(
+                self._caches,
+                [(jnp.asarray(k), jnp.asarray(v)) for k, v in layers],
+                jnp.asarray(dest))
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        saved = 0
+        with self._block_lock:
+            for ch, blk in primaries:
+                # Publish: the insert is dispatched, so the block is
+                # ordinary prefix state.  A concurrent identical
+                # admission may have registered the chain first —
+                # keep the canonical entry (same rule as
+                # _register_chunk_blocks); our block stays private.
+                if self._prefix_index.get(ch) is None:
+                    self._prefix_index[ch] = blk
+                    self._block_chain[blk] = ch
+                self._faultback_by_chain.pop(ch, None)
+            for _ch, _blk, req, _prim in pending:
+                req.host_tier_hit_blocks += 1
+                req.host_tier_saved_tokens += self.block_size
+                saved += self.block_size
+            self.host_tier_tokens_saved += saved
+        for ch, _blk in primaries:
+            self.kv_tier.end_fault(ch)
+        obs.generator_kv_tier_tokens_saved_total().labels(
+            model=self.name).inc(saved)
+        self.kv_tier.note_faultback(len(primaries), elapsed_ms)
+        if riders:
+            self.kv_tier.note_coalesced(riders)
+        TIMELINE.record("host", "cache.faultback",
+                        attrs={"blocks": len(primaries),
+                               "coalesced": riders,
+                               "ms": round(elapsed_ms, 3)})
+        return True
+
     def _plan_prompt_blocks(self, req: _Request, slot: int,
                             chunk_regs: Optional[Dict[int, Tuple[
                                 bytes, int]]] = None,
@@ -1184,6 +1491,11 @@ class GenerationEngine:
         plan_misses = 0
         hit_chains: List[bytes] = []
         depth_obs: List[int] = []
+        # Host-tier fault-backs this plan claims: (chain, dest block,
+        # primary).  primary=False rows coalesce on a pending fault's
+        # block instead of reading the tier again (single-flight).
+        plan_host_hits = 0
+        host_faults: List[Tuple[bytes, int, bool]] = []
         # Chain digests depend only on the prompt bytes — compute them
         # outside the lock, once, for both the hit probe and the
         # allocation loop below.
@@ -1214,14 +1526,26 @@ class GenerationEngine:
                 bpc = self.prefill_chunk_tokens // bs
                 h = 0
                 for c in range(full):
-                    if force_miss or \
-                            self._prefix_index.get(chains[c]) is None:
+                    if force_miss:
                         break
-                    h += 1
+                    if self._prefix_index.get(chains[c]) is not None:
+                        h += 1
+                        continue
+                    # Probe order: device index above, host tier
+                    # here — a host-resident chain counts toward the
+                    # contiguous hit prefix (its chunk skips dispatch
+                    # after the fault-back lands), re-prefill below.
+                    if self.kv_tier is not None and (
+                            chains[c] in self._faultback_by_chain
+                            or self.kv_tier.contains(chains[c])):
+                        h += 1
+                        continue
+                    break
                 n_chunks = -(-n // self.prefill_chunk_tokens)
                 max_hit_blocks = min((h // bpc) * bpc,
                                      bpc * (n_chunks - 1))
             for c in range(total):
+                host_chain: Optional[bytes] = None
                 if c < full:
                     chain = chains[c]
                     hit = (None if force_miss
@@ -1239,7 +1563,34 @@ class GenerationEngine:
                         self._chain_hits[chain] = depth
                         depth_obs.append(depth)
                         continue
+                    # Device miss: probe the host tier (probe order
+                    # device -> host tier -> re-prefill).  Chunked
+                    # plans only accept host hits inside the whole-
+                    # chunk hit prefix — exactly where a device hit
+                    # would be accepted — because a dispatching chunk
+                    # rewrites EVERY block it covers and a fault-back-
+                    # registered block may already be shared.
+                    if (self.kv_tier is not None and not force_miss
+                            and (max_hit_blocks is None
+                                 or c < max_hit_blocks)):
+                        shared = self._faultback_by_chain.get(chain)
+                        if shared is not None:
+                            # Single-flight: a pending (undrained)
+                            # fault-back already targets this chain —
+                            # ride its block instead of reading the
+                            # tier twice.
+                            self._ref_block_locked(shared)
+                            self._tables[slot, c] = shared
+                            taken.append(shared)
+                            dest.append(-1)
+                            plan_host_hits += 1
+                            host_faults.append((chain, shared, False))
+                            continue
+                        if self.kv_tier.begin_fault(chain):
+                            host_chain = chain
                 blk = self._alloc_block_locked()
+                if blk is None and host_chain is not None:
+                    self.kv_tier.end_fault(host_chain)
                 if blk is None:
                     # Roll back: this request waits for freed blocks.
                     # Deregister THIS plan's fresh registrations
@@ -1255,6 +1606,14 @@ class GenerationEngine:
                     self._count_invalidations_locked(dropped)
                     for b in taken:
                         self._unref_block_locked(b)
+                    # Release this plan's host-tier claims: primaries
+                    # unpin their tier entries (eviction may take them
+                    # again) and unpublish the coalescing point; the
+                    # replan re-probes the tier from scratch.
+                    for ch, _b, primary in host_faults:
+                        if primary:
+                            self.kv_tier.end_fault(ch)
+                            self._faultback_by_chain.pop(ch, None)
                     # Rewind the reuse-depth census: the replan will
                     # re-probe these chains and count them again.
                     for ch in hit_chains:
@@ -1265,12 +1624,26 @@ class GenerationEngine:
                             else:
                                 self._chain_hits[ch] = d - 1
                     self._tables[slot, :] = -1
-                    self._flush_lookup_counters(req, None, plan_hits,
-                                                plan_misses, depth_obs)
+                    self._flush_lookup_counters(
+                        req, None, plan_hits, plan_misses, depth_obs,
+                        plan_host_hits=plan_host_hits)
                     return None
                 self._ref_block_locked(blk)
                 self._tables[slot, c] = blk
                 taken.append(blk)
+                if host_chain is not None:
+                    # Fault-back: the host tier holds this chain's
+                    # k/v.  The drain (enqueue executor, FIFO-before
+                    # any dispatch that could read the block) inserts
+                    # it into `blk`; the plan treats the block as a
+                    # hit — dest -1 drops the prefill's own write, and
+                    # an all-hit chunk skips its dispatch outright
+                    # (the compute saving fault-back exists for).
+                    dest.append(-1)
+                    plan_host_hits += 1
+                    host_faults.append((host_chain, blk, True))
+                    self._faultback_by_chain[host_chain] = blk
+                    continue
                 dest.append(blk)
                 if c < full:
                     plan_misses += 1
@@ -1294,14 +1667,25 @@ class GenerationEngine:
                         fresh_regs.append((chain, blk))
             if chunk_regs is None:
                 self._plan_regs[slot] = fresh_regs
+            if host_faults:
+                # Claimed under the lock; the caller MUST drain these
+                # (one tier read + one pool insert dispatch on the
+                # enqueue executor) before any dispatch of this plan
+                # can read the blocks, and roll the whole plan back if
+                # the drain fails.
+                for ch, b, primary in host_faults:
+                    self._faultback_pending.append((ch, b, req,
+                                                    primary))
         self._flush_lookup_counters(req, dest, plan_hits, plan_misses,
-                                    depth_obs)
+                                    depth_obs,
+                                    plan_host_hits=plan_host_hits)
         return dest
 
     def _flush_lookup_counters(self, req: _Request,
                                dest: Optional[List[int]],
                                plan_hits: int, plan_misses: int,
-                               depth_obs: List[int]) -> None:
+                               depth_obs: List[int],
+                               plan_host_hits: int = 0) -> None:
         """Flush one plan's lookup accounting to the registry twins
         (one family resolve per plan, outside the per-block loop) and,
         on a successful plan, fold the cache economics into the
@@ -1312,6 +1696,14 @@ class GenerationEngine:
             fam = obs.generator_prefix_reuse_depth_hits()
             for depth in depth_obs:
                 fam.labels(model=self.name).observe(depth)
+        if plan_host_hits:
+            # Device miss answered by the host tier: counted as its
+            # own lookup outcome (token-saved attribution waits for
+            # the fault-back to actually COMMIT on the drain — a
+            # chaos-failed fault-back re-prefills and saves nothing).
+            obs.generator_prefix_lookups_total().labels(
+                model=self.name, outcome="host_hit").inc(
+                    plan_host_hits)
         if plan_misses:
             obs.generator_prefix_lookups_total().labels(
                 model=self.name, outcome="miss").inc(plan_misses)
@@ -1542,6 +1934,16 @@ class GenerationEngine:
                                         force_miss=force_miss)
         if dest is None:
             return False
+        if (self.kv_tier is not None and self._faultback_pending
+                and not await loop.run_in_executor(
+                    self._enqueue_executor, self._drain_faultbacks)):
+            # Transactional fault-back failure: nothing dispatched —
+            # release this plan's blocks (its fresh registrations were
+            # deferred into chunk_regs and never published) and leave
+            # the request pending.  The immediate replan misses the
+            # tier (failed chains dropped) and re-prefills.
+            self._schedule_block_release(slot)
+            return True
         self._pending.popleft()
         n = int(req.prompt_ids.size)
         act = _Active(req=req, length=n, last_token=-1, generated=0,
@@ -1638,6 +2040,10 @@ class GenerationEngine:
         the final chunk scatter the sampled first token into the
         device feed arrays — the very next wave decodes this slot
         without any host round trip."""
+        # The admission plan that produced this chunk (or a concurrent
+        # one) may have evicted spill-pending blocks this chunk's
+        # writes will rewrite: gather first.
+        self._drain_spills()
         jnp = self._jnp
         req = act.req
         C = self.prefill_chunk_tokens
@@ -1772,6 +2178,8 @@ class GenerationEngine:
             "blocks_held": req.blocks_held,
             "cache_hit_blocks": req.cache_hit_blocks,
             "cache_saved_tokens": req.cache_saved_tokens,
+            "host_tier_hit_blocks": req.host_tier_hit_blocks,
+            "host_tier_saved_tokens": req.host_tier_saved_tokens,
         })
 
     def _expire_deadlines(self) -> None:
@@ -1824,6 +2232,24 @@ class GenerationEngine:
                     self._take_prefill_group(force_miss=force_miss)
                 if not group:
                     break  # paged pool pressure: wait for frees
+                if (self.kv_tier is not None
+                        and self._faultback_pending
+                        and not await loop.run_in_executor(
+                            self._enqueue_executor,
+                            self._drain_faultbacks)):
+                    # Transactional fault-back failure (the
+                    # `engine.kv_faultback` chaos site, or entries
+                    # evicted between probe and read): nothing was
+                    # dispatched — roll the whole group's plans back
+                    # and re-queue the requests at the front.  Their
+                    # replans MISS the tier (the failed chains were
+                    # dropped) and fall through to plain re-prefill.
+                    for req, slot in zip(group, slots):
+                        self._deregister_plan(slot)
+                        self._schedule_block_release(slot)
+                    for req in reversed(group):
+                        self._pending.appendleft(req)
+                    continue
                 try:
                     firsts_h, lp_h = await loop.run_in_executor(
                         self._enqueue_executor,
@@ -2207,6 +2633,9 @@ class GenerationEngine:
         dispatch).  Consumes the device-resident caches + feed arrays
         and replaces them with the wave's output handles."""
         jnp = self._jnp
+        # Slot growth for this wave may have evicted spill-pending
+        # blocks the wave's decode writes will rewrite: gather first.
+        self._drain_spills()
         self._note_program("decode", self.max_slots,
                            self.steps_per_call)
         temps, top_ks, top_ps, seeds, want_lp = self._sampling_arrays()
@@ -2262,6 +2691,9 @@ class GenerationEngine:
         full prefill dispatch.  The batch pads to a pow2 row bucket so
         compile count stays bounded; padding rows carry an
         out-of-bounds slot sentinel the scatters drop."""
+        # This group's plans may have evicted spill-pending blocks the
+        # insert below will rewrite: gather first.
+        self._drain_spills()
         jnp = self._jnp
         b = len(group)
         b_bucket = 1

@@ -47,6 +47,22 @@ def device_hbm_in_use(device=None) -> Optional[int]:
     return None
 
 
+def host_memory_bytes() -> int:
+    """Available HOST memory (bytes), 0 when unknowable.  The host-
+    side twin of `device_hbm_bytes`: this ledger budgets the device;
+    the kv tier (engine/kv_tier.py) budgets its spill file against
+    what the host can give without swapping the serving process —
+    same admission discipline, one level down."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return 0
+
+
 class InsufficientHBM(Exception):
     """No room for an admission.  `permanent` distinguishes "can
     NEVER fit" (bigger than the whole budget) from the transient

@@ -1,0 +1,407 @@
+"""Host-memory KV tier: capacity-evicted prefix blocks spill here and
+fault back with one device_put-shaped insert on the next turn.
+
+The paged pool's capacity evictions (generator.py `_alloc_block_locked`)
+used to DROP the LRU cached prefix block — a returning multi-turn
+conversation then pays a full re-prefill for context the device computed
+seconds ago.  This tier keeps that state one level down: evicted blocks'
+k/v land in a page-aligned host mmap keyed by the chain digest the
+prefix index already computes, and the admission plan probes
+device index → host tier → re-prefill.  A warm host fault is one mmap
+read + one jitted pool insert (milliseconds) versus a multi-second
+re-prefill of a long history.
+
+Robustness contract (the point of this module, per ISSUE 16):
+
+- **Transactional spill**: the in-memory index entry publishes only
+  AFTER the slot's full payload is written — a half-spilled chain can
+  never be read; a failed spill leaves the tier exactly as it was and
+  the eviction degrades to the drop-on-evict baseline.
+- **Transactional fault-back**: `begin_fault`/`end_fault` bracket a
+  read; a failed fault-back drops the (now-suspect) entry so the
+  replanned admission misses the tier and falls through to a normal
+  re-prefill.
+- **Bounded LRU ledger with admission-aware eviction**: the tier holds
+  at most `capacity_blocks` entries; admission of a new spill evicts
+  the LRU entry but never one mid-fault-in (the `engine/hbm.py`
+  victim_ok discipline, host-side), and the whole file is clamped
+  against the host's available memory (`hbm.host_memory_bytes`).
+- **Single-flight fault-in**: `begin_fault` refcounts in-flight chains;
+  concurrent returning turns coalesce on the same physical read
+  (counted as outcome=coalesced).
+- **Observable**: occupancy/spill/fault registry families, a `debug()`
+  block federated under `/debug/cache`, and a flight-recorder pin when
+  fault-backs storm (`KFS_KV_TIER_STORM_*` — a storm means the device
+  pool is churning conversations through the tier faster than they
+  finish, the thrash evidence an operator needs pinned).
+
+Storage follows PR 7's param-cache mmap discipline: page-aligned slot
+stride, one preallocated file, read-only consumers never see torn
+writes (publication is the in-memory index, which dies with the
+process — the file carries no cross-restart authority).
+
+Threading: `put()` runs on the engine's fetch executor, `read()` on the
+enqueue executor, `contains`/`begin_fault` on the scheduler loop — all
+state is guarded by one lock, and every payload copy in or out of the
+mmap happens under it (slots are small: one block's k/v).  Nothing here
+ever runs ON the scheduler loop thread except dict probes.
+"""
+
+import logging
+import mmap
+import os
+import tempfile
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Dict, Optional
+
+from kfserving_tpu.observability import metrics as obs
+
+logger = logging.getLogger(__name__)
+
+# Page alignment for slot strides (PR 7's param_cache discipline): the
+# kernel faults whole pages, so a slot straddling page boundaries costs
+# an extra fault per read for no layout benefit.
+_ALIGN = 4096
+
+# Never let the spill file claim more than this fraction of the host's
+# available memory — the tier is a cache under the serving process, not
+# a tenant that evicts it.
+_HOST_MEM_FRACTION = 0.5
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(float(os.environ.get(name, default)))
+    except (TypeError, ValueError):
+        return default
+
+
+class HostKVTier:
+    """Bounded host-memory ledger of spilled KV blocks, chain-keyed.
+
+    `block_bytes` is the exact payload size of one block's k/v across
+    all layers; `capacity_blocks` bounds the ledger (clamped against
+    available host memory).  The tier never touches device state — the
+    engine owns gather/insert dispatches; this class owns bytes,
+    the LRU index, and the telemetry.
+    """
+
+    def __init__(self, *, block_bytes: int, capacity_blocks: int,
+                 directory: Optional[str] = None,
+                 model: str = "decoder"):
+        if block_bytes <= 0:
+            raise ValueError("block_bytes must be positive")
+        if capacity_blocks <= 0:
+            raise ValueError("capacity_blocks must be positive")
+        self.model = model
+        self.block_bytes = int(block_bytes)
+        self.slot_bytes = (
+            (self.block_bytes + _ALIGN - 1) // _ALIGN * _ALIGN)
+        # hbm.py ledger interplay: the device ledger budgets HBM, this
+        # one budgets host RAM — clamp the file against what the host
+        # can actually give without swapping the serving process out.
+        from kfserving_tpu.engine.hbm import host_memory_bytes
+
+        avail = host_memory_bytes()
+        capacity_blocks = int(capacity_blocks)
+        if avail > 0:
+            max_blocks = int(avail * _HOST_MEM_FRACTION
+                             // self.slot_bytes)
+            if 0 < max_blocks < capacity_blocks:
+                logger.warning(
+                    "kv tier capacity clamped %d -> %d blocks "
+                    "(host memory available: %.1f GiB)",
+                    capacity_blocks, max_blocks, avail / 1024**3)
+                capacity_blocks = max_blocks
+        self.capacity_blocks = max(1, capacity_blocks)
+
+        self._owns_dir = directory is None
+        directory = directory or tempfile.mkdtemp(
+            prefix=f"kfs-kvtier-{model}-")
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "kv_tier.bin")
+        size = self.capacity_blocks * self.slot_bytes
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o600)
+        try:
+            os.ftruncate(fd, size)  # sparse until slots are written
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+
+        self._lock = threading.Lock()
+        self._index: "OrderedDict[bytes, int]" = OrderedDict()
+        self._free: deque = deque(range(self.capacity_blocks))
+        # chain -> in-flight fault-back refcount: eviction never
+        # victimizes these (admission-aware), and a second concurrent
+        # fault on the same chain is counted as coalesced.
+        self._inflight: Dict[bytes, int] = {}
+        self._closed = False
+
+        # -- counters (ints under the lock; registry twins emitted at
+        # the event site) ----------------------------------------------
+        self.spills = 0
+        self.spill_failures = 0
+        self.spill_duplicates = 0
+        self.faults = 0            # physically read-back blocks
+        self.coalesced = 0         # riders on an in-flight fault
+        self.fault_failures = 0
+        self.evictions = 0         # LRU capacity evictions
+        self.eviction_skips = 0    # vetoed: victim mid-fault-in
+        self.dropped = 0           # entries dropped after a failed
+        #                            fault-back (presumed unusable)
+        self._fault_ms: deque = deque(maxlen=512)
+
+        # -- fault-back storm detection (flight-recorder pin) ----------
+        self.storm_window_s = float(os.environ.get(
+            "KFS_KV_TIER_STORM_WINDOW_S", "10"))
+        self.storm_threshold = _env_int(
+            "KFS_KV_TIER_STORM_THRESHOLD", 32)
+        self._fault_times: deque = deque(maxlen=1024)
+        self._storm_pinned_at = 0.0
+        self._flight_recorder = None
+
+    # -- wiring ------------------------------------------------------------
+    def attach_flight_recorder(self, recorder) -> None:
+        """Point storm pins at a server's flight recorder (app.py
+        attaches its monitoring recorder at start)."""
+        self._flight_recorder = recorder
+
+    # -- probes (scheduler-loop safe: dict lookups only) -------------------
+    def contains(self, chain: bytes) -> bool:
+        with self._lock:
+            return chain in self._index
+
+    def begin_fault(self, chain: bytes) -> bool:
+        """Mark `chain` in-flight for fault-back (single-flight
+        bracket).  Returns False when the tier no longer holds it —
+        the caller falls through to re-prefill.  While in-flight the
+        entry cannot be evicted by a concurrent spill admission."""
+        with self._lock:
+            if chain not in self._index:
+                return False
+            self._inflight[chain] = self._inflight.get(chain, 0) + 1
+            return True
+
+    def note_coalesced(self, blocks: int = 1) -> None:
+        with self._lock:
+            self.coalesced += blocks
+        obs.generator_kv_tier_faultbacks_total().labels(
+            model=self.model, outcome="coalesced").inc(blocks)
+
+    def end_fault(self, chain: bytes) -> None:
+        with self._lock:
+            n = self._inflight.get(chain, 0) - 1
+            if n <= 0:
+                self._inflight.pop(chain, None)
+            else:
+                self._inflight[chain] = n
+
+    # -- spill (fetch-executor thread) -------------------------------------
+    def put(self, chain: bytes, payload: bytes) -> bool:
+        """Admit one block's payload.  Transactional: the index entry
+        publishes only after the slot holds the complete payload, so a
+        failure at any point leaves the tier without the chain (the
+        eviction that produced it degrades to a plain drop).  Returns
+        False on failure; never raises."""
+        try:
+            if len(payload) != self.block_bytes:
+                raise ValueError(
+                    f"payload {len(payload)}B != block {self.block_bytes}B")
+            with self._lock:
+                if self._closed:
+                    return False
+                if chain in self._index:
+                    # Already safe (a fault-back re-registered the
+                    # chain on device and it was re-evicted before
+                    # this late spill resolved).
+                    self.spill_duplicates += 1
+                    obs.generator_kv_tier_spills_total().labels(
+                        model=self.model, outcome="duplicate").inc()
+                    return True
+                slot = self._reserve_slot_locked()
+                if slot is None:
+                    raise RuntimeError(
+                        "kv tier full: every entry is mid-fault-in")
+                off = slot * self.slot_bytes
+                self._mm[off:off + self.block_bytes] = payload
+                # Publication point: a reader can only find the chain
+                # AFTER the full payload landed.
+                self._index[chain] = slot
+                self._index.move_to_end(chain)
+                self.spills += 1
+            obs.generator_kv_tier_spills_total().labels(
+                model=self.model, outcome="spilled").inc()
+            self._publish_occupancy()
+            return True
+        except Exception:
+            logger.exception("kv tier spill failed (%s)", self.model)
+            with self._lock:
+                self.spill_failures += 1
+            obs.generator_kv_tier_spills_total().labels(
+                model=self.model, outcome="failed").inc()
+            return False
+
+    def note_spill_failure(self, blocks: int = 1) -> None:
+        """Spills aborted before ever reaching put() — e.g. the
+        `engine.kv_spill` chaos site firing on the gather fetch.  The
+        evictions degrade to plain drops; this keeps the tier's
+        attempt accounting honest about it."""
+        with self._lock:
+            self.spill_failures += blocks
+        obs.generator_kv_tier_spills_total().labels(
+            model=self.model, outcome="failed").inc(blocks)
+
+    def _reserve_slot_locked(self) -> Optional[int]:
+        if self._free:
+            return self._free.popleft()
+        # LRU eviction, admission-aware: never victimize an entry a
+        # fault-back is reading right now (hbm.py's victim_ok veto,
+        # host-side) — skip it and take the next-oldest.
+        for chain in self._index:
+            if chain in self._inflight:
+                self.eviction_skips += 1
+                obs.generator_kv_tier_evictions_total().labels(
+                    model=self.model, reason="skipped_inflight").inc()
+                continue
+            slot = self._index.pop(chain)
+            self.evictions += 1
+            obs.generator_kv_tier_evictions_total().labels(
+                model=self.model, reason="capacity").inc()
+            return slot
+        return None
+
+    # -- fault-back (enqueue-executor thread) ------------------------------
+    def read(self, chain: bytes) -> bytes:
+        """One block's payload (a bytes copy — the mmap slot can be
+        recycled by a concurrent spill the moment the lock drops).
+        Raises KeyError when the chain is gone (evicted between the
+        plan's probe and this read) — the caller's fault-back fails
+        transactionally and the turn re-prefills."""
+        with self._lock:
+            slot = self._index.get(chain)
+            if slot is None:
+                raise KeyError(chain.hex())
+            off = slot * self.slot_bytes
+            payload = bytes(self._mm[off:off + self.block_bytes])
+            self._index.move_to_end(chain)
+        return payload
+
+    def note_faultback(self, blocks: int, elapsed_ms: float) -> None:
+        """Account one successful fault-back batch: `blocks` physical
+        reads landed on device in `elapsed_ms`."""
+        with self._lock:
+            self.faults += blocks
+            self._fault_ms.append(elapsed_ms)
+        obs.generator_kv_tier_faultbacks_total().labels(
+            model=self.model, outcome="faulted").inc(blocks)
+        obs.generator_kv_tier_faultback_ms().labels(
+            model=self.model).observe(elapsed_ms)
+        self._note_storm(blocks)
+
+    def note_fault_failure(self, blocks: int = 1) -> None:
+        with self._lock:
+            self.fault_failures += blocks
+        obs.generator_kv_tier_faultbacks_total().labels(
+            model=self.model, outcome="failed").inc(blocks)
+
+    def drop(self, chain: bytes) -> None:
+        """Remove an entry (failed fault-back: the payload is suspect
+        — the replanned turn must MISS the tier and re-prefill)."""
+        with self._lock:
+            slot = self._index.pop(chain, None)
+            if slot is None:
+                return
+            self._free.append(slot)
+            self.dropped += 1
+        obs.generator_kv_tier_evictions_total().labels(
+            model=self.model, reason="faultback_failed").inc()
+        self._publish_occupancy()
+
+    # -- storm pin ---------------------------------------------------------
+    def _note_storm(self, blocks: int) -> None:
+        now = time.monotonic()
+        for _ in range(blocks):
+            self._fault_times.append(now)
+        recent = sum(1 for t in self._fault_times
+                     if now - t <= self.storm_window_s)
+        if recent <= self.storm_threshold:
+            return
+        recorder = self._flight_recorder
+        # One pin per storm window, not one per fault in it.
+        if recorder is None or \
+                now - self._storm_pinned_at < self.storm_window_s:
+            return
+        self._storm_pinned_at = now
+        recorder.record({
+            "kind": "kv_tier_faultback_storm",
+            "model": self.model,
+            "faults_in_window": recent,
+            "window_s": self.storm_window_s,
+            "host_tier": self.debug(),
+        }, pin="kv_faultback_storm")
+        logger.warning(
+            "kv tier fault-back storm: %d blocks in %.0fs (device "
+            "pool churns conversations through the host tier — "
+            "flight-recorder entry pinned)",
+            recent, self.storm_window_s)
+
+    # -- introspection -----------------------------------------------------
+    def _publish_occupancy(self) -> None:
+        with self._lock:
+            used = len(self._index)
+        obs.generator_kv_tier_blocks().labels(
+            model=self.model).set(float(used))
+        obs.generator_kv_tier_occupancy_ratio().labels(
+            model=self.model).set(
+                min(1.0, used / max(1, self.capacity_blocks)))
+
+    def debug(self) -> Dict[str, Any]:
+        """The `host_tier` block of `/debug/cache`, federated by the
+        router under the `replica` label."""
+        with self._lock:
+            samples = sorted(self._fault_ms)
+
+            def pct(q: float) -> float:
+                if not samples:
+                    return 0.0
+                return round(samples[min(len(samples) - 1,
+                                         int(len(samples) * q))], 3)
+
+            return {
+                "capacity_blocks": self.capacity_blocks,
+                "used_blocks": len(self._index),
+                "block_bytes": self.block_bytes,
+                "slot_bytes": self.slot_bytes,
+                "file_bytes": self.capacity_blocks * self.slot_bytes,
+                "inflight_faults": len(self._inflight),
+                "spills": self.spills,
+                "spill_failures": self.spill_failures,
+                "spill_duplicates": self.spill_duplicates,
+                "faulted_blocks": self.faults,
+                "coalesced_blocks": self.coalesced,
+                "fault_failures": self.fault_failures,
+                "evictions": self.evictions,
+                "eviction_skips": self.eviction_skips,
+                "dropped": self.dropped,
+                "faultback_ms": {"p50": pct(0.50), "p99": pct(0.99)},
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._index.clear()
+            self._inflight.clear()
+            try:
+                self._mm.close()
+            except Exception:
+                pass
+        try:
+            os.unlink(self.path)
+            if self._owns_dir:
+                os.rmdir(os.path.dirname(self.path))
+        except OSError:
+            pass

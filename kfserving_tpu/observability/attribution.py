@@ -85,6 +85,14 @@ def observe(model: str, trace_id: Optional[str],
         if isinstance(saved, (int, float)):
             obs.request_cache_saved_tokens().labels(
                 model=model).observe(float(saved))
+        # Distinct from cache_saved_tokens (device prefix hits): these
+        # prompt tokens were recovered from the HOST tier by a
+        # fault-back — additive, never double-counted (a block is
+        # either a device hit or a host fault, per plan).
+        host_saved = record.get("host_tier_saved_tokens")
+        if isinstance(host_saved, (int, float)):
+            obs.request_host_tier_saved_tokens().labels(
+                model=model).observe(float(host_saved))
         if trace_id:
             with _lock:
                 _records[trace_id] = record

@@ -140,8 +140,10 @@ def generator_prefix_lookups_total():
         "kfserving_tpu_generator_prefix_lookups_total",
         "Chain-hash prefix-index probes per full prompt block at plan "
         "time, by outcome (hit = the block's k/v were already "
-        "resident and the plan points at the shared block; miss = a "
-        "fresh block was allocated) — the replica-side feed "
+        "device-resident and the plan points at the shared block; "
+        "host_hit = a device miss answered by the host KV tier, the "
+        "block faults back instead of re-prefilling; miss = a fresh "
+        "block was allocated) — the replica-side feed "
         "prefix-affinity routing reads through /metrics federation")
 
 
@@ -158,15 +160,85 @@ def generator_prefill_tokens_saved_total():
 def generator_block_evictions_total():
     return REGISTRY.counter(
         "kfserving_tpu_generator_block_evictions_total",
-        "Pool blocks leaving their role, by cause: capacity = LRU "
-        "reclaim of a zero-ref cached prefix block under allocation "
-        "pressure (its index entry drops with it); index_invalidation "
-        "= provisional prefix registrations dropped because their "
-        "planned writes never dispatched (plan rollback / enqueue "
-        "failure); zombie_deferral = slot blocks released after "
-        "maturing through the zombie-wave deferral window (the "
-        "normal free path, counted so the deferral machinery is "
-        "observable)")
+        "Pool blocks leaving their role, by cause: capacity_spilled "
+        "= LRU reclaim of a zero-ref cached prefix block under "
+        "allocation pressure whose k/v landed in the host KV tier "
+        "(its device index entry drops, the chain survives host-"
+        "side); capacity_dropped = the same reclaim with the state "
+        "lost (no tier, no chain, or a failed spill — the drop-on-"
+        "evict baseline); index_invalidation = provisional prefix "
+        "registrations dropped because their planned writes never "
+        "dispatched (plan rollback / enqueue failure); "
+        "zombie_deferral = slot blocks released after maturing "
+        "through the zombie-wave deferral window (the normal free "
+        "path, counted so the deferral machinery is observable)")
+
+
+# -- host KV tier (engine/kv_tier.py): spilled-conversation residency
+# one level under the device pool — occupancy, spill/fault outcomes,
+# and the latency of faulting a returning turn's blocks back ----------
+def generator_kv_tier_blocks():
+    return REGISTRY.gauge(
+        "kfserving_tpu_generator_kv_tier_blocks",
+        "Blocks currently held by the host KV tier (spilled "
+        "conversation prefixes a returning turn can fault back "
+        "instead of re-prefilling)")
+
+
+def generator_kv_tier_occupancy_ratio():
+    return REGISTRY.gauge(
+        "kfserving_tpu_generator_kv_tier_occupancy_ratio",
+        "Host KV tier occupancy over its capacity (1.0 = the tier's "
+        "own LRU ledger is evicting on every admission)")
+
+
+def generator_kv_tier_spills_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_generator_kv_tier_spills_total",
+        "Capacity-evicted blocks offered to the host tier by "
+        "outcome: spilled = payload landed and the index entry "
+        "published; failed = the spill machinery failed (the "
+        "eviction degraded to the drop-on-evict baseline — "
+        "counted under block_evictions{cause=\"capacity_dropped\"}); "
+        "duplicate = the chain was already host-resident")
+
+
+def generator_kv_tier_faultbacks_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_generator_kv_tier_faultbacks_total",
+        "Host-tier blocks a returning turn's admission plan claimed, "
+        "by outcome: faulted = one physical read + pool insert; "
+        "coalesced = a concurrent plan rode an in-flight fault "
+        "(single-flight); failed = the fault-back failed and the "
+        "turn fell through to a normal re-prefill")
+
+
+def generator_kv_tier_faultback_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_generator_kv_tier_faultback_ms",
+        "Latency of one fault-back batch (mmap read + jitted pool "
+        "insert enqueue) — the milliseconds a returning turn paid "
+        "instead of a full re-prefill")
+
+
+def generator_kv_tier_evictions_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_generator_kv_tier_evictions_total",
+        "Host-tier entries leaving the ledger by reason: capacity = "
+        "LRU eviction admitting a newer spill; skipped_inflight = an "
+        "eviction vetoed because the victim was mid-fault-in "
+        "(admission-aware, the hbm.py victim_ok discipline); "
+        "faultback_failed = entry dropped because its read failed "
+        "(the payload is suspect — the turn re-prefills)")
+
+
+def generator_kv_tier_tokens_saved_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_generator_kv_tier_tokens_saved_total",
+        "Prompt tokens served from the host KV tier instead of "
+        "re-prefilled (host-hit blocks x block_size) — the host-"
+        "side twin of generator_prefill_tokens_saved_total, kept "
+        "distinct so the drop-vs-spill economics stay attributable")
 
 
 def generator_prefix_reuse_depth_hits():
@@ -286,6 +358,17 @@ def request_cache_saved_tokens():
         "kfserving_tpu_request_cache_saved_tokens",
         "Prompt tokens a request did not re-store thanks to prefix-"
         "cache hits (hit blocks x block_size; 0 = fully cold)",
+        buckets=TOKEN_BUCKETS)
+
+
+def request_host_tier_saved_tokens():
+    return REGISTRY.histogram(
+        "kfserving_tpu_request_host_tier_saved_tokens",
+        "Prompt tokens a request served from the host KV tier "
+        "(fault-back) instead of re-prefilling — distinct from "
+        "request_cache_saved_tokens (device prefix hits) so the "
+        "per-request cost record shows WHICH tier earned the "
+        "savings; the two are additive",
         buckets=TOKEN_BUCKETS)
 
 

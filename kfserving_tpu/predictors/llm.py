@@ -45,6 +45,14 @@ config.json schema:
                                    #   decode wave (steps_per_call
                                    #   decode steps).  Must be a
                                    #   multiple of block_size.
+      "host_tier_blocks": 256,     # host KV tier (paged only):
+                                   #   capacity-evicted prefix blocks
+                                   #   spill to a host-RAM mmap tier
+                                   #   of this many blocks and fault
+                                   #   back on the next turn instead
+                                   #   of re-prefilling; 0/absent =
+                                   #   off.  host_tier_dir overrides
+                                   #   the spill-file location.
       "adaptive_depth": true,      # drop to depth-1 when every live
                                    #   stream finishes within the
                                    #   waves already in flight
@@ -357,6 +365,8 @@ class GenerativeConfig:
                  block_size: Optional[int] = None,
                  cache_blocks: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
+                 host_tier_blocks: Optional[int] = None,
+                 host_tier_dir: Optional[str] = None,
                  adaptive_depth: bool = True,
                  mesh: Optional[Dict[str, int]] = None,
                  **_ignored):
@@ -388,6 +398,12 @@ class GenerativeConfig:
         # stops speculative waves that could only decode garbage.
         self.prefill_chunk_tokens = (int(prefill_chunk_tokens)
                                      if prefill_chunk_tokens else None)
+        # Host KV tier (paged only): capacity-evicted prefix blocks
+        # spill to a host-RAM mmap tier of this many blocks instead of
+        # dropping; 0/None = off (KFS_KV_TIER_BLOCKS is the env twin).
+        self.host_tier_blocks = (int(host_tier_blocks)
+                                 if host_tier_blocks else None)
+        self.host_tier_dir = host_tier_dir
         self.adaptive_depth = bool(adaptive_depth)
         self.mesh = mesh or {}
 
@@ -473,6 +489,8 @@ class GenerativeModel(Model):
             block_size=cfg.block_size,
             cache_blocks=cfg.cache_blocks,
             prefill_chunk_tokens=cfg.prefill_chunk_tokens,
+            host_tier_blocks=cfg.host_tier_blocks,
+            host_tier_dir=cfg.host_tier_dir,
             adaptive_depth=cfg.adaptive_depth,
             mesh=mesh, name=self.name)
         if self.hbm is not None:

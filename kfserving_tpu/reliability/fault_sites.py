@@ -70,6 +70,20 @@ SITES: Dict[str, tuple] = {
         "<component>` — an injected error drops the request to "
         "plain round-robin (counted as outcome=fallback), the "
         "blind-spray escape hatch chaos must prove"),
+    "ENGINE_KV_SPILL": (
+        "engine.kv_spill",
+        "GenerationEngine host-tier spill of capacity-evicted KV "
+        "blocks, keyed by engine name — an injected error fails the "
+        "spill BEFORE the tier index publishes, proving the "
+        "eviction degrades to the drop-on-evict baseline (counted "
+        "as cause=capacity_dropped) with bit-exact generation"),
+    "ENGINE_KV_FAULTBACK": (
+        "engine.kv_faultback",
+        "GenerationEngine host-tier fault-back of a returning "
+        "turn's spilled blocks, keyed by engine name — an injected "
+        "error fails the read BEFORE any pool insert dispatches, "
+        "proving the admission plan rolls back and the turn falls "
+        "through to a normal re-prefill with bit-exact generation"),
 }
 
 
@@ -91,3 +105,5 @@ ROUTER_ADMISSION = "router.admission"
 GENERATOR_PREFIX_LOOKUP = "generator.prefix_lookup"
 ENGINE_RESIDENCY_SWAP = "engine.residency_swap"
 ROUTER_AFFINITY_PICK = "router.affinity_pick"
+ENGINE_KV_SPILL = "engine.kv_spill"
+ENGINE_KV_FAULTBACK = "engine.kv_faultback"

@@ -865,6 +865,7 @@ class ModelServer:
         models: Dict[str, Any] = {}
         hbm = None
         residency = None
+        host_tier: Dict[str, Any] = {}
         seen_managers = set()
         res_manager = getattr(self.repository, "residency", None)
         if res_manager is not None:
@@ -885,6 +886,17 @@ class ModelServer:
                 except Exception:
                     logger.exception("cache debug for %s failed",
                                      model.name)
+            tier = getattr(getattr(model, "engine", None),
+                           "kv_tier", None)
+            if tier is not None:
+                try:
+                    # Host KV tier beside the device pool it backs:
+                    # occupancy, spill/fault-back outcomes, fault-back
+                    # latency p50/p99 (ISSUE 16).
+                    host_tier[model.name] = tier.debug()
+                except Exception:
+                    logger.exception("kv tier debug for %s failed",
+                                     model.name)
             manager = getattr(model, "hbm", None)
             if manager is not None and id(manager) not in seen_managers:
                 seen_managers.add(id(manager))
@@ -900,7 +912,8 @@ class ModelServer:
                 except Exception:
                     logger.exception("hbm debug failed")
         return _json({"models": models, "hbm": hbm,
-                      "residency": residency})
+                      "residency": residency,
+                      "host_tier": host_tier or None})
 
     async def _profiler_start(self, req: Request) -> Response:
         from kfserving_tpu.tracing import profiler
@@ -944,6 +957,15 @@ class ModelServer:
         if residency is not None:
             residency.attach_flight_recorder(
                 self.monitoring.flight_recorder)
+        # Host KV tiers pin fault-back storms the same way (the device
+        # pool churning conversations through the tier faster than
+        # they finish is thrash evidence an operator needs pinned).
+        for model in self.repository.get_models():
+            tier = getattr(getattr(model, "engine", None),
+                           "kv_tier", None)
+            if tier is not None:
+                tier.attach_flight_recorder(
+                    self.monitoring.flight_recorder)
         # Device-discipline sanitizer (KFS_SANITIZE=1): violations
         # pin into this server's flight recorder, and the stall
         # watchdog heartbeats the serving loop.  Disabled: two env

@@ -172,9 +172,14 @@ async def smoke() -> List[str]:
         model="metrics-probe", outcome="hit").inc(3)
     obs.generator_prefix_lookups_total().labels(
         model="metrics-probe", outcome="miss").inc()
+    obs.generator_prefix_lookups_total().labels(
+        model="metrics-probe", outcome="host_hit").inc()
     obs.generator_prefill_tokens_saved_total().labels(
         model="metrics-probe").inc(384)
-    for cause in ("capacity", "index_invalidation", "zombie_deferral"):
+    # ISSUE 16: `capacity` split by fate — spilled to the host tier
+    # vs dropped (the baseline / a failed spill).
+    for cause in ("capacity_spilled", "capacity_dropped",
+                  "index_invalidation", "zombie_deferral"):
         obs.generator_block_evictions_total().labels(
             model="metrics-probe", cause=cause).inc()
     obs.generator_prefix_reuse_depth_hits().labels(
@@ -197,6 +202,30 @@ async def smoke() -> List[str]:
         model="metrics-probe").observe(5)
     obs.request_cache_saved_tokens().labels(
         model="metrics-probe").observe(256)
+    # Tiered KV residency families (ISSUE 16): host-tier occupancy,
+    # spill/fault-back outcomes, tier evictions, fault-back latency,
+    # and the per-request host-tier savings histogram (distinct from
+    # the device-cache one just above) — representative samples so
+    # names, label shapes, and unit suffixes always lint.
+    obs.generator_kv_tier_blocks().labels(
+        model="metrics-probe").set(48.0)
+    obs.generator_kv_tier_occupancy_ratio().labels(
+        model="metrics-probe").set(0.75)
+    for outcome in ("spilled", "failed", "duplicate"):
+        obs.generator_kv_tier_spills_total().labels(
+            model="metrics-probe", outcome=outcome).inc()
+    for outcome in ("faulted", "coalesced", "failed"):
+        obs.generator_kv_tier_faultbacks_total().labels(
+            model="metrics-probe", outcome=outcome).inc()
+    obs.generator_kv_tier_faultback_ms().labels(
+        model="metrics-probe").observe(3.2)
+    for reason in ("capacity", "skipped_inflight", "faultback_failed"):
+        obs.generator_kv_tier_evictions_total().labels(
+            model="metrics-probe", reason=reason).inc()
+    obs.generator_kv_tier_tokens_saved_total().labels(
+        model="metrics-probe").inc(512)
+    obs.request_host_tier_saved_tokens().labels(
+        model="metrics-probe").observe(512)
     # Model residency & affinity routing families (ISSUE 15): the
     # residency state/fault-in telemetry, the admission-aware
     # eviction-skip counter, and the router's affinity-pick outcomes —

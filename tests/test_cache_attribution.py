@@ -157,7 +157,8 @@ async def test_eviction_causes_discriminating_sequence(tiny):
         st = await _settle_pool(eng)
         ev = st["evictions"]
         assert ev["zombie_deferral"] == 2, ev
-        assert ev["capacity"] == 0 and ev["index_invalidation"] == 0
+        assert ev["capacity_dropped"] == 0
+        assert ev["index_invalidation"] == 0
         assert st["reclaimable_blocks"] == 1  # the registered block
 
         # Phase 2 — capacity: drain the free list, then one more
@@ -174,7 +175,11 @@ async def test_eviction_causes_discriminating_sequence(tiny):
             assert eng._prefix_index == {}  # entry evicted with it
             eng._free_blocks.extend(held + [victim])
         ev = eng.stats()["paged"]["evictions"]
-        assert ev["capacity"] == 1 and ev["index_invalidation"] == 0
+        # No host tier wired: a capacity eviction IS a drop (the
+        # baseline the ISSUE 16 split makes explicit).
+        assert ev["capacity_dropped"] == 1
+        assert ev["capacity_spilled"] == 0
+        assert ev["index_invalidation"] == 0
 
         # Phase 3 — index_invalidation: a 2-block plan that registers
         # chunk 0 then fails allocation on chunk 1 rolls back and
@@ -191,8 +196,8 @@ async def test_eviction_causes_discriminating_sequence(tiny):
             for b in held:
                 eng._unref_block_locked(b)
         ev = eng.stats()["paged"]["evictions"]
-        assert ev == {"capacity": 1, "index_invalidation": 1,
-                      "zombie_deferral": 2}
+        assert ev == {"capacity_dropped": 1, "capacity_spilled": 0,
+                      "index_invalidation": 1, "zombie_deferral": 2}
         # Registry twins agree cause-for-cause.
         for cause, want in ev.items():
             assert _counter_value(
