@@ -169,6 +169,9 @@ def _compact_configs(results: dict) -> dict:
                 "ttft_p50_ms")
             c["host_tier_tokens_saved"] = (r.get("tier") or {}).get(
                 "tokens_saved_total")
+        elif name == "history":
+            c.update(pick(r, "overhead_pct", "stress_overhead_pct",
+                          "within_budget", "live_series"))
         elif name == "generate_stream_wire":
             c["grpc_over_sse"] = r.get("grpc_over_sse")
             c["grpc_tokens_per_s"] = (r.get("grpc") or {}).get(
@@ -227,6 +230,7 @@ def main():
         "generate_stream_wire": C.bench_generate_stream_wire,
         "cache": C.bench_cache,
         "kvtier": C.bench_kvtier,
+        "history": C.bench_history,
     }
     results = {}
     for name, fn in matrix.items():

@@ -2737,3 +2737,155 @@ async def bench_kvtier(smoke: bool) -> Dict[str, Any]:
         return out
     finally:
         await server.stop_async()
+
+
+async def bench_history(smoke: bool) -> Dict[str, Any]:
+    """History sampler overhead A/B (ISSUE 17 acceptance): serving
+    throughput on the same live server with the ring-TSDB sampler
+    ticking vs stopped.
+
+    A sub-0.1% effect cannot be resolved through scheduler/GC noise
+    directly, so the bench amplifies it: the on-arm ticks at 20x the
+    default rate (tick_s=0.05), the interleaved A/B measures the
+    amplified delta, and the committed per-default-tick overhead is
+    that delta / 20.  Noise discipline: many short alternating
+    segments (drift spans both arms), the lead arm flips every pair
+    (second-segment warmth cancels), gc.collect() + gc.disable()
+    around each segment (collections land between, not inside,
+    segments), identical idle gaps in both arms (the first request
+    after an idle pause is ~10x the steady-state cost and must not
+    bill to one arm), and the estimator is the median of per-pair
+    process-CPU deltas (immune to external CPU contention — client
+    and server share this process).  Evidence committed to
+    BENCH_history.json, including the deterministic cross-check:
+    mean tick wall-time x tick rate."""
+    import gc
+
+    import aiohttp
+
+    from kfserving_tpu.model.model import Model
+    from kfserving_tpu.observability.registry import REGISTRY
+
+    class _Echo(Model):
+        def load(self):
+            self.ready = True
+            return True
+
+        async def predict(self, request):
+            return {"predictions": [1]}
+
+    seg_req = 600 if smoke else 1500  # requests per segment
+    pairs = 24                        # alternating on/off segment pairs
+    amplification = 20.0              # on-arm tick rate vs default
+    tick_s = str(1.0 / amplification)
+    prev_tick = os.environ.get("KFS_HISTORY_TICK_S")
+    os.environ["KFS_HISTORY_TICK_S"] = tick_s
+    try:
+        model = _Echo("histbench")
+        model.load()
+        server = await _serve([model])
+    finally:
+        if prev_tick is None:
+            os.environ.pop("KFS_HISTORY_TICK_S", None)
+        else:
+            os.environ["KFS_HISTORY_TICK_S"] = prev_tick
+    url = (f"http://127.0.0.1:{server.http_port}"
+           f"/v1/models/histbench:predict")
+    payload = {"instances": [[1.0]]}
+    try:
+        async with aiohttp.ClientSession() as session:
+
+            async def measure(n: int):
+                """(wall seconds, process-CPU seconds) for n
+                closed-loop requests, GC parked outside the segment."""
+                gc.collect()
+                gc.disable()
+                try:
+                    w0 = time.perf_counter()
+                    c0 = time.process_time()
+                    for _ in range(n):
+                        async with session.post(url,
+                                                json=payload) as r:
+                            await r.read()
+                            assert r.status == 200
+                    return (time.perf_counter() - w0,
+                            time.process_time() - c0)
+                finally:
+                    # kfslint: disable=async-blocking — stdlib
+                    # gc.enable() (name-collides with the
+                    # compile_cache.enable helper); it only flips a
+                    # flag, nothing blocks.
+                    gc.enable()
+
+            await measure(2 * seg_req)  # warmup, discarded
+            arms = {"history_on": [], "history_off": []}
+            deltas_cpu, deltas_wall = [], []
+            for pair in range(pairs):
+                order = (("history_on", "history_off")
+                         if pair % 2 == 0
+                         else ("history_off", "history_on"))
+                seg = {}
+                for arm in order:
+                    if arm == "history_on":
+                        await server.history.start()
+                    else:
+                        await server.history.stop()
+                    await asyncio.sleep(0.06)  # identical in both arms
+                    seg[arm] = await measure(seg_req)
+                    arms[arm].append(seg_req / seg[arm][0])
+                await server.history.stop()
+                on, off = seg["history_on"], seg["history_off"]
+                deltas_wall.append((on[0] - off[0]) / off[0] * 100.0)
+                deltas_cpu.append((on[1] - off[1]) / off[1] * 100.0)
+        deltas_cpu.sort()
+        deltas_wall.sort()
+        stress_pct = deltas_cpu[len(deltas_cpu) // 2]
+        overhead_pct = stress_pct / amplification
+        med = {arm: sorted(v)[len(v) // 2] for arm, v in arms.items()}
+        tick_hist = None
+        fam = REGISTRY.family("kfserving_tpu_history_tick_ms")
+        if fam is not None:
+            for _, child in fam.samples():
+                if child.total:
+                    mean_ms = child.sum / child.total
+                    tick_hist = {
+                        "ticks": child.total,
+                        "mean_ms": round(mean_ms, 4),
+                        # Deterministic cross-check: the fraction of
+                        # wall time the tick body consumes at the
+                        # DEFAULT 1 s tick.
+                        "direct_overhead_pct_at_default_tick": round(
+                            mean_ms / 1000.0 * 100.0, 4)}
+        out = {
+            "scenario": "history_sampler_overhead_ab",
+            "smoke": smoke,
+            "stress_tick_s": float(tick_s),
+            "amplification": amplification,
+            "requests_per_segment": seg_req,
+            "segment_pairs": pairs,
+            "history_on": {
+                "median_segment_req_per_s": round(
+                    med["history_on"], 1)},
+            "history_off": {
+                "median_segment_req_per_s": round(
+                    med["history_off"], 1)},
+            "stress_overhead_pct": round(stress_pct, 3),
+            "stress_overhead_wall_pct": round(
+                deltas_wall[len(deltas_wall) // 2], 3),
+            # The committed headline: the stress delta scaled back to
+            # the shipping 1 s tick.
+            "overhead_pct": round(overhead_pct, 4),
+            "within_budget": overhead_pct < 1.0,
+            "live_series": server.history.store.series_count(),
+            "tick": tick_hist,
+        }
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        # kfslint: disable=async-blocking — evidence commit after the
+        # measured waves; the server is torn down below.
+        with open(os.path.join(root, "BENCH_history.json"), "w") as f:
+            # kfslint: disable=async-blocking — same write as above.
+            json.dump(out, f, indent=2)
+        return out
+    finally:
+        await server.stop_async()

@@ -10,6 +10,8 @@
     python -m kfserving_tpu.client rollouts
     python -m kfserving_tpu.client profile --window 60 -o trace.json
     python -m kfserving_tpu.client cache [--replica HOST] [--top-k N]
+    python -m kfserving_tpu.client history [SERIES] [--window S] \
+        [--replica HOST]
 
 The reference splits this between kubectl (CRDs) and the SDK; the TPU
 build ships one client for both planes.
@@ -88,6 +90,26 @@ p_cache.add_argument("--replica", default=None,
 p_cache.add_argument("--top-k", type=int, default=None,
                      help="hot chains per model (default 10)")
 
+p_history = sub.add_parser(
+    "history",
+    help="telemetry history (ring-TSDB frames) rendered as one "
+         "sparkline per fleet series")
+p_history.add_argument("series", nargs="?",
+                       help="family name (e.g. kfserving_tpu_"
+                            "request_latency_ms_p99); omit for every "
+                            "live series")
+p_history.add_argument("--labels", default=None,
+                       help="label filter, k=v[,k2=v2...]")
+p_history.add_argument("--window", type=float, default=None,
+                       help="lookback seconds (default 600)")
+p_history.add_argument("--step", type=float, default=None,
+                       help="alignment grid seconds (default 1)")
+p_history.add_argument("--replica", default=None,
+                       help="narrow to one replica host:port")
+p_history.add_argument("--json", action="store_true",
+                       help="raw federated frames instead of "
+                            "sparklines")
+
 p_creds = sub.add_parser(
     "credentials",
     help="register storage credentials (reference set_credentials)")
@@ -130,6 +152,55 @@ async def _payload_async(args) -> dict:
     -f -` reads stdin, which can block indefinitely on a pipe."""
     return await asyncio.get_running_loop().run_in_executor(
         None, _payload, args)
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values) -> str:
+    """One unicode block character per frame, scaled to the series'
+    own min..max (a flat series renders as a flat floor line)."""
+    if not values:
+        return ""
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _SPARK[0] * len(values)
+    span = hi - lo
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / span * len(_SPARK)))]
+        for v in values)
+
+
+def _render_history(body: dict) -> str:
+    """The fleet rollup as text: one header + sparkline per series.
+
+    Accepts both wire shapes: the router's federation (`replicas` +
+    `fleet`) and a single replica's flat `series` list (pointing
+    --ingress-url straight at a model server works too)."""
+    lines = []
+    if "series" in body and "fleet" not in body:
+        lines.append("replicas: (single replica)")
+        fleet = body.get("series") or []
+    else:
+        replicas = sorted((body.get("replicas") or {}).keys())
+        lines.append(f"replicas: {', '.join(replicas) or '(none)'}")
+        fleet = body.get("fleet") or []
+    if not fleet:
+        lines.append("(no series matched)")
+    for s in fleet:
+        values = [f[1] for f in (s.get("frames") or [])]
+        label = ",".join(f"{k}={v}" for k, v in
+                         sorted((s.get("labels") or {}).items()))
+        name = s.get("name", "")
+        head = f"{name}{{{label}}}" if label else name
+        if values:
+            head += (f"  [{s.get('kind')}] last={values[-1]:.4g} "
+                     f"min={min(values):.4g} max={max(values):.4g} "
+                     f"n={len(values)}")
+        lines.append(head)
+        lines.append("  " + (_sparkline(values) or "(no frames)"))
+    return "\n".join(lines)
 
 
 def _read_json(path: str) -> dict:
@@ -176,6 +247,25 @@ async def _run(args) -> dict:
         if args.command == "cache":
             return await c.cache(replica=args.replica,
                                  top_k=args.top_k)
+        if args.command == "history":
+            labels = None
+            if args.labels:
+                labels = {}
+                for pair in args.labels.split(","):
+                    if "=" not in pair:
+                        raise SystemExit(
+                            "--labels must be k=v[,k2=v2...]")
+                    k, v = pair.split("=", 1)
+                    labels[k] = v
+            body = await c.history(series=args.series, labels=labels,
+                                   window_s=args.window,
+                                   step_s=args.step,
+                                   replica=args.replica)
+            if args.json:
+                return body
+            # Rendered (not JSON) output: main() prints this text
+            # verbatim so the sparkline glyphs survive.
+            return {"_rendered": _render_history(body)}
         if args.command == "profile":
             trace = await c.profile(window_s=args.window,
                                     replica=args.replica)
@@ -225,6 +315,9 @@ def main(argv=None) -> int:
     except Exception as e:
         print(json.dumps({"error": str(e)}), file=sys.stderr)
         return 1
+    if isinstance(result, dict) and "_rendered" in result:
+        print(result["_rendered"])
+        return 0
     print(json.dumps(result, indent=2))
     return 0
 

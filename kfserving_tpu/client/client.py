@@ -21,6 +21,7 @@ All methods are async (the whole stack is asyncio); use
 import asyncio
 import json
 from dataclasses import asdict, is_dataclass
+from urllib.parse import quote
 from typing import Any, Dict, List, Optional
 
 from kfserving_tpu.reliability import RetryPolicy, fault_sites, faults
@@ -211,6 +212,35 @@ class KFServingClient:
         qs = ("?" + "&".join(params)) if params else ""
         return await self._request(
             "GET", f"{self._ingress()}/debug/cache{qs}")
+
+    async def history(self, series: Optional[str] = None,
+                      labels: Optional[Dict[str, str]] = None,
+                      window_s: Optional[float] = None,
+                      step_s: Optional[float] = None,
+                      replica: Optional[str] = None) -> Dict[str, Any]:
+        """Fetch federated telemetry history from the ingress router:
+        each replica's ring-TSDB frames for `series` (a family name;
+        None = every live series) under its `replica` key, plus the
+        fleet rollup merged by grid timestamp (rates sum, gauges/
+        quantiles/ratios mean).  `labels` filters by label subset,
+        `window_s` bounds the lookback, `step_s` overrides the 1 s
+        alignment grid, `replica` narrows to one host."""
+        params = []
+        if series:
+            params.append(f"series={quote(series)}")
+        if labels:
+            pairs = ",".join(f"{k}={v}" for k, v in
+                             sorted(labels.items()))
+            params.append(f"labels={quote(pairs)}")
+        if window_s is not None:
+            params.append(f"window_s={float(window_s)}")
+        if step_s is not None:
+            params.append(f"step_s={float(step_s)}")
+        if replica:
+            params.append(f"replica={replica}")
+        qs = ("?" + "&".join(params)) if params else ""
+        return await self._request(
+            "GET", f"{self._ingress()}/debug/history{qs}")
 
     # -- readiness (reference wait_isvc_ready, kf_serving_client.py:232+) ---
     async def wait_isvc_ready(self, name: str, namespace: str = "default",

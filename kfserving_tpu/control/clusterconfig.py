@@ -130,6 +130,13 @@ class AutoscalerConfig:
     # burn_exit for exit_ticks consecutive ticks per level step-down.
     burn_exit: float = 1.0
     exit_ticks: int = 3
+    # Slope-aware gap sizing (ISSUE 17, off by default): inflate the
+    # observed service time by the history detector's latency trend
+    # slope projected `slope_horizon_s` ahead, so the predictive
+    # sizing provisions for where p99 is heading.  Off = the sizing
+    # math is exactly the pre-history behavior.
+    slope_aware: bool = False
+    slope_horizon_s: float = 15.0
 
 
 @dataclass

@@ -95,7 +95,9 @@ class ServingManager:
                 burn_alert=scaler_cfg.burn_alert,
                 burn_exit=scaler_cfg.burn_exit,
                 exit_ticks=scaler_cfg.exit_ticks,
-                brownout=self.brownout)
+                brownout=self.brownout,
+                slope_aware=scaler_cfg.slope_aware,
+                slope_horizon_s=scaler_cfg.slope_horizon_s)
         self.autoscaler = Autoscaler(
             self.controller, self.router,
             target_concurrency=scaler_cfg.target_concurrency,

@@ -77,6 +77,17 @@ DEFAULT_MAX_BROWNOUT_LEVEL = 2
 # autoscaler falls back to its target concurrency; the sizing model
 # needs the same per-replica capacity assumption.
 DEFAULT_FALLBACK_CONCURRENCY = 4
+# Slope-aware gap sizing (ISSUE 17, off by default): how far ahead
+# the history detector's latency trend slope is projected when
+# inflating the observed service time.
+DEFAULT_SLOPE_HORIZON_S = 15.0
+# The watched latency series whose trend slope feeds the projection
+# (ms of p99 per second) — the router's own per-revision view first,
+# the replicas' request-latency view as fallback.
+SLOPE_SERIES_NAMES = (
+    "kfserving_tpu_revision_request_ms_p99",
+    "kfserving_tpu_request_latency_ms_p99",
+)
 
 
 def ensure_flight_recorder(orchestrator) -> Optional[FlightRecorder]:
@@ -110,11 +121,21 @@ class PredictiveScaler:
                  exit_ticks: int = DEFAULT_EXIT_TICKS,
                  target_util: float = DEFAULT_TARGET_UTIL,
                  brownout=None,
-                 max_brownout_level: int = DEFAULT_MAX_BROWNOUT_LEVEL):
+                 max_brownout_level: int = DEFAULT_MAX_BROWNOUT_LEVEL,
+                 slope_aware: bool = False,
+                 slope_horizon_s: float = DEFAULT_SLOPE_HORIZON_S):
         self.controller = controller
         self.router = router
         self.brownout = brownout
         self.target_util = target_util
+        # Slope-aware gap sizing (ISSUE 17): when on, the history
+        # detector's trend-slope gauge inflates the observed service
+        # time by the projected latency growth over `slope_horizon_s`
+        # — capacity for where the latency is HEADING, one window
+        # before the mean catches up.  Off (the default) leaves the
+        # sizing math exactly as before.
+        self.slope_aware = slope_aware
+        self.slope_horizon_s = slope_horizon_s
         self.burn_exit = burn_exit
         self.exit_ticks = max(1, int(exit_ticks))
         self.max_brownout_level = max_brownout_level
@@ -238,6 +259,24 @@ class PredictiveScaler:
             weighted += counts[-1] * buckets[-1] * 1.5  # +Inf bucket
         return (weighted / total) / 1000.0
 
+    def _latency_slope_ms_per_s(self, model: str) -> Optional[float]:
+        """The history detector's trend slope for this model's watched
+        latency-p99 series (ms per second), worst series wins.  None
+        when no history subsystem exports the gauge — the slope-aware
+        path then degrades to exactly the slope-off sizing."""
+        fam = REGISTRY.family(obs.TREND_SLOPE_SERIES)
+        if fam is None:
+            return None
+        worst: Optional[float] = None
+        for labels, child in fam.samples():
+            if labels.get("series") not in SLOPE_SERIES_NAMES:
+                continue
+            if labels.get("model") != model:
+                continue
+            if worst is None or child.value > worst:
+                worst = child.value
+        return worst
+
     def burn_state(self, model: str
                    ) -> Tuple[bool, Dict[str, Dict[str, float]]]:
         """(fast_burn, burn_rates) for a model.  Fast burn = the
@@ -283,6 +322,16 @@ class PredictiveScaler:
             arrival = max(arrival,
                           self.arrival_rate(f"router/{name}/{entry}"))
         service_s = self.service_estimate_s(name)
+        slope_ms_per_s = None
+        if self.slope_aware and service_s:
+            # Leading input (ISSUE 17): project the observed service
+            # time to where the trend says latency will BE one
+            # horizon out.  Only a rising slope inflates — a falling
+            # one must not shrink capacity below what is measured.
+            slope_ms_per_s = self._latency_slope_ms_per_s(name)
+            if slope_ms_per_s is not None and slope_ms_per_s > 0:
+                service_s = service_s + (slope_ms_per_s / 1000.0) \
+                    * self.slope_horizon_s
         plan: Dict[str, Any] = {
             "component": cid,
             "arrival_per_s": round(arrival, 3),
@@ -293,6 +342,11 @@ class PredictiveScaler:
             "current": current,
             "max_replicas": comp.max_replicas,
         }
+        if self.slope_aware:
+            plan["slope_ms_per_s"] = (
+                round(slope_ms_per_s, 4)
+                if slope_ms_per_s is not None else None)
+            plan["slope_horizon_s"] = self.slope_horizon_s
         # The sizing itself runs UNGATED (brownout needs the demand
         # picture even after shedding calmed the latency series);
         # only the scaling/pre-arm actuation is gated on fast burn.

@@ -200,6 +200,11 @@ class IngressRouter:
         # label — the feed prefix-affinity routing (ROADMAP item 3)
         # and the HBM residency manager (item 4) will consume.
         r.add("GET", "/debug/cache", self._debug_cache)
+        # Telemetry-history federation (ISSUE 17): every replica's
+        # ring-TSDB frames keyed under the `replica` label, resampled
+        # onto one absolute epoch grid so a fleet rollup can merge
+        # them by timestamp (rates sum, everything else means).
+        r.add("GET", "/debug/history", self._debug_history)
         # Progressive-delivery status (ISSUE 4): active rollouts,
         # recent promotions/rollbacks with pinned evidence, and the
         # quarantine ledger.
@@ -1009,6 +1014,76 @@ class IngressRouter:
         return Response(json.dumps({
             "replicas": replicas,
             "fleet": totals,
+        }).encode())
+
+    async def _debug_history(self, req: Request) -> Response:
+        """Federated telemetry history: each replica's /debug/history
+        frames under its `replica` host key, plus a fleet rollup per
+        (series, labels) merged by grid timestamp — rates (counter
+        deltas/s) SUM across replicas, every other kind (gauges,
+        quantiles, ratios) means.  The scrape pins `step_s` (default
+        1 s) so every replica resamples onto the same absolute epoch
+        grid; ?series=/?labels=/?window_s= pass through, ?replica=
+        narrows to one host."""
+        from urllib.parse import quote
+
+        only = req.query.get("replica")
+        step = req.query.get("step_s") or "1"
+        window = req.query.get("window_s")
+        try:
+            float(step)
+            if window is not None:
+                float(window)
+        except ValueError:
+            return Response(
+                b'{"error": "window_s and step_s must be numbers"}',
+                status=400)
+        qs = f"?step_s={quote(step)}"
+        for param in ("series", "labels"):
+            value = req.query.get(param)
+            if value:
+                qs += f"&{param}={quote(value)}"
+        if window:
+            qs += f"&window_s={quote(window)}"
+        hosts = [only] if only else self._replica_hosts()
+        replicas: Dict[str, dict] = {}
+        merged: Dict[tuple, dict] = {}
+        for host, body in await self._scrape_json_all(
+                hosts, f"/debug/history{qs}"):
+            replicas[host] = body
+            for s in body.get("series") or []:
+                key = (s.get("name"),
+                       tuple(sorted((s.get("labels") or {}).items())))
+                agg = merged.setdefault(key, {
+                    "name": s.get("name"),
+                    "labels": s.get("labels") or {},
+                    "kind": s.get("kind"),
+                    "step_s": s.get("step_s"),
+                    "buckets": {}})
+                for frame in s.get("frames") or []:
+                    ts, value = frame[0], frame[1]
+                    slot = agg["buckets"].setdefault(ts, [0.0, 0])
+                    slot[0] += value
+                    slot[1] += 1
+        fleet = []
+        for agg in merged.values():
+            # A per-replica rate sums to the fleet rate; a mean of
+            # gauges/quantiles/ratios is the only rollup that does
+            # not invent load that never existed.
+            summing = agg["kind"] == "rate"
+            frames = [[ts, (acc if summing else acc / n)]
+                      for ts, (acc, n) in
+                      sorted(agg["buckets"].items())]
+            fleet.append({"name": agg["name"],
+                          "labels": agg["labels"],
+                          "kind": agg["kind"],
+                          "step_s": agg["step_s"],
+                          "frames": frames})
+        fleet.sort(key=lambda d: (d["name"],
+                                  sorted(d["labels"].items())))
+        return Response(json.dumps({
+            "replicas": replicas,
+            "fleet": fleet,
         }).encode())
 
     async def _debug_flightrecorder(self, req: Request) -> Response:

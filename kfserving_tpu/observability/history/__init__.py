@@ -1,0 +1,62 @@
+"""Continuous telemetry history: the missing fourth leg beside
+metrics, traces, and profiles.
+
+Every other telemetry surface in the stack is instantaneous — the
+registry answers "what is true right now at scrape time" — so nobody
+could answer "when did TTFT p99 start degrading" or "what did pool
+occupancy look like in the two minutes before the eviction storm".
+This package retains that time axis in-process, bounded and
+allocation-light:
+
+- `store`    — `HistoryStore`, a ring TSDB: fixed-size per-series
+               rings with downsampled retention tiers (default
+               1 s x 10 min -> 10 s x 2 h), so memory stays fixed no
+               matter how long the replica lives.
+- `sampler`  — `HistorySampler`, the background tick (default 1 s)
+               that walks every registry family: counters land as
+               per-second rates (deltas over the tick), gauges as
+               values, histograms as per-bucket deltas reduced to
+               derived `_p50`/`_p99`/`_count` series; plus synthetic
+               `kfserving_tpu_history_error_ratio` /
+               `_prefix_hit_ratio` series derived across label sets.
+               Scrape-time publishers (roofline gauges, pool ratios)
+               run ON the tick so live scrapes and history agree.
+- `detector` — `TrendDetector`, EWMA + z-score change-point detection
+               per watched series (KFS_HISTORY_WATCH*), pinning a
+               `trend_<series>` flight-recorder entry that embeds the
+               pre/post window frames and exporting trend-slope
+               gauges the predictive scaler consumes as a leading
+               input.
+
+Served per replica at `GET /debug/history?series=&labels=&window_s=&
+step_s=`, federated by the ingress router under the `replica` label
+with a fleet rollup, and reachable from the SDK via
+`client.history()` / `kfs history <series>`.
+
+Import discipline (observability package contract): nothing from
+`server/`, `control/`, `engine/`, or `reliability/` — the fault-site
+hook and the scrape-time publishers are injected by the server that
+owns the sampler.
+"""
+
+from kfserving_tpu.observability.history.detector import (
+    DEFAULT_WATCHES,
+    TrendDetector,
+)
+from kfserving_tpu.observability.history.sampler import (
+    DEFAULT_TICK_S,
+    ENV_ENABLE,
+    ENV_TICK,
+    HistorySampler,
+    history_enabled,
+)
+from kfserving_tpu.observability.history.store import (
+    DEFAULT_TIERS,
+    HistoryStore,
+)
+
+__all__ = [
+    "HistoryStore", "HistorySampler", "TrendDetector",
+    "DEFAULT_TIERS", "DEFAULT_TICK_S", "DEFAULT_WATCHES",
+    "ENV_ENABLE", "ENV_TICK", "history_enabled",
+]

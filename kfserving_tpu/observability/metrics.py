@@ -37,6 +37,11 @@ REQUEST_LATENCY_SERIES = "kfserving_tpu_request_latency_ms"
 REVISION_REQUESTS_SERIES = "kfserving_tpu_revision_requests_total"
 REVISION_LATENCY_SERIES = "kfserving_tpu_revision_request_ms"
 
+# The trend-slope gauge the history detector exports and the
+# predictive scaler's slope-aware sizing reads back — shared constant
+# so the producer/consumer pair can't drift apart.
+TREND_SLOPE_SERIES = "kfserving_tpu_trend_slope_per_second"
+
 
 # -- batcher ------------------------------------------------------------
 def batch_queue_wait_ms():
@@ -502,6 +507,62 @@ def flightrecorder_pinned_total():
     return REGISTRY.counter(
         "kfserving_tpu_flightrecorder_pinned_total",
         "Flight-recorder entries pinned, by trigger reason")
+
+
+# -- telemetry history & trend detection (observability/history/) ------
+def history_tick_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_history_tick_ms",
+        "Wall time of one history sampler tick (walk every registry "
+        "family, append rings, run the trend detector) — the "
+        "sampler's own overhead, bounded by construction")
+
+
+def history_tick_failures_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_history_tick_failures_total",
+        "History sampler ticks that raised (swallowed; history goes "
+        "stale-but-served) — a climbing rate means the time axis is "
+        "silently frozen")
+
+
+def history_samples_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_history_samples_total",
+        "Points appended to the in-process history rings across all "
+        "series and ticks")
+
+
+def history_series():
+    return REGISTRY.gauge(
+        "kfserving_tpu_history_series",
+        "Live series in the history ring store (bounded by "
+        "KFS_HISTORY_MAX_SERIES; overflow is dropped, never grown)")
+
+
+def trend_slope_per_second():
+    return REGISTRY.gauge(
+        TREND_SLOPE_SERIES,
+        "EWMA'd first derivative of each watched history series "
+        "(units of the series per second), labeled {series=family, "
+        "...underlying labels} — the leading input slope-aware "
+        "predictive scaling consumes")
+
+
+def trend_zscore():
+    return REGISTRY.gauge(
+        "kfserving_tpu_trend_zscore",
+        "Latest z-score of each watched history series against its "
+        "EWMA baseline (|z| past the threshold for consecutive ticks "
+        "declares a change-point)")
+
+
+def trend_changepoints_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_trend_changepoints_total",
+        "Change-points the history trend detector declared, by "
+        "watched series — each one also pins a trend_<series> "
+        "flight-recorder entry embedding the pre/post window frames")
 
 
 # -- payload logger -----------------------------------------------------

@@ -84,6 +84,13 @@ SITES: Dict[str, tuple] = {
         "error fails the read BEFORE any pool insert dispatches, "
         "proving the admission plan rolls back and the turn falls "
         "through to a normal re-prefill with bit-exact generation"),
+    "OBSERVABILITY_HISTORY_TICK": (
+        "observability.history_tick",
+        "HistorySampler background tick (probed via the async hook "
+        "the server injects) — an injected hang parks only the "
+        "sampler task and an injected error is swallowed and "
+        "counted, proving history degrades to stale-but-served and "
+        "the serving path never blocks on its own telemetry"),
 }
 
 
@@ -107,3 +114,4 @@ ENGINE_RESIDENCY_SWAP = "engine.residency_swap"
 ROUTER_AFFINITY_PICK = "router.affinity_pick"
 ENGINE_KV_SPILL = "engine.kv_spill"
 ENGINE_KV_FAULTBACK = "engine.kv_faultback"
+OBSERVABILITY_HISTORY_TICK = "observability.history_tick"

@@ -178,6 +178,31 @@ class ModelServer:
 
         self.monitoring = Monitoring(self)
         self.services.append(self.monitoring)
+        # Continuous telemetry history (ISSUE 17): the ring TSDB
+        # sampler ticks every registry family — the process-wide
+        # REGISTRY plus THIS server's private request registry — into
+        # bounded rings, runs the scrape-time publishers so live
+        # scrapes and history agree, and feeds the trend detector
+        # whose change-points pin into this server's flight recorder.
+        # KFS_HISTORY=0 disables the whole subsystem.
+        from kfserving_tpu.observability.history import (
+            HistorySampler,
+            TrendDetector,
+            history_enabled,
+        )
+
+        self.history: Optional[HistorySampler] = None
+        if history_enabled():
+            from kfserving_tpu.observability.registry import REGISTRY
+
+            self.history = HistorySampler(
+                registries=[self.metrics.registry, REGISTRY],
+                fault_hook=self._history_tick_fault,
+                publishers=[self.publish_engine_gauges])
+            self.history.detector = TrendDetector(
+                self.history.store,
+                recorder=self.monitoring.flight_recorder)
+            self.services.append(self.history)
         # Per-replica admission control (Knative containerConcurrency,
         # reference component.go:79-82): at most `container_concurrency`
         # inference calls execute at once; up to `max_queue_depth` more
@@ -276,6 +301,10 @@ class ModelServer:
         # router under the `replica` label — the feed prefix-affinity
         # routing and the HBM residency manager will read.
         r.add("GET", "/debug/cache", self._cache)
+        # Telemetry history (ISSUE 17): the replica's ring-TSDB query
+        # surface, federated by the router under the `replica` label
+        # with a fleet rollup.
+        r.add("GET", "/debug/history", self._history)
 
     # -- handlers ----------------------------------------------------------
     async def _live(self, req: Request) -> Response:
@@ -700,8 +729,14 @@ class ModelServer:
 
         return _json(startup.phases())
 
-    async def _metrics(self, req: Request) -> Response:
-        # Engine gauges (device/host breakdown, MFU) refresh at scrape.
+    def publish_engine_gauges(self) -> None:
+        """Refresh every scrape-time-published family (roofline MFU /
+        padding / goodput / HBM bandwidth, pool occupancy and
+        fragmentation ratios, generic per-key engine gauges) from the
+        engines' stats dicts.  Runs at every `/metrics` scrape AND on
+        the history sampler's tick — before ISSUE 17 these families
+        were invisible between scrapes, so history and a live scrape
+        could disagree about the same series."""
         from kfserving_tpu.observability.profiling import roofline
 
         for model in self.repository.get_models():
@@ -744,6 +779,10 @@ class ModelServer:
                             labels={"model": model.name})
             except Exception:
                 logger.exception("engine stats for %s failed", model.name)
+
+    async def _metrics(self, req: Request) -> Response:
+        # Engine gauges (device/host breakdown, MFU) refresh at scrape.
+        self.publish_engine_gauges()
         # Content negotiation: exemplars are only legal under the
         # OpenMetrics content type; the classic text parser would
         # reject the suffix and drop the whole scrape.
@@ -914,6 +953,66 @@ class ModelServer:
         return _json({"models": models, "hbm": hbm,
                       "residency": residency,
                       "host_tier": host_tier or None})
+
+    async def _history_tick_fault(self) -> None:
+        """The history sampler's chaos seam: probes the
+        `observability.history_tick` fault site before every tick.
+        Lives HERE (not in observability/) so the history package
+        never imports the reliability layer — the hook is injected
+        at construction."""
+        from kfserving_tpu.reliability import fault_sites
+        from kfserving_tpu.reliability.faults import faults
+
+        await faults.inject(fault_sites.OBSERVABILITY_HISTORY_TICK)
+
+    async def _history(self, req: Request) -> Response:
+        """Replica telemetry history: aligned (ts, value) frames from
+        the in-process ring TSDB.  `?series=` selects one family
+        (omitted = every live series), `?labels=k=v,k2=v2` filters by
+        label subset, `?window_s=` bounds the lookback (default
+        600 s), `?step_s=` resamples onto an absolute epoch grid so
+        the router can merge replicas by timestamp.  `?index=1`
+        returns the series catalog instead of frames.  History off
+        (KFS_HISTORY=0) answers 200 with `enabled: false` — the
+        router must still federate the replica."""
+        if self.history is None:
+            return _json({"enabled": False, "series": []})
+        if req.query.get("index") == "1":
+            return _json({"enabled": True,
+                          "tick_s": self.history.tick_s,
+                          "tiers": self.history.store.tiers,
+                          "series": self.history.store.index()})
+        series = req.query.get("series") or None
+        labels: Dict[str, str] = {}
+        for pair in (req.query.get("labels") or "").split(","):
+            if not pair:
+                continue
+            if "=" not in pair:
+                return _json(
+                    {"error": "labels must be k=v[,k2=v2...]"},
+                    status=400)
+            k, v = pair.split("=", 1)
+            labels[k] = v
+        try:
+            window_s = float(req.query.get("window_s", "600"))
+            step_raw = req.query.get("step_s")
+            step_s = float(step_raw) if step_raw else None
+        except ValueError:
+            return _json(
+                {"error": "window_s and step_s must be numbers"},
+                status=400)
+        if window_s <= 0 or (step_s is not None and step_s <= 0):
+            return _json(
+                {"error": "window_s and step_s must be positive"},
+                status=400)
+        return _json({
+            "enabled": True,
+            "tick_s": self.history.tick_s,
+            "ticks": self.history.ticks,
+            "series": self.history.store.query(
+                series=series, labels=labels or None,
+                window_s=window_s, step_s=step_s),
+        })
 
     async def _profiler_start(self, req: Request) -> Response:
         from kfserving_tpu.tracing import profiler

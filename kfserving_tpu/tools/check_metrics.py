@@ -247,6 +247,26 @@ async def smoke() -> List[str]:
     for kind in ("forbidden_transfer", "recompile", "loop_stall"):
         obs.sanitizer_violations_total().labels(kind=kind).inc()
     obs.sanitizer_armed().set(1)
+    # Telemetry history & trend families (ISSUE 17): the sampler's
+    # self-metrics, the synthetic ratio series (bounded [0, 1]), and
+    # the trend detector's slope/z-score/change-point exports — one
+    # real tick over the populated registries plus representative
+    # touches so names, label shapes, and unit suffixes always lint.
+    if server.history is not None:
+        server.history.tick()
+        server.history.tick()
+    obs.history_tick_ms().observe(0.8)
+    obs.history_tick_failures_total().inc()
+    obs.history_samples_total().inc(64)
+    obs.history_series().set(17.0)
+    obs.trend_slope_per_second().labels(
+        series="kfserving_tpu_request_latency_ms_p99",
+        model="metrics-probe").set(2.5)
+    obs.trend_zscore().labels(
+        series="kfserving_tpu_request_latency_ms_p99",
+        model="metrics-probe").set(4.2)
+    obs.trend_changepoints_total().labels(
+        series="kfserving_tpu_request_latency_ms_p99").inc()
     problems: List[str] = []
     if resp.status != 200:
         problems.append(

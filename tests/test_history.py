@@ -1,0 +1,663 @@
+"""Telemetry history (ISSUE 17): ring TSDB, sampler, trend detection.
+
+Strategy mirrors the repo's observability testing: pure-logic units
+against private registries and synthetic frames, plus in-process
+end-to-end acceptance on a live server (real sockets, no TPU).  The
+chaos-marked tests prove the `observability.history_tick` fault site
+degrades history to stale-but-served without ever blocking serving;
+the acceptance test injects a deterministic latency regression via
+`dataplane.infer` and asserts the detector pins a `trend_*` entry
+whose embedded frames show the step.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from kfserving_tpu.control.controller import Controller
+from kfserving_tpu.control.orchestrator import FakeOrchestrator
+from kfserving_tpu.control.predictive import PredictiveScaler
+from kfserving_tpu.control.router import IngressRouter
+from kfserving_tpu.control.spec import InferenceService, PredictorSpec
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.observability import metrics as obs
+from kfserving_tpu.observability.history import (
+    HistorySampler,
+    HistoryStore,
+    TrendDetector,
+)
+from kfserving_tpu.observability.history.sampler import (
+    ERROR_RATIO_SERIES,
+    PREFIX_HIT_RATIO_SERIES,
+    _quantile,
+)
+from kfserving_tpu.observability.metrics import REQUEST_TOTAL_SERIES
+from kfserving_tpu.observability.monitoring.slo import SLOObjective
+from kfserving_tpu.observability.registry import REGISTRY, Registry
+from kfserving_tpu.reliability import fault_sites, faults
+from kfserving_tpu.server.http import Request
+from tests.utils import http_json, running_server
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults.reset()
+
+
+class _EchoModel(Model):
+    def __init__(self, name):
+        super().__init__(name)
+
+    def load(self):
+        self.ready = True
+        return True
+
+    async def predict(self, request):
+        return {"predictions": [1]}
+
+
+# ------------------------------------------------------- store units --
+def test_ring_wraps_and_keeps_newest():
+    store = HistoryStore(tick_s=1.0, tiers=[(1.0, 4)])
+    for t in range(6):
+        assert store.record("s", None, "gauge", float(t), float(t))
+    [series] = store.query(now=5.0, window_s=100.0)
+    assert series["frames"] == [[2.0, 2.0], [3.0, 3.0],
+                                [4.0, 4.0], [5.0, 5.0]]
+    assert store.latest("s") == (5.0, 5.0)
+
+
+def test_coarse_tier_is_mean_of_fine_points():
+    store = HistoryStore(tick_s=1.0, tiers=[(1.0, 5), (10.0, 10)])
+    for t in range(30):
+        store.record("s", None, "gauge", float(t), float(t))
+    # A short window fits tier 0 (5 s span): raw 1 s frames.
+    [fine] = store.query(now=29.0, window_s=4.0)
+    assert fine["step_s"] == 1.0
+    assert fine["frames"][-1] == [29.0, 29.0]
+    # A 30 s window outgrows tier 0 -> tier 1, whose points are the
+    # mean of each flushed 10 s bucket (the third is still open).
+    [coarse] = store.query(now=29.0, window_s=30.0)
+    assert coarse["step_s"] == 10.0
+    assert coarse["frames"] == [[0.0, 4.5], [10.0, 14.5]]
+
+
+def test_series_cap_refuses_and_counts():
+    store = HistoryStore(tick_s=1.0, max_series=2)
+    assert store.record("a", None, "gauge", 0.0, 1.0)
+    assert store.record("b", None, "gauge", 0.0, 1.0)
+    assert not store.record("c", None, "gauge", 0.0, 1.0)
+    assert store.dropped == 1
+    assert store.series_count() == 2
+    # Existing series still append at the cap.
+    assert store.record("a", None, "gauge", 1.0, 2.0)
+
+
+def test_query_label_filter_and_resample_grid():
+    store = HistoryStore(tick_s=1.0)
+    for ts, v in ((0.0, 1.0), (1.0, 3.0)):
+        store.record("s", {"model": "a"}, "gauge", ts, v)
+    store.record("s", {"model": "b"}, "gauge", 0.0, 9.0)
+    out = store.query(series="s", labels={"model": "a"}, now=1.0,
+                      window_s=60.0, step_s=2.0)
+    assert len(out) == 1
+    # Both samples fall in the [0, 2) grid bucket: mean 2.0.
+    assert out[0]["frames"] == [[0.0, 2.0]]
+    assert store.query(series="nope", now=1.0) == []
+
+
+def test_sweep_drops_series_not_in_live_set():
+    store = HistoryStore(tick_s=1.0)
+    store.record("a", {"m": "1"}, "gauge", 0.0, 1.0)
+    store.record("b", None, "gauge", 0.0, 1.0)
+    assert store.sweep({store.key("a", {"m": "1"})}) == 1
+    assert [s["name"] for s in store.index()] == ["a"]
+
+
+def test_quantile_interpolation():
+    # 100 observations in the (0, 10] bucket: p50 interpolates to the
+    # bucket midpoint, p99 nearly to the bound.
+    assert _quantile([10.0, 100.0], [100, 0], 100, 0.5) == \
+        pytest.approx(5.0)
+    assert _quantile([10.0, 100.0], [100, 0], 100, 0.99) == \
+        pytest.approx(9.9)
+    # Observations in an upper bucket interpolate from its lower bound.
+    assert _quantile([10.0, 100.0], [0, 100], 100, 0.5) == \
+        pytest.approx(55.0)
+
+
+# ----------------------------------------------------- sampler units --
+def _sampler(reg, **kw):
+    kw.setdefault("store", HistoryStore(tick_s=1.0))
+    kw.setdefault("tick_s", 1.0)
+    return HistorySampler(registries=[reg], **kw)
+
+
+def test_counter_baseline_then_rate_then_reset():
+    reg = Registry()
+    c = reg.counter("kfserving_tpu_test_total").labels(model="m")
+    s = _sampler(reg)
+    c.inc(5)
+    s.tick(now=100.0)
+    # First sight establishes the baseline only: no frame.
+    assert s.store.latest("kfserving_tpu_test_total",
+                          {"model": "m"}) is None
+    c.inc(10)
+    s.tick(now=101.0)
+    assert s.store.latest("kfserving_tpu_test_total",
+                          {"model": "m"}) == (101.0, 10.0)
+    # A counter reset (restarted child) clamps to the new value —
+    # never a negative rate.
+    c.value = 3.0
+    s.tick(now=102.0)
+    assert s.store.latest("kfserving_tpu_test_total",
+                          {"model": "m"}) == (102.0, 3.0)
+
+
+def test_gauge_and_histogram_derived_series():
+    reg = Registry()
+    reg.gauge("kfserving_tpu_test_depth").labels(model="m").set(7.0)
+    h = reg.histogram("kfserving_tpu_test_ms",
+                      buckets=[10.0, 100.0]).labels(model="m")
+    s = _sampler(reg)
+    s.tick(now=0.0)  # histogram baseline
+    assert s.store.latest("kfserving_tpu_test_depth",
+                          {"model": "m"}) == (0.0, 7.0)
+    for _ in range(100):
+        h.observe(5.0)
+    s.tick(now=1.0)
+    assert s.store.latest("kfserving_tpu_test_ms_count",
+                          {"model": "m"}) == (1.0, 100.0)
+    assert s.store.latest("kfserving_tpu_test_ms_p50",
+                          {"model": "m"})[1] == pytest.approx(5.0)
+    assert s.store.latest("kfserving_tpu_test_ms_p99",
+                          {"model": "m"})[1] == pytest.approx(9.9)
+    # An idle tick records a zero count-rate but no quantile frame
+    # (the per-tick delta is empty), and the rings survive the sweep.
+    s.tick(now=2.0)
+    assert s.store.latest("kfserving_tpu_test_ms_count",
+                          {"model": "m"}) == (2.0, 0.0)
+    assert s.store.latest("kfserving_tpu_test_ms_p99",
+                          {"model": "m"})[0] == 1.0
+
+
+def test_publishers_run_before_sampling_each_tick():
+    """The scrape-time publisher fix: families published only at
+    /metrics render time (roofline, pool ratios) are refreshed by the
+    tick itself, so history sees the same values a live scrape would."""
+    reg = Registry()
+    calls = []
+
+    def publish():
+        calls.append(1)
+        reg.gauge("kfserving_tpu_test_ratio").labels().set(
+            float(len(calls)))
+
+    def broken():
+        raise RuntimeError("publisher boom")
+
+    s = _sampler(reg, publishers=[publish, broken])
+    s.tick(now=0.0)
+    s.tick(now=1.0)
+    assert len(calls) == 2
+    # The tick sampled the freshly published value (not a stale one),
+    # and the raising publisher neither aborted the tick nor counted
+    # as a tick failure.
+    assert s.store.latest("kfserving_tpu_test_ratio", {}) == (1.0, 2.0)
+    assert s.failures == 0
+
+
+def test_synthetic_error_and_prefix_hit_ratios():
+    reg = Registry()
+    req = reg.counter(REQUEST_TOTAL_SERIES)
+    ok = req.labels(model="m", verb="predict", status="200")
+    err = req.labels(model="m", verb="predict", status="503")
+    look = reg.counter("kfserving_tpu_generator_prefix_lookups_total")
+    hit = look.labels(model="m", outcome="hit")
+    miss = look.labels(model="m", outcome="miss")
+    s = _sampler(reg)
+    s.tick(now=0.0)
+    ok.inc(8)
+    err.inc(2)
+    hit.inc(3)
+    miss.inc(1)
+    s.tick(now=1.0)
+    assert s.store.latest(ERROR_RATIO_SERIES,
+                          {"model": "m"}) == (1.0, 0.2)
+    assert s.store.latest(PREFIX_HIT_RATIO_SERIES,
+                          {"model": "m"}) == (1.0, 0.75)
+    # An idle tick keeps the ratio rings but records nothing (no
+    # traffic is not a 0% error rate).
+    s.tick(now=2.0)
+    assert s.store.latest(ERROR_RATIO_SERIES,
+                          {"model": "m"})[0] == 1.0
+
+
+def test_prune_stops_sampling_and_no_ghost_resurrection():
+    """Family.prune() x sampler: a pruned revision's series is swept
+    from the store the next tick, and a rollback that re-registers
+    the same label set starts from a fresh baseline — no ghost ring,
+    no stale frames, no inherited counter baseline."""
+    reg = Registry()
+    name = "kfserving_tpu_test_total"
+    c = reg.counter(name).labels(model="m", revision="r1")
+    s = _sampler(reg)
+    c.inc(100)
+    s.tick(now=0.0)
+    c.inc(10)
+    s.tick(now=1.0)
+    labels = {"model": "m", "revision": "r1"}
+    assert s.store.latest(name, labels) == (1.0, 10.0)
+    reg.family(name).prune(revision="r1")
+    s.tick(now=2.0)
+    assert s.store.latest(name, labels) is None
+    assert s.store.series_count() == 0
+    # Rollback: the same child re-registers with a fresh count.
+    c2 = reg.counter(name).labels(model="m", revision="r1")
+    c2.inc(50)
+    s.tick(now=3.0)
+    # First sight after re-registration is baseline-only — a ghost
+    # ring would have resurrected the old frames here.
+    assert s.store.latest(name, labels) is None
+    c2.inc(4)
+    s.tick(now=4.0)
+    [series] = s.store.query(series=name, now=4.0, window_s=600.0)
+    assert series["frames"] == [[4.0, 4.0]]
+
+
+def test_sampler_self_metrics_and_store_cap_env(monkeypatch):
+    monkeypatch.setenv("KFS_HISTORY_MAX_SERIES", "3")
+    reg = Registry()
+    reg.gauge("kfserving_tpu_test_depth").labels().set(1.0)
+    s = HistorySampler(registries=[reg], tick_s=1.0)
+    assert s.store.max_series == 3
+    s.tick(now=0.0)
+    assert s.ticks == 1
+    fam = REGISTRY.family("kfserving_tpu_history_series")
+    [(_, child)] = list(fam.samples())
+    assert child.value == 1.0
+
+
+# ---------------------------------------------------- trend detector --
+class _Recorder:
+    def __init__(self):
+        self.pins = []
+
+    def record(self, entry, pin=None):
+        self.pins.append((pin, entry))
+
+
+def test_detector_pins_changepoint_with_pre_post_frames():
+    store = HistoryStore(tick_s=1.0)
+    rec = _Recorder()
+    name = "kfserving_tpu_test_ms_p99"
+    det = TrendDetector(store, watches=[name], recorder=rec,
+                        min_samples=5, breach_ticks=2,
+                        cooldown_s=30.0, window_s=20.0)
+    labels = {"model": "m"}
+    for t in range(12):
+        store.record(name, labels, "quantile", float(t), 10.0)
+        det.evaluate(now=float(t))
+    assert det.changepoints == 0
+    for t in range(12, 18):
+        store.record(name, labels, "quantile", float(t), 100.0)
+        det.evaluate(now=float(t))
+    # One change-point at the second breaching tick; the cooldown and
+    # re-seeded baseline absorb the settled new level (no re-pin).
+    assert det.changepoints == 1
+    [(pin, entry)] = rec.pins
+    assert pin == "trend_" + name
+    assert entry["series"] == name and entry["labels"] == labels
+    assert entry["breach_start_ts"] == 12.0
+    pre = [v for _, v in entry["pre"]]
+    post = [v for _, v in entry["post"]]
+    assert pre and post
+    assert max(pre) < min(post)  # the step is visible in the frames
+    # Slope/z gauges exported under {series=..., ...labels}.
+    fam = REGISTRY.family("kfserving_tpu_trend_slope_per_second")
+    samples = {tuple(sorted(lbls.items())) for lbls, _ in fam.samples()}
+    assert (("model", "m"), ("series", name)) in samples
+    # The change-point counter incremented for this series.
+    cp = REGISTRY.family("kfserving_tpu_trend_changepoints_total")
+    [(lbls, child)] = list(cp.samples())
+    assert lbls == {"series": name} and child.value == 1.0
+
+
+def test_detector_flatline_variance_floor():
+    """A perfectly flat series must not turn the first real jitter
+    into a division-by-epsilon change-point."""
+    store = HistoryStore(tick_s=1.0)
+    rec = _Recorder()
+    det = TrendDetector(store, watches=["s"], recorder=rec,
+                        min_samples=5, breach_ticks=2)
+    for t in range(30):
+        store.record("s", None, "gauge", float(t), 10.0)
+        det.evaluate(now=float(t))
+    # 1% wiggle: z = 0.1 / max(std, 0.05 * 10) = 0.2 — no breach.
+    store.record("s", None, "gauge", 30.0, 10.1)
+    det.evaluate(now=30.0)
+    assert det.changepoints == 0
+
+
+def test_detector_prunes_state_and_gauges_with_swept_series():
+    store = HistoryStore(tick_s=1.0)
+    det = TrendDetector(store, watches=["s"], min_samples=5)
+    store.record("s", {"model": "m"}, "gauge", 0.0, 1.0)
+    det.evaluate(now=0.0)
+    fam = REGISTRY.family("kfserving_tpu_trend_slope_per_second")
+    assert len(list(fam.samples())) == 1
+    store.sweep(set())  # the sampler swept the source series
+    det.evaluate(now=1.0)
+    assert det._state == {}
+    assert len(list(fam.samples())) == 0
+
+
+def test_detector_watch_list_env_override(monkeypatch):
+    monkeypatch.setenv("KFS_HISTORY_WATCH", " a , b ")
+    monkeypatch.setenv("KFS_HISTORY_WATCH_Z", "2.5")
+    det = TrendDetector(HistoryStore())
+    assert det.watches == ["a", "b"]
+    assert det.z_threshold == 2.5
+
+
+# ----------------------------------------- slope-aware gap sizing ----
+def _isvc(name="m", **kw):
+    kw.setdefault("framework", "sklearn")
+    kw.setdefault("storage_uri", "file:///models/m")
+    return InferenceService(name=name, predictor=PredictorSpec(**kw))
+
+
+def _feed_series(router, pred, *, rps=100, latency_ms=400.0,
+                 ticks=6, tick_s=0.5, model="m"):
+    t = 1000.0
+    for i in range(ticks):
+        key = f"router/{model}/predictor"
+        router.offered_count[key] = int((i + 1) * rps * tick_s)
+        for _ in range(20):
+            obs.revision_requests_total().labels(
+                model=model, revision="r1", status="200").inc()
+            obs.revision_request_ms().labels(
+                model=model, revision="r1").observe(latency_ms)
+        pred.observe(now=t)
+        t += tick_s
+    return t
+
+
+async def _sized_plan(slope_aware, slope):
+    orch = FakeOrchestrator()
+    c = Controller(orch)
+    isvc = _isvc(min_replicas=1, max_replicas=100,
+                 container_concurrency=2)
+    await c.apply(isvc)
+    router = IngressRouter(c)
+    pred = PredictiveScaler(
+        c, router,
+        objectives={"m": SLOObjective("m", latency_ms=100.0)},
+        windows_s=(1.0, 5.0), burn_alert=2.0,
+        slope_aware=slope_aware)
+    if slope is not None:
+        obs.trend_slope_per_second().labels(
+            series="kfserving_tpu_revision_request_ms_p99",
+            model="m", revision="r1").set(slope)
+    _feed_series(router, pred, rps=100, latency_ms=400.0)
+    pred.desired_replicas("m", isvc, "predictor", isvc.predictor,
+                          "default/m/predictor", 1)
+    return pred._plans["default/m/predictor"]
+
+
+async def test_slope_aware_off_is_exact_pre_change_sizing():
+    """Flag off (the default): a screaming slope gauge changes
+    nothing — required replicas and the plan record match the
+    pre-history behavior exactly."""
+    plan = await _sized_plan(slope_aware=False, slope=50.0)
+    # ceil(100 * 0.375 / (0.8 * 2)) = 24 (the ISSUE 12 sizing).
+    assert plan["required"] == 24
+    assert "slope_ms_per_s" not in plan
+
+
+async def test_slope_aware_inflates_service_time_by_projection():
+    plan = await _sized_plan(slope_aware=True, slope=20.0)
+    # service 0.375 s + (20 ms/s / 1000) * 15 s horizon = 0.675 s:
+    # ceil(100 * 0.675 / 1.6) = 43.
+    assert plan["required"] == 43
+    assert plan["slope_ms_per_s"] == pytest.approx(20.0)
+    assert plan["slope_horizon_s"] == 15.0
+
+
+async def test_slope_aware_ignores_negative_slope():
+    """An improving (falling) latency trend never deflates the
+    sizing below the observed service time."""
+    plan = await _sized_plan(slope_aware=True, slope=-30.0)
+    assert plan["required"] == 24
+
+
+# ------------------------------------------- replica endpoint (e2e) --
+async def test_history_endpoint_agrees_with_live_counters():
+    """Acceptance: summing the /debug/history rate frames (1 s grid,
+    manual 1 s ticks) reproduces the live registry counter totals
+    within one sample period."""
+    async with running_server([_EchoModel("m")]) as server:
+        port = server.http_port
+        # Park the background sampler; drive the tick deterministically.
+        await server.history.stop()
+        t0 = time.time()
+        server.history.tick(now=t0)  # counter baselines
+        for i in range(1, 6):
+            for _ in range(4):
+                status, _ = await http_json(
+                    port, "POST", "/v1/models/m:predict",
+                    {"instances": [[1]]})
+                assert status == 200
+            server.history.tick(now=t0 + i)
+        status, body = await http_json(
+            port, "GET",
+            f"/debug/history?series={REQUEST_TOTAL_SERIES}"
+            f"&window_s=600&step_s=1")
+        assert status == 200 and body["enabled"]
+        assert body["series"], "request counter series missing"
+        from_history = sum(
+            v for s in body["series"] for _, v in s["frames"]
+            if s["kind"] == "rate")
+        live = sum(
+            child.value for _, child in
+            server.metrics.registry.family(
+                REQUEST_TOTAL_SERIES).samples())
+        assert from_history == pytest.approx(live, abs=4.0)
+        # The catalog view lists the series with its kind.
+        status, idx = await http_json(port, "GET",
+                                      "/debug/history?index=1")
+        assert status == 200
+        kinds = {s["name"]: s["kind"] for s in idx["series"]}
+        assert kinds.get(REQUEST_TOTAL_SERIES) == "rate"
+        # Malformed parameters answer 400, not 500.
+        for bad in ("labels=model", "window_s=nope", "step_s=-1"):
+            status, _ = await http_json(port, "GET",
+                                        f"/debug/history?{bad}")
+            assert status == 400
+
+
+async def test_history_disabled_env(monkeypatch):
+    monkeypatch.setenv("KFS_HISTORY", "0")
+    async with running_server([_EchoModel("m")]) as server:
+        assert server.history is None
+        status, body = await http_json(server.http_port, "GET",
+                                       "/debug/history")
+        assert status == 200
+        assert body == {"enabled": False, "series": []}
+
+
+# --------------------------------------------------- chaos (faults) --
+@pytest.mark.chaos
+async def test_chaos_raising_tick_counts_failures_never_serving(
+        monkeypatch):
+    """Every tick raising inside the fault site is swallowed and
+    counted; serving and the (stale) history endpoint stay up."""
+    monkeypatch.setenv("KFS_HISTORY_TICK_S", "0.05")
+    faults.configure({fault_sites.OBSERVABILITY_HISTORY_TICK: {
+        "error_rate": 1.0}})
+    async with running_server([_EchoModel("m")]) as server:
+        port = server.http_port
+        deadline = time.time() + 5.0
+        while server.history.failures < 2 and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        assert server.history.failures >= 2
+        assert server.history.ticks == 0  # no tick ever completed
+        status, _ = await http_json(port, "POST",
+                                    "/v1/models/m:predict",
+                                    {"instances": [[1]]})
+        assert status == 200
+        status, body = await http_json(port, "GET", "/debug/history")
+        assert status == 200 and body["enabled"]
+        stats = faults.stats()[fault_sites.OBSERVABILITY_HISTORY_TICK]
+        assert stats["injected"] >= 2
+        fam = REGISTRY.family(
+            "kfserving_tpu_history_tick_failures_total")
+        [(_, child)] = list(fam.samples())
+        assert child.value >= 2
+
+
+@pytest.mark.chaos
+async def test_chaos_wedged_tick_parks_only_the_sampler(monkeypatch):
+    """An injected hang wedges the sampler task alone: history goes
+    stale-but-served and requests never block on telemetry."""
+    monkeypatch.setenv("KFS_HISTORY_TICK_S", "0.05")
+    async with running_server([_EchoModel("m")]) as server:
+        port = server.http_port
+        deadline = time.time() + 5.0
+        while server.history.ticks < 1 and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        assert server.history.ticks >= 1
+        faults.configure({fault_sites.OBSERVABILITY_HISTORY_TICK: {
+            "hang_s": 60.0}})
+        await asyncio.sleep(0.2)
+        wedged_at = server.history.ticks
+        t0 = time.perf_counter()
+        status, _ = await http_json(port, "POST",
+                                    "/v1/models/m:predict",
+                                    {"instances": [[1]]})
+        assert status == 200
+        assert time.perf_counter() - t0 < 5.0  # never waits the hang
+        status, body = await http_json(port, "GET", "/debug/history")
+        assert status == 200 and body["enabled"]
+        await asyncio.sleep(0.3)
+        # The sampler made no progress while wedged (at most the one
+        # tick already in flight when the fault landed).
+        assert server.history.ticks <= wedged_at + 1
+    # server.stop_async() cancelled the wedged task cleanly.
+
+
+# --------------------------------------- acceptance: injected step --
+@pytest.mark.chaos
+async def test_acceptance_latency_regression_pins_trend_entry():
+    """The ISSUE 17 acceptance: a deterministic injected latency
+    regression (dataplane.infer fault) makes the detector pin a
+    `trend_*` flight-recorder entry whose embedded pre/post frames
+    show the step."""
+    async with running_server([_EchoModel("m")]) as server:
+        port = server.http_port
+        await server.history.stop()
+
+        async def burst(n=3):
+            results = await asyncio.gather(*(
+                http_json(port, "POST", "/v1/models/m:predict",
+                          {"instances": [[1]]}) for _ in range(n)))
+            assert all(status == 200 for status, _ in results)
+
+        t0 = time.time()
+        server.history.tick(now=t0)  # histogram baseline
+        for i in range(1, 26):  # 25 healthy quantile frames (warmup)
+            await burst()
+            server.history.tick(now=t0 + i)
+        assert server.history.detector.changepoints == 0
+        faults.configure({fault_sites.DATAPLANE_INFER: {
+            "latency_ms": 150.0}})
+        for i in range(26, 33):
+            await burst()
+            server.history.tick(now=t0 + i)
+        det = server.history.detector
+        assert det.changepoints >= 1
+        pinned = server.monitoring.flight_recorder.dump(
+            pinned_only=True)["pinned"]
+        trends = [e for e in pinned
+                  if str(e.get("pinned", "")).startswith(
+                      "trend_kfserving_tpu_request_latency_ms_p99")]
+        assert trends, f"no trend pin among {pinned}"
+        entry = trends[0]
+        assert entry["kind"] == "trend"
+        pre = [v for _, v in entry["pre"]]
+        post = [v for _, v in entry["post"]]
+        assert pre and post
+        # The embedded frames show the injected step: every post-
+        # breach p99 sits above every healthy pre-breach p99.
+        assert min(post) > max(pre)
+        assert min(post) >= 100.0  # the 150 ms injection dominates
+
+
+# --------------------------------------------- router federation ----
+async def test_router_federates_history_fleet_rollup(monkeypatch):
+    """Rates SUM across replicas, gauges mean; the scrape pins a
+    shared step so replica frames merge by grid timestamp."""
+    router = IngressRouter(Controller(FakeOrchestrator()))
+    rate = {"name": REQUEST_TOTAL_SERIES, "labels": {"model": "m"},
+            "kind": "rate", "step_s": 1.0}
+    gauge = {"name": "kfserving_tpu_test_ratio", "labels": {},
+             "kind": "gauge", "step_s": 1.0}
+    bodies = {
+        "h1": {"enabled": True, "series": [
+            dict(rate, frames=[[100.0, 5.0], [101.0, 7.0]]),
+            dict(gauge, frames=[[100.0, 0.2]])]},
+        "h2": {"enabled": True, "series": [
+            dict(rate, frames=[[100.0, 3.0]]),
+            dict(gauge, frames=[[100.0, 0.6]])]},
+    }
+    paths = []
+
+    async def fake_scrape(hosts, path):
+        paths.append(path)
+        return [(h, bodies[h]) for h in ("h1", "h2")]
+
+    monkeypatch.setattr(router, "_scrape_json_all", fake_scrape)
+    monkeypatch.setattr(router, "_replica_hosts",
+                        lambda: ["h1", "h2"])
+    resp = await router._debug_history(Request(
+        "GET", "/debug/history",
+        {"series": REQUEST_TOTAL_SERIES, "window_s": "60"}, {}, b""))
+    assert resp.status == 200
+    assert "step_s=1" in paths[0] and "window_s=60" in paths[0]
+    body = json.loads(resp.body)
+    assert set(body["replicas"]) == {"h1", "h2"}
+    by_name = {s["name"]: s for s in body["fleet"]}
+    # 5 + 3 requests/s at ts 100 across the fleet; h2 is silent at
+    # 101 so the fleet rate there is h1's alone.
+    assert by_name[REQUEST_TOTAL_SERIES]["frames"] == \
+        [[100.0, 8.0], [101.0, 7.0]]
+    assert by_name["kfserving_tpu_test_ratio"]["frames"] == \
+        [[100.0, pytest.approx(0.4)]]
+    resp = await router._debug_history(Request(
+        "GET", "/debug/history", {"step_s": "nope"}, {}, b""))
+    assert resp.status == 400
+
+
+# ----------------------------------------------------------- CLI ----
+def test_cli_sparkline_rendering():
+    from kfserving_tpu.client.cli import _render_history, _sparkline
+
+    assert _sparkline([]) == ""
+    assert _sparkline([3.0, 3.0, 3.0]) == "▁▁▁"  # flat -> floor line
+    ramp = _sparkline([0.0, 1.0, 2.0, 3.0])
+    assert len(ramp) == 4 and ramp[0] == "▁" and ramp[-1] == "█"
+    text = _render_history({
+        "replicas": {"h1": {}, "h2": {}},
+        "fleet": [{"name": "kfserving_tpu_test_total",
+                   "labels": {"model": "m"}, "kind": "rate",
+                   "step_s": 1.0,
+                   "frames": [[0.0, 1.0], [1.0, 4.0]]}]})
+    assert "replicas: h1, h2" in text
+    assert "kfserving_tpu_test_total{model=m}" in text
+    assert "last=4" in text and "n=2" in text
+    assert "▁" in text and "█" in text
+    empty = _render_history({"replicas": {}, "fleet": []})
+    assert "(no series matched)" in empty
