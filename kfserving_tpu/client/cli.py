@@ -9,9 +9,12 @@
     python -m kfserving_tpu.client promote NAME
     python -m kfserving_tpu.client rollouts
     python -m kfserving_tpu.client profile --window 60 -o trace.json
-    python -m kfserving_tpu.client cache [--replica HOST] [--top-k N]
+    python -m kfserving_tpu.client cache [--replica HOST] [--top-k N] \
+        [--top-cost N]
     python -m kfserving_tpu.client history [SERIES] [--window S] \
         [--replica HOST]
+    python -m kfserving_tpu.client incidents [ID] [--state open]
+    python -m kfserving_tpu.client doctor
 
 The reference splits this between kubectl (CRDs) and the SDK; the TPU
 build ships one client for both planes.
@@ -21,6 +24,7 @@ import argparse
 import asyncio
 import json
 import sys
+import time
 
 from kfserving_tpu.client.client import KFServingClient
 
@@ -89,6 +93,10 @@ p_cache.add_argument("--replica", default=None,
                      help="narrow to one replica host:port")
 p_cache.add_argument("--top-k", type=int, default=None,
                      help="hot chains per model (default 10)")
+p_cache.add_argument("--top-cost", type=int, default=None,
+                     help="also list the top-N cost-attribution "
+                          "records by attributed device-ms and by KV "
+                          "blocks held")
 
 p_history = sub.add_parser(
     "history",
@@ -109,6 +117,28 @@ p_history.add_argument("--replica", default=None,
 p_history.add_argument("--json", action="store_true",
                        help="raw federated frames instead of "
                             "sparklines")
+
+p_incidents = sub.add_parser(
+    "incidents",
+    help="diagnosed incidents (detector firings joined into "
+         "evidence-bearing records with ranked causal hypotheses)")
+p_incidents.add_argument("id", nargs="?",
+                         help="incident id for the full record "
+                              "(evidence bundle included)")
+p_incidents.add_argument("--state", default=None,
+                         choices=["open", "closed"],
+                         help="filter the listing by state")
+p_incidents.add_argument("--limit", type=int, default=None)
+p_incidents.add_argument("--replica", default=None,
+                         help="narrow to one replica host:port")
+p_incidents.add_argument("--json", action="store_true",
+                         help="raw wire body instead of the rendered "
+                              "digest")
+
+sub.add_parser(
+    "doctor",
+    help="one-shot fleet health digest: open incidents with top "
+         "hypotheses, trend slopes, latency/MFU/occupancy snapshot")
 
 p_creds = sub.add_parser(
     "credentials",
@@ -203,6 +233,146 @@ def _render_history(body: dict) -> str:
     return "\n".join(lines)
 
 
+def _fmt_ts(ts) -> str:
+    if ts is None:
+        return "-"
+    return time.strftime("%H:%M:%S", time.localtime(ts))
+
+
+def _fmt_hyp(hyp: dict) -> str:
+    """One hypothesis with its supporting numbers inline."""
+    ev = hyp.get("evidence") or {}
+    nums = ", ".join(f"{k}={v}" for k, v in sorted(ev.items()))
+    line = (f"{hyp.get('cause')} (score {hyp.get('score', 0):.2f}): "
+            f"{hyp.get('summary', '')}")
+    if nums:
+        line += f" [{nums}]"
+    return line
+
+
+def _render_incident_detail(inc: dict) -> str:
+    lines = [f"incident {inc.get('id')}  [{inc.get('state')}]  "
+             f"model={inc.get('model')}  root_cause="
+             f"{inc.get('root_cause') or 'unclassified'}"]
+    if inc.get("replica"):
+        lines.append(f"replica: {inc['replica']}")
+    lines.append(f"opened: {_fmt_ts(inc.get('opened_ts'))}  "
+                 f"updated: {_fmt_ts(inc.get('updated_ts'))}  "
+                 f"closed: {_fmt_ts(inc.get('closed_ts'))}")
+    counts = inc.get("trigger_counts") or {}
+    if counts:
+        lines.append("triggers: " + ", ".join(
+            f"{k}x{v}" for k, v in sorted(counts.items())))
+    lines.append("hypotheses:")
+    for hyp in inc.get("hypotheses") or []:
+        lines.append("  " + _fmt_hyp(hyp))
+    if not inc.get("hypotheses"):
+        lines.append("  (unclassified — bundle held no usable "
+                     "decomposition)")
+    sources = (inc.get("evidence") or {}).get("sources") or []
+    lines.append(f"evidence sources: {', '.join(sources) or '(none)'}")
+    return "\n".join(lines)
+
+
+def _render_incidents(body: dict) -> str:
+    """All three wire shapes: the router federation (`replicas` +
+    `fleet` rollup), a bare replica's report (`incidents`), and the
+    `?id=` full record."""
+    if body.get("id"):
+        return _render_incident_detail(body)
+    lines = []
+    if "fleet" in body or "replicas" in body:
+        replicas = sorted((body.get("replicas") or {}).keys())
+        lines.append(f"replicas: {', '.join(replicas) or '(none)'}")
+        fleet = body.get("fleet") or []
+        lines.append(f"fleet incidents: {len(fleet)} "
+                     f"({body.get('open', 0)} open)")
+        for f in fleet:
+            state = "OPEN" if f.get("open") else "closed"
+            lines.append(
+                f"[{state}] {f.get('root_cause') or 'unclassified'} "
+                f"model={f.get('model')} x{f.get('count')} on "
+                f"{len(f.get('replicas') or [])} replica(s)")
+            if f.get("top_hypothesis"):
+                lines.append("  " + _fmt_hyp(f["top_hypothesis"]))
+            for ref in (f.get("incident_ids") or [])[:5]:
+                lines.append(f"  {ref.get('replica')}: "
+                             f"{ref.get('id')}")
+        brown = ((body.get("router") or {})
+                 .get("brownout_levels")) or {}
+        active = {m: lvl for m, lvl in brown.items() if lvl}
+        if active:
+            lines.append("router brownout: " + ", ".join(
+                f"{m}=L{lvl}" for m, lvl in sorted(active.items())))
+    else:
+        lines.append("replicas: (single replica)")
+        if body.get("enabled") is False:
+            lines.append("incident engine disabled (KFS_INCIDENTS=0)")
+            return "\n".join(lines)
+        incidents = body.get("incidents") or []
+        lines.append(f"incidents: {len(incidents)} "
+                     f"({body.get('open', 0)} open, "
+                     f"{body.get('total_opened', 0)} opened total)")
+        for inc in incidents:
+            state = ("OPEN" if inc.get("state") == "open"
+                     else inc.get("state"))
+            lines.append(
+                f"[{state}] {inc.get('id')} "
+                f"{inc.get('root_cause') or 'unclassified'} "
+                f"model={inc.get('model')}")
+            if inc.get("top_hypothesis"):
+                lines.append("  " + _fmt_hyp(inc["top_hypothesis"]))
+    return "\n".join(lines)
+
+
+# Series the doctor digests alongside the incident list: tail
+# latency, the trend detector's slopes, and the MFU / KV-pool
+# occupancy snapshot.
+_DOCTOR_SERIES = (
+    "kfserving_tpu_request_latency_ms_p99",
+    "kfserving_tpu_trend_slope_per_second",
+    "kfserving_tpu_engine_mfu",
+    "kfserving_tpu_generator_pool_occupancy_ratio",
+)
+
+
+def _series_list(body: dict) -> list:
+    """History series in either wire shape (router fleet rollup vs a
+    bare replica's flat list)."""
+    if "series" in body and "fleet" not in body:
+        return body.get("series") or []
+    return body.get("fleet") or []
+
+
+def _render_doctor(incidents_body: dict, histories: dict) -> str:
+    open_count = incidents_body.get("open", 0) or 0
+    verdict = ("HEALTHY — no open incidents" if not open_count
+               else f"ATTENTION — {open_count} open incident(s)")
+    lines = [f"kfs doctor: {verdict}", "", "-- incidents --",
+             _render_incidents(incidents_body), "", "-- signals --"]
+    for name, body in histories.items():
+        if body.get("_error"):
+            lines.append(f"  {name}: unavailable ({body['_error']})")
+            continue
+        series = _series_list(body)
+        if not series:
+            lines.append(f"  {name}: (no frames)")
+            continue
+        for s in series[:8]:
+            values = [f[1] for f in (s.get("frames") or [])]
+            if not values:
+                continue
+            label = ",".join(f"{k}={v}" for k, v in
+                             sorted((s.get("labels") or {}).items()))
+            head = s.get("name", name) + (f"{{{label}}}"
+                                          if label else "")
+            lines.append(f"  {head}: last={values[-1]:.4g} "
+                         f"min={min(values):.4g} "
+                         f"max={max(values):.4g}  "
+                         + _sparkline(values[-40:]))
+    return "\n".join(lines)
+
+
 def _read_json(path: str) -> dict:
     with open(path) as f:
         return json.load(f)
@@ -246,7 +416,28 @@ async def _run(args) -> dict:
             return await c.rollouts()
         if args.command == "cache":
             return await c.cache(replica=args.replica,
-                                 top_k=args.top_k)
+                                 top_k=args.top_k,
+                                 top_cost=args.top_cost)
+        if args.command == "incidents":
+            body = await c.incidents(incident_id=args.id,
+                                     state=args.state,
+                                     limit=args.limit,
+                                     replica=args.replica)
+            if args.json:
+                return body
+            return {"_rendered": _render_incidents(body)}
+        if args.command == "doctor":
+            incidents_body = await c.incidents()
+            histories = {}
+            for name in _DOCTOR_SERIES:
+                try:
+                    histories[name] = await c.history(series=name)
+                except Exception as e:
+                    # A partial digest still diagnoses: a replica
+                    # without the history ring just loses sparklines.
+                    histories[name] = {"_error": str(e)}
+            return {"_rendered": _render_doctor(incidents_body,
+                                                histories)}
         if args.command == "history":
             labels = None
             if args.labels:

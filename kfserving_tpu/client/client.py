@@ -197,21 +197,51 @@ class KFServingClient:
             "GET", f"{self._ingress()}/debug/profile?{qs}")
 
     async def cache(self, replica: Optional[str] = None,
-                    top_k: Optional[int] = None) -> Dict[str, Any]:
+                    top_k: Optional[int] = None,
+                    top_cost: Optional[int] = None) -> Dict[str, Any]:
         """Fetch the fleet's federated cache snapshot from the ingress
         router: per-replica prefix-index census (entry count,
         reuse-depth distribution, top-K hot chains), block-pool
         occupancy, and HBM residency — the observability feed
         prefix-affinity routing consumes.  `replica` narrows to one
-        host; `top_k` bounds the hot-chain list."""
+        host; `top_k` bounds the hot-chain list; `top_cost` adds the
+        top-N cost-attribution records ranked by attributed device-ms
+        and by KV blocks held (ISSUE 18)."""
         params = []
         if replica:
             params.append(f"replica={replica}")
         if top_k is not None:
             params.append(f"top_k={int(top_k)}")
+        if top_cost is not None:
+            params.append(f"top_cost={int(top_cost)}")
         qs = ("?" + "&".join(params)) if params else ""
         return await self._request(
             "GET", f"{self._ingress()}/debug/cache{qs}")
+
+    async def incidents(self, incident_id: Optional[str] = None,
+                        state: Optional[str] = None,
+                        limit: Optional[int] = None,
+                        replica: Optional[str] = None
+                        ) -> Dict[str, Any]:
+        """Fetch diagnosed incidents from the ingress router: each
+        replica's incident summaries under its host key plus the
+        fleet rollup deduplicated by (root cause, model) and the
+        router's own admission/brownout state.  `incident_id` pulls
+        one full evidence-bearing record from whichever replica owns
+        it; `state` filters (\"open\"/\"closed\"); `replica` narrows
+        to one host."""
+        params = []
+        if incident_id:
+            params.append(f"id={quote(incident_id)}")
+        if state:
+            params.append(f"state={quote(state)}")
+        if limit is not None:
+            params.append(f"limit={int(limit)}")
+        if replica:
+            params.append(f"replica={replica}")
+        qs = ("?" + "&".join(params)) if params else ""
+        return await self._request(
+            "GET", f"{self._ingress()}/debug/incidents{qs}")
 
     async def history(self, series: Optional[str] = None,
                       labels: Optional[Dict[str, str]] = None,

@@ -22,7 +22,7 @@ import logging
 import os
 import random
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import aiohttp
 
@@ -205,6 +205,13 @@ class IngressRouter:
         # onto one absolute epoch grid so a fleet rollup can merge
         # them by timestamp (rates sum, everything else means).
         r.add("GET", "/debug/history", self._debug_history)
+        # Incident-engine federation (ISSUE 18): every replica's
+        # diagnosed incidents keyed under the `replica` label, plus a
+        # fleet rollup that dedups by (root cause, model) — the same
+        # regression breaching N replicas is ONE fleet incident — and
+        # the router's own admission/brownout state beside it (the
+        # evidence only this vantage point holds).
+        r.add("GET", "/debug/incidents", self._debug_incidents)
         # Progressive-delivery status (ISSUE 4): active rollouts,
         # recent promotions/rollbacks with pinned evidence, and the
         # quarantine ledger.
@@ -973,15 +980,22 @@ class IngressRouter:
         through to the replicas' hot-chain census."""
         only = req.query.get("replica")
         top_k = req.query.get("top_k")
-        if top_k is not None:
-            try:
-                int(top_k)
-            except ValueError:
-                return Response(
-                    b'{"error": "top_k must be an integer"}',
-                    status=400)
+        top_cost = req.query.get("top_cost")
+        for raw in (top_k, top_cost):
+            if raw is not None:
+                try:
+                    int(raw)
+                except ValueError:
+                    return Response(
+                        b'{"error": "top_k and top_cost must be '
+                        b'integers"}', status=400)
         hosts = [only] if only else self._replica_hosts()
-        qs = f"?top_k={top_k}" if top_k else ""
+        params = []
+        if top_k:
+            params.append(f"top_k={top_k}")
+        if top_cost:
+            params.append(f"top_cost={top_cost}")
+        qs = ("?" + "&".join(params)) if params else ""
         replicas: Dict[str, dict] = {}
         totals = {"index_entries": 0, "prefix_hits": 0,
                   "prefix_misses": 0, "prefill_tokens_saved": 0,
@@ -1100,6 +1114,20 @@ class IngressRouter:
         qs = f"?limit={limit}"
         if pinned_only:
             qs += "&pinned=1"
+        # Pin-stream filters (ISSUE 18) pass through to every replica
+        # AND apply to the supervisor's own recorder below.
+        pin_type = req.query.get("pin_type") or None
+        since_raw = req.query.get("since_ts")
+        try:
+            since_ts = float(since_raw) if since_raw else None
+        except ValueError:
+            return Response(
+                b'{"error": "since_ts must be a number"}', status=400)
+        if pin_type:
+            from urllib.parse import quote
+            qs += f"&pin_type={quote(pin_type)}"
+        if since_ts is not None:
+            qs += f"&since_ts={since_ts}"
         entries: list = []
         pinned: list = []
         # The supervisor's own recorder (failover/swap-failure
@@ -1114,7 +1142,9 @@ class IngressRouter:
                 "flight_recorder", None)
             if recorder is not None:
                 body = recorder.dump(limit=limit,
-                                     pinned_only=pinned_only)
+                                     pinned_only=pinned_only,
+                                     pin_type=pin_type,
+                                     since_ts=since_ts)
                 entries += [dict(e, replica="supervisor")
                             for e in body.get("entries", [])]
                 pinned += [dict(e, replica="supervisor")
@@ -1129,6 +1159,104 @@ class IngressRouter:
                        for e in body.get("pinned", [])]
         return Response(json.dumps(
             {"entries": entries, "pinned": pinned}).encode())
+
+    def _router_admission_state(self) -> Dict[str, Any]:
+        """The router's own admission evidence for incident views:
+        brownout levels, in-flight gauges, breaker states — the
+        vantage point no replica bundle can see."""
+        state: Dict[str, Any] = {
+            "brownout_levels": (self.brownout.report()
+                                if self.brownout is not None else {}),
+            "inflight": dict(self.inflight),
+            "requests": dict(self.request_count),
+            "offered": dict(self.offered_count),
+        }
+        state["breakers"] = {host: breaker.state
+                             for host, breaker
+                             in self._breakers.items()}
+        return state
+
+    async def _debug_incidents(self, req: Request) -> Response:
+        """Federated incident view (ISSUE 18).  `?id=` pulls one full
+        record from whichever replica owns it (404 when none does);
+        the bare list returns every replica's summaries under its
+        host key plus a FLEET rollup deduplicated by (root cause,
+        model) — the same regression diagnosed on N replicas merges
+        into one fleet incident listing the replicas it hit — and the
+        router's own admission/brownout state.  ?replica= narrows,
+        ?state=/?limit= pass through."""
+        from urllib.parse import quote
+
+        only = req.query.get("replica")
+        hosts = [only] if only else self._replica_hosts()
+        incident_id = req.query.get("id")
+        if incident_id:
+            qs = f"?id={quote(incident_id)}"
+            for host, body in await self._scrape_json_all(
+                    hosts, f"/debug/incidents{qs}"):
+                if body.get("id"):
+                    return Response(json.dumps(
+                        dict(body, replica=host)).encode())
+            return Response(
+                json.dumps({"error":
+                            f"unknown incident {incident_id}"}
+                           ).encode(), status=404)
+        try:
+            limit = int(req.query.get("limit", "50"))
+        except ValueError:
+            return Response(b'{"error": "limit must be an integer"}',
+                            status=400)
+        state = req.query.get("state")
+        qs = f"?limit={limit}"
+        if state:
+            qs += f"&state={quote(state)}"
+        replicas: Dict[str, dict] = {}
+        merged: Dict[tuple, dict] = {}
+        for host, body in await self._scrape_json_all(
+                hosts, f"/debug/incidents{qs}"):
+            replicas[host] = body
+            for inc in body.get("incidents") or []:
+                key = (inc.get("root_cause"), inc.get("model"))
+                fleet_inc = merged.setdefault(key, {
+                    "root_cause": inc.get("root_cause"),
+                    "model": inc.get("model"),
+                    "replicas": [],
+                    "incident_ids": [],
+                    "count": 0,
+                    "open": False,
+                    "first_opened_ts": inc.get("opened_ts"),
+                    "last_updated_ts": inc.get("updated_ts"),
+                    "top_hypothesis": inc.get("top_hypothesis"),
+                })
+                fleet_inc["count"] += 1
+                if host not in fleet_inc["replicas"]:
+                    fleet_inc["replicas"].append(host)
+                fleet_inc["incident_ids"].append(
+                    {"replica": host, "id": inc.get("id")})
+                if inc.get("state") == "open":
+                    fleet_inc["open"] = True
+                opened = inc.get("opened_ts")
+                if opened is not None and (
+                        fleet_inc["first_opened_ts"] is None
+                        or opened < fleet_inc["first_opened_ts"]):
+                    fleet_inc["first_opened_ts"] = opened
+                updated = inc.get("updated_ts")
+                if updated is not None and (
+                        fleet_inc["last_updated_ts"] is None
+                        or updated > fleet_inc["last_updated_ts"]):
+                    fleet_inc["last_updated_ts"] = updated
+                    fleet_inc["top_hypothesis"] = \
+                        inc.get("top_hypothesis")
+        fleet = sorted(
+            merged.values(),
+            key=lambda f: (not f["open"],
+                           -(f["last_updated_ts"] or 0.0)))
+        return Response(json.dumps({
+            "replicas": replicas,
+            "fleet": fleet,
+            "open": sum(1 for f in fleet if f["open"]),
+            "router": self._router_admission_state(),
+        }).encode())
 
     # Transport-level failover attempts per request: a crashed replica is
     # evicted and the request retries the next one (the reference leans

@@ -36,6 +36,7 @@ Import discipline (observability package contract): nothing from
 
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Set
 
@@ -63,6 +64,9 @@ def observe(model: str, trace_id: Optional[str],
     engine's completion path."""
     record = dict(record)
     record["model"] = model
+    # Wall-clock stamp: the top-coster query (and the incident
+    # engine's evidence bundle) filters records by finish time.
+    record.setdefault("ts", time.time())
     try:
         device = record.get("device_ms") or {}
         for phase in ("prefill", "decode"):
@@ -125,6 +129,47 @@ def recent(limit: int = 10) -> List[Dict[str, Any]]:
     limit = max(0, int(limit))
     with _lock:
         return [dict(r) for r in list(_records.values())[-limit:]]
+
+
+def total_device_ms(record: Dict[str, Any]) -> float:
+    """A record's attributed device milliseconds summed over phases."""
+    device = record.get("device_ms") or {}
+    total = 0.0
+    for ms in device.values():
+        if isinstance(ms, (int, float)):
+            total += float(ms)
+    return total
+
+
+def top(k: int = 10, window_s: Optional[float] = None,
+        by: str = "device_ms",
+        now: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Top-K cost records from the ring, ranked by attributed device
+    milliseconds (`by="device_ms"`, summed over phases) or peak blocks
+    held (`by="held_blocks"`).  `window_s` keeps only records whose
+    finish stamp falls inside the trailing window — the incident
+    engine's evidence bundle asks for "the most expensive requests of
+    the breach window", `kfs cache --top-cost` asks the same question
+    interactively.  Each returned copy carries its computed
+    `total_device_ms` so rankings are self-explanatory."""
+    if by not in ("device_ms", "held_blocks"):
+        raise ValueError("by must be device_ms or held_blocks")
+    k = max(0, int(k))
+    now = time.time() if now is None else now
+    with _lock:
+        records = [dict(r) for r in _records.values()]
+    if window_s is not None:
+        horizon = now - float(window_s)
+        records = [r for r in records
+                   if float(r.get("ts") or 0.0) >= horizon]
+    for r in records:
+        r["total_device_ms"] = round(total_device_ms(r), 3)
+    if by == "device_ms":
+        records.sort(key=lambda r: r["total_device_ms"], reverse=True)
+    else:
+        records.sort(key=lambda r: float(r.get("blocks_held") or 0.0),
+                     reverse=True)
+    return records[:k]
 
 
 def clear() -> None:

@@ -1,0 +1,30 @@
+"""Incident engine: automated cross-signal diagnosis.
+
+The join layer over every detector the stack already runs — SLO
+burn-rate breaches, trend change-points, sanitizer violations,
+eviction/fault-back storms, lifecycle failovers — turning isolated
+flight-recorder pins into ONE diagnosed, evidence-bearing incident
+record per regression (manager.py), classified against the additive
+latency decomposition (classify.py).
+
+Served at replica `GET /debug/incidents`, federated by the router
+with fleet-level root-cause dedup, exported as the
+`kfserving_tpu_incident_*` registry families, and surfaced through
+`client.incidents()` / `kfs incidents` / `kfs doctor`.
+"""
+
+from kfserving_tpu.observability.incidents.classify import (
+    CAUSES,
+    classify,
+)
+from kfserving_tpu.observability.incidents.manager import (
+    IncidentManager,
+    incidents_enabled,
+)
+
+__all__ = [
+    "CAUSES",
+    "IncidentManager",
+    "classify",
+    "incidents_enabled",
+]

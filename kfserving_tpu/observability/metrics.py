@@ -806,3 +806,55 @@ def sanitizer_armed():
         "1 while KFS_SANITIZE=1 has the runtime sanitizer active in "
         "this process (transfer guard + recompile assertion + loop "
         "watchdog)")
+
+
+# -- incident engine (automated cross-signal diagnosis) -----------------
+def incident_open():
+    return REGISTRY.gauge(
+        "kfserving_tpu_incident_open",
+        "Open (undiagnosed-recovery) incidents per dedup key — the "
+        "model under breach, or `_server` for process-wide storms")
+
+
+def incident_opened_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_incident_opened_total",
+        "Incidents opened, labeled by the causal classifier's "
+        "top-ranked hypothesis at open time (queue_wait|"
+        "device_compute|cache_miss_storm|eviction_thrash|"
+        "recompile_host_sync|brownout_shed|failover|unclassified)")
+
+
+def incident_triggers_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_incident_triggers_total",
+        "Detector firings fed to the incident engine by trigger kind "
+        "(slo_breach|trend|sanitizer|eviction_storm|faultback_storm|"
+        "failover) — each either opens an incident or attaches to the "
+        "open one inside the dedup window")
+
+
+def incident_failures_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_incident_failures_total",
+        "Incident pipeline failures by reason (error = diagnosis "
+        "raised and was swallowed, dropped = the bounded trigger "
+        "queue overflowed while the worker was wedged) — under chaos "
+        "the pipeline degrades to plain detector pins, it never "
+        "blocks serving")
+
+
+# An incident's life spans seconds (a one-tick blip) to tens of
+# minutes (a sustained regression) — the request-latency ladder is
+# three decades too low.
+INCIDENT_DURATION_BUCKETS_MS = [
+    1000, 5000, 15000, 60000, 300000, 900000, 3600000]
+
+
+def incident_duration_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_incident_duration_ms",
+        "Open-to-close wall time of resolved incidents (close = "
+        "recovery observed, then the cooldown window passed with no "
+        "further triggers)",
+        buckets=INCIDENT_DURATION_BUCKETS_MS)

@@ -171,14 +171,19 @@ class Monitoring:
             logger.exception("flight-recorder capture failed")
 
     def dump_flightrecorder(self, limit: int = 100,
-                            pinned_only: bool = False
+                            pinned_only: bool = False,
+                            pin_type: Optional[str] = None,
+                            since_ts: Optional[float] = None
                             ) -> Dict[str, Any]:
         """The /debug/flightrecorder body: recorder dump with lazy
         timeline resolution for ring entries recorded without one (a
         debug endpoint can afford the tracer scans the hot path
-        can't)."""
+        can't).  `pin_type`/`since_ts` pass through to the recorder's
+        pin-stream filters (ISSUE 18)."""
         dump = self.flight_recorder.dump(limit=limit,
-                                         pinned_only=pinned_only)
+                                         pinned_only=pinned_only,
+                                         pin_type=pin_type,
+                                         since_ts=since_ts)
         # Copies, not in-place writes: dump() hands back the stored
         # dicts, which the recording path may be appending around.
         dump["entries"] = [

@@ -20,10 +20,13 @@ like `/debug/traces`).  Knobs: `KFS_FLIGHTRECORDER_SIZE` (ring),
 `KFS_FLIGHTRECORDER_LATENCY_WINDOW` (p99 sample window).
 """
 
+import logging
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger("kfserving_tpu.monitoring.flightrecorder")
 
 from kfserving_tpu.observability import metrics as obs
 from kfserving_tpu.observability.monitoring.knobs import env_number
@@ -49,6 +52,12 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self.recorded = 0
         self.pinned_count = 0
+        # Pin taps: called with every PINNED entry, outside the lock
+        # (recording happens on the event loop, executor threads, and
+        # the sanitizer watchdog thread alike — listeners must be
+        # thread-safe and cheap).  The incident engine subscribes here
+        # to turn detector pins into incident triggers.
+        self._pin_listeners: List[Callable[[Dict[str, Any]], None]] = []
 
     @classmethod
     def from_env(cls) -> "FlightRecorder":
@@ -99,18 +108,63 @@ class FlightRecorder:
                 self._pinned.append(entry)
         if pin:
             obs.flightrecorder_pinned_total().labels(reason=pin).inc()
+            for listener in list(self._pin_listeners):
+                try:
+                    listener(entry)
+                except Exception:
+                    # A broken tap must never fail the recording path.
+                    logger.exception("pin listener failed")
+
+    def add_pin_listener(
+            self, listener: Callable[[Dict[str, Any]], None]) -> None:
+        """Subscribe to pinned entries (each call gets the stamped
+        entry dict, `pinned` key included)."""
+        self._pin_listeners.append(listener)
+
+    def remove_pin_listener(
+            self, listener: Callable[[Dict[str, Any]], None]) -> None:
+        try:
+            self._pin_listeners.remove(listener)
+        except ValueError:
+            pass
 
     # -- dumping -----------------------------------------------------------
     def dump(self, limit: int = 100,
-             pinned_only: bool = False) -> Dict[str, Any]:
+             pinned_only: bool = False,
+             pin_type: Optional[str] = None,
+             since_ts: Optional[float] = None) -> Dict[str, Any]:
+        """`pin_type` keeps only entries whose pin reason starts with
+        the given prefix (`trend`, `slo_`, `sanitizer_recompile`, ...)
+        — unpinned ring entries are excluded too, so an incident
+        bundle can pull just the detector evidence instead of the
+        whole ring.  `since_ts` keeps entries stamped at or after the
+        given wall-clock time."""
         # Clamp BEFORE slicing: [-0:] is the whole deque, and a
         # negative limit would slice an arbitrary tail — a ?limit=0
         # query must mean "none", not "everything".
         limit = max(0, int(limit))
+
+        def keep(entry: Dict[str, Any]) -> bool:
+            if since_ts is not None and \
+                    float(entry.get("ts") or 0.0) < since_ts:
+                return False
+            if pin_type:
+                reason = entry.get("pinned")
+                if not reason or not str(reason).startswith(pin_type):
+                    return False
+            return True
+
+        filtering = pin_type or since_ts is not None
         with self._lock:
-            pinned = list(self._pinned)[-limit:] if limit else []
-            entries = ([] if pinned_only or not limit
-                       else list(self._ring)[-limit:])
+            pinned_src = ([e for e in self._pinned if keep(e)]
+                          if filtering else list(self._pinned))
+            pinned = pinned_src[-limit:] if limit else []
+            if pinned_only or not limit:
+                entries = []
+            else:
+                ring_src = ([e for e in self._ring if keep(e)]
+                            if filtering else list(self._ring))
+                entries = ring_src[-limit:]
             return {
                 "recorded": self.recorded,
                 "pinned_total": self.pinned_count,

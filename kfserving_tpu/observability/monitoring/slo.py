@@ -168,6 +168,12 @@ class SLOEngine:
         self._snapshots: List[Tuple[float, Dict[str, Dict]]] = []
         self._alerting: Dict[str, bool] = {}
         self._last_report: Dict[str, Any] = {}
+        # Breach-transition taps: called as (model, alerting,
+        # burn_rates) on every healthy->alerting and alerting->healthy
+        # edge — the incident engine opens/recovers incidents off this
+        # edge instead of polling the report.  Listeners must not
+        # raise; a broken tap is logged and skipped.
+        self.transition_listeners: List[Any] = []
 
     @classmethod
     def from_env(cls, registries: Sequence) -> "SLOEngine":
@@ -313,6 +319,9 @@ class SLOEngine:
                 logger.warning("SLO alert for model %s: burn rates %s "
                                "(threshold %s)", model, burn_rates,
                                self.burn_alert)
+                self._notify_transition(model, True, burn_rates)
+            elif was and not is_alerting:
+                self._notify_transition(model, False, burn_rates)
             models[model] = {
                 "objective": objective.to_dict(),
                 "burn_rates": burn_rates,
@@ -328,6 +337,14 @@ class SLOEngine:
             "models": models,
         }
         return self._last_report
+
+    def _notify_transition(self, model: str, alerting: bool,
+                           burn_rates: Dict[str, Any]) -> None:
+        for listener in list(self.transition_listeners):
+            try:
+                listener(model, alerting, dict(burn_rates))
+            except Exception:
+                logger.exception("SLO transition listener failed")
 
     def _baseline(self, at: float) -> Optional[Dict[str, Dict]]:
         """Newest snapshot taken at or before `at`; when history is

@@ -91,6 +91,15 @@ SITES: Dict[str, tuple] = {
         "sampler task and an injected error is swallowed and "
         "counted, proving history degrades to stale-but-served and "
         "the serving path never blocks on its own telemetry"),
+    "OBSERVABILITY_INCIDENT_OPEN": (
+        "observability.incident_open",
+        "IncidentManager diagnosis worker, probed before each "
+        "queued trigger is processed — an injected error is "
+        "swallowed and counted "
+        "(kfserving_tpu_incident_failures_total), an injected hang "
+        "parks only the worker task, proving a wedged incident "
+        "pipeline degrades to plain detector pins and predicts "
+        "never block on diagnosis"),
 }
 
 
@@ -115,3 +124,4 @@ ROUTER_AFFINITY_PICK = "router.affinity_pick"
 ENGINE_KV_SPILL = "engine.kv_spill"
 ENGINE_KV_FAULTBACK = "engine.kv_faultback"
 OBSERVABILITY_HISTORY_TICK = "observability.history_tick"
+OBSERVABILITY_INCIDENT_OPEN = "observability.incident_open"

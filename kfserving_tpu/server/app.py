@@ -203,6 +203,34 @@ class ModelServer:
                 self.history.store,
                 recorder=self.monitoring.flight_recorder)
             self.services.append(self.history)
+        # Incident engine (ISSUE 18): the join over every detector —
+        # SLO breach edges, trend change-points, sanitizer violations,
+        # eviction/fault-back storms, failovers — diagnosed against
+        # the additive decomposition with a cross-signal evidence
+        # bundle, served at GET /debug/incidents.  Triggers tee off
+        # the flight recorder's pin stream and the SLO engine's
+        # breach edge; diagnosis runs on a background worker behind
+        # the observability.incident_open fault site (injected hook —
+        # observability/ never imports reliability/).  KFS_INCIDENTS=0
+        # disables the subsystem.
+        from kfserving_tpu.observability.incidents import (
+            IncidentManager,
+            incidents_enabled,
+        )
+
+        self.incidents: Optional[IncidentManager] = None
+        if incidents_enabled():
+            self.incidents = IncidentManager(
+                history=(self.history.store
+                         if self.history is not None else None),
+                recorder=self.monitoring.flight_recorder,
+                providers={"cache": self._incident_cache_snapshot},
+                fault_hook=self._incident_open_fault)
+            self.monitoring.flight_recorder.add_pin_listener(
+                self.incidents.on_pin)
+            self.monitoring.slo.transition_listeners.append(
+                self.incidents.on_slo_transition)
+            self.services.append(self.incidents)
         # Per-replica admission control (Knative containerConcurrency,
         # reference component.go:79-82): at most `container_concurrency`
         # inference calls execute at once; up to `max_queue_depth` more
@@ -305,6 +333,11 @@ class ModelServer:
         # surface, federated by the router under the `replica` label
         # with a fleet rollup.
         r.add("GET", "/debug/history", self._history)
+        # Incident engine (ISSUE 18): diagnosed incident records —
+        # list summaries, ?id= pulls one full record with its
+        # evidence bundle, ?state=open filters.  Federated by the
+        # router with fleet-level root-cause dedup.
+        r.add("GET", "/debug/incidents", self._incidents)
 
     # -- handlers ----------------------------------------------------------
     async def _live(self, req: Request) -> Response:
@@ -813,8 +846,21 @@ class ModelServer:
             return _json({"error": "limit must be an integer"},
                          status=400)
         pinned_only = req.query.get("pinned", "0") == "1"
+        # Pin-stream filters (ISSUE 18): ?pin_type= prefix-matches the
+        # pin reason (trend / slo_ / sanitizer_...), ?since_ts= keeps
+        # entries stamped at or after the wall-clock time — incident
+        # bundles and humans pull just the detector evidence instead
+        # of the whole ring.
+        pin_type = req.query.get("pin_type") or None
+        since_raw = req.query.get("since_ts")
+        try:
+            since_ts = float(since_raw) if since_raw else None
+        except ValueError:
+            return _json({"error": "since_ts must be a number"},
+                         status=400)
         return _json(self.monitoring.dump_flightrecorder(
-            limit=limit, pinned_only=pinned_only))
+            limit=limit, pinned_only=pinned_only, pin_type=pin_type,
+            since_ts=since_ts))
 
     async def _traces(self, req: Request) -> Response:
         from kfserving_tpu.tracing import tracer
@@ -895,12 +941,38 @@ class ModelServer:
         index entry count, reuse-depth distribution, top-K hot chains
         by hit count, and the pool occupancy stats; plus the HBM
         accountant's residency ledger when one is wired.  ?top_k=
-        bounds the hot-chain list (default 10)."""
+        bounds the hot-chain list (default 10); ?top_cost=K appends
+        the attribution ring's top-K cost records (by device-ms and
+        by held blocks — `kfs cache --top-cost`)."""
         try:
             top_k = int(req.query.get("top_k", "10"))
+            top_cost = int(req.query.get("top_cost", "0"))
         except ValueError:
-            return _json({"error": "top_k must be an integer"},
-                         status=400)
+            return _json({"error": "top_k and top_cost must be "
+                                   "integers"}, status=400)
+        body = self.cache_snapshot(top_k=top_k)
+        if top_cost > 0:
+            from kfserving_tpu.observability import attribution
+
+            window_raw = req.query.get("cost_window_s")
+            try:
+                window_s = float(window_raw) if window_raw else None
+            except ValueError:
+                return _json({"error": "cost_window_s must be a "
+                                       "number"}, status=400)
+            body["top_cost"] = {
+                "by_device_ms": attribution.top(
+                    top_cost, window_s=window_s, by="device_ms"),
+                "by_held_blocks": attribution.top(
+                    top_cost, window_s=window_s, by="held_blocks"),
+            }
+        return _json(body)
+
+    def cache_snapshot(self, top_k: int = 10) -> Dict[str, Any]:
+        """The /debug/cache body as a plain dict — shared by the
+        handler and the incident engine's evidence provider (the
+        bundle embeds exactly what the debug endpoint would have
+        shown at open time)."""
         models: Dict[str, Any] = {}
         hbm = None
         residency = None
@@ -950,9 +1022,51 @@ class ModelServer:
                         hbm["used_bytes"] += snap["used_bytes"]
                 except Exception:
                     logger.exception("hbm debug failed")
-        return _json({"models": models, "hbm": hbm,
-                      "residency": residency,
-                      "host_tier": host_tier or None})
+        return {"models": models, "hbm": hbm,
+                "residency": residency,
+                "host_tier": host_tier or None}
+
+    def _incident_cache_snapshot(self) -> Dict[str, Any]:
+        """Evidence-bundle provider: the cache/residency/HBM state at
+        incident-open time (bounded hot-chain census)."""
+        return self.cache_snapshot(top_k=5)
+
+    async def _incidents(self, req: Request) -> Response:
+        """Diagnosed incident records (ISSUE 18).  `?id=` returns one
+        full record, evidence bundle and ranked hypotheses included;
+        the bare list returns newest-first summaries (`?state=open`
+        filters, `?limit=` bounds).  Incidents off (KFS_INCIDENTS=0)
+        answers 200 with `enabled: false` — the router must still
+        federate the replica."""
+        if self.incidents is None:
+            return _json({"enabled": False, "open": 0,
+                          "incidents": []})
+        incident_id = req.query.get("id")
+        if incident_id:
+            record = self.incidents.get(incident_id)
+            if record is None:
+                return _json(
+                    {"error": f"unknown incident {incident_id}"},
+                    status=404)
+            return _json(record)
+        try:
+            limit = int(req.query.get("limit", "50"))
+        except ValueError:
+            return _json({"error": "limit must be an integer"},
+                         status=400)
+        state = req.query.get("state") or None
+        return _json(self.incidents.report(state=state, limit=limit))
+
+    async def _incident_open_fault(self) -> None:
+        """The incident worker's chaos seam: probes the
+        `observability.incident_open` fault site before each queued
+        trigger is diagnosed.  Lives HERE (not in observability/) so
+        the incidents package never imports the reliability layer —
+        the hook is injected at construction."""
+        from kfserving_tpu.reliability import fault_sites
+        from kfserving_tpu.reliability.faults import faults
+
+        await faults.inject(fault_sites.OBSERVABILITY_INCIDENT_OPEN)
 
     async def _history_tick_fault(self) -> None:
         """The history sampler's chaos seam: probes the

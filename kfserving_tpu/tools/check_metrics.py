@@ -267,6 +267,24 @@ async def smoke() -> List[str]:
         model="metrics-probe").set(4.2)
     obs.trend_changepoints_total().labels(
         series="kfserving_tpu_request_latency_ms_p99").inc()
+    # Incident-engine families (ISSUE 18): the per-key open gauge,
+    # the cause-labeled open counter, the kind-labeled trigger
+    # counter, the failure counter (every reason the worker can
+    # shed), and the duration histogram — touched with
+    # representative values so names, label shapes, and unit
+    # suffixes always lint.
+    obs.incident_open().labels(model="metrics-probe").set(1)
+    obs.incident_open().labels(model="_server").set(0)
+    for cause in ("queue_wait", "device_compute", "cache_miss_storm",
+                  "eviction_thrash", "recompile_host_sync",
+                  "brownout_shed", "failover", "unclassified"):
+        obs.incident_opened_total().labels(cause=cause).inc()
+    for kind in ("slo_breach", "trend", "sanitizer", "eviction_storm",
+                 "faultback_storm", "failover"):
+        obs.incident_triggers_total().labels(kind=kind).inc()
+    for reason in ("error", "dropped", "spool"):
+        obs.incident_failures_total().labels(reason=reason).inc()
+    obs.incident_duration_ms().observe(42_000.0)
     problems: List[str] = []
     if resp.status != 200:
         problems.append(

@@ -1,0 +1,793 @@
+"""Incident engine (ISSUE 18): cross-signal diagnosis + evidence.
+
+Strategy mirrors the repo's observability testing: pure-logic units
+for the classifier and the trigger/dedup/close state machine, driven
+with pinned clocks (`drain(now=...)`) for determinism; in-process e2e
+acceptance on a live server (real sockets, no TPU) for the two
+mandated scenarios — an injected `dataplane.infer` latency step after
+a healthy warmup must open EXACTLY ONE incident classified
+device_compute (not queue_wait) with >= 3 evidence sources that
+closes after recovery + cooldown, and a pool-pressure eviction storm
+must classify eviction_thrash.  Chaos-marked tests prove the
+`observability.incident_open` fault site degrades diagnosis to plain
+detector pins (failures counted) without ever blocking predicts.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from kfserving_tpu.control.controller import Controller
+from kfserving_tpu.control.orchestrator import FakeOrchestrator
+from kfserving_tpu.control.router import IngressRouter
+from kfserving_tpu.model.model import Model
+from kfserving_tpu.observability import attribution
+from kfserving_tpu.observability.incidents import (
+    CAUSES,
+    IncidentManager,
+    classify,
+)
+from kfserving_tpu.observability.monitoring.flight_recorder import (
+    FlightRecorder,
+)
+from kfserving_tpu.observability.profiling import TIMELINE
+from kfserving_tpu.observability.registry import REGISTRY
+from kfserving_tpu.reliability import fault_sites, faults
+from kfserving_tpu.server.http import Request
+from tests.utils import http_json, running_server
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    attribution.clear()
+    TIMELINE.clear()
+    yield
+    faults.reset()
+    attribution.clear()
+    TIMELINE.clear()
+
+
+def _counter(name, **labels):
+    """Current value of one labeled counter child (0 when absent)."""
+    fam = REGISTRY.family(name)
+    if fam is None:
+        return 0.0
+    for sample_labels, child in fam.samples():
+        if all(sample_labels.get(k) == v for k, v in labels.items()):
+            return child.value
+    return 0.0
+
+
+class _EchoModel(Model):
+    def __init__(self, name):
+        super().__init__(name)
+
+    def load(self):
+        self.ready = True
+        return True
+
+    async def predict(self, request):
+        return {"predictions": [1]}
+
+
+def _request_pin(latency_ms, infer_ms, pin="latency_outlier",
+                 ts=None, **extra):
+    stages = {"decode": 0.5, "infer": infer_ms, "encode": 0.5}
+    entry = {"model": "m", "verb": "predict", "status": 200,
+             "latency_ms": latency_ms, "stages": stages,
+             "pinned": pin, "ts": time.time() if ts is None else ts}
+    entry.update(extra)
+    return entry
+
+
+# ------------------------------------------------- classifier units --
+def test_classify_device_compute_beats_queue_wait():
+    """Injected device latency signature: the infer stage IS the
+    latency, so device_compute outranks queue_wait."""
+    evidence = {
+        "flightrecorder": {"pinned": [
+            _request_pin(160.0, 155.0) for _ in range(3)]},
+        "consistency": {"attribution_device_ms": 450.0,
+                        "timeline_device_ms": 465.0,
+                        "delta_ratio": 0.0323},
+    }
+    hypotheses = classify({"trend": 1}, evidence)
+    assert hypotheses[0]["cause"] == "device_compute"
+    scores = {h["cause"]: h["score"] for h in hypotheses}
+    assert scores.get("queue_wait", 0.0) < scores["device_compute"]
+    ev = hypotheses[0]["evidence"]
+    assert ev["infer_stage_share"] == pytest.approx(155.0 / 160.0,
+                                                    abs=1e-3)
+    # The supporting numbers ride inline (the ±10% cross-check too).
+    assert ev["delta_ratio"] == 0.0323
+    assert ev["pinned_requests"] == 3
+
+
+def test_classify_queue_wait_dominates_unattributed_latency():
+    """Latency mostly OUTSIDE the recorded stages = admission-queue
+    wait; queue_wait must win even though infer ran too."""
+    evidence = {"flightrecorder": {"pinned": [
+        _request_pin(200.0, 15.0) for _ in range(2)]}}
+    hypotheses = classify({"slo_breach": 1}, evidence)
+    assert hypotheses[0]["cause"] == "queue_wait"
+    assert hypotheses[0]["score"] > 0.9  # (200 - 16) / 200
+    assert hypotheses[0]["evidence"]["pinned_requests"] == 2
+
+
+def test_classify_queue_wait_from_history_series():
+    """Without stage pins, the queue-wait quantile vs the latency
+    quantile carries the same verdict."""
+    evidence = {"history": [
+        {"name": "kfserving_tpu_batch_queue_wait_ms_p99",
+         "labels": {"model": "m"}, "frames": [[1.0, 90.0]]},
+        {"name": "kfserving_tpu_request_latency_ms_p99",
+         "labels": {"model": "m"}, "frames": [[1.0, 100.0]]},
+    ]}
+    hypotheses = classify({"trend": 1}, evidence)
+    assert hypotheses[0]["cause"] == "queue_wait"
+    assert hypotheses[0]["score"] == pytest.approx(0.9)
+
+
+def test_classify_cache_miss_storm_from_hit_ratio_collapse():
+    frames = [[float(t), 0.8] for t in range(4)] + \
+        [[float(t), 0.2] for t in range(4, 8)]
+    evidence = {"history": [
+        {"name": "kfserving_tpu_history_prefix_hit_ratio",
+         "labels": {}, "frames": frames}]}
+    hypotheses = classify({"trend": 1}, evidence)
+    assert hypotheses[0]["cause"] == "cache_miss_storm"
+    assert hypotheses[0]["score"] == 1.0  # clamp(2 * 0.6)
+    assert hypotheses[0]["evidence"]["pre_hit_ratio"] == 0.8
+
+
+def test_classify_eviction_thrash_scales_with_storms():
+    [h1] = classify({"eviction_storm": 1}, {})
+    assert h1["cause"] == "eviction_thrash"
+    assert h1["score"] == pytest.approx(0.7)
+    [h3] = classify({"eviction_storm": 2, "faultback_storm": 1}, {})
+    assert h3["score"] == 1.0
+    # A saturated pool corroborates: +0.15 from the cache snapshot.
+    [h_occ] = classify({"eviction_storm": 1}, {"cache": {"models": {
+        "m": {"paged": {"pool_occupancy_ratio": 0.97}}}}})
+    assert h_occ["score"] == pytest.approx(0.85)
+    assert h_occ["evidence"]["pool_occupancy_ratio"] == 0.97
+
+
+def test_classify_sanitizer_brownout_failover():
+    [sani] = classify({"sanitizer": 2}, {"flightrecorder": {"pinned": [
+        {"pinned": "sanitizer_recompile"},
+        {"pinned": "sanitizer_forbidden_transfer"}]}})
+    assert sani["cause"] == "recompile_host_sync"
+    assert sani["score"] == pytest.approx(0.9)
+    assert sani["evidence"]["violation_kinds"] == {
+        "recompile": 1, "forbidden_transfer": 1}
+    [brown] = classify({}, {"router": {"brownout_levels": {"m": 2}}})
+    assert brown["cause"] == "brownout_shed"
+    assert brown["score"] == pytest.approx(0.7)
+    [fail] = classify({"failover": 1}, {})
+    assert fail["cause"] == "failover"
+    assert fail["score"] == pytest.approx(0.8)
+
+
+def test_classify_empty_bundle_is_unclassified():
+    assert classify({}, {}) == []
+    assert classify({"trend": 3}, {"history": []}) == []
+
+
+# ------------------------------------------- attribution.top units --
+def test_attribution_top_ranks_and_windows():
+    now = time.time()
+    attribution.observe("m", "t-old", {
+        "device_ms": {"decode": 500.0}, "ts": now - 300.0})
+    attribution.observe("m", "t-big", {
+        "device_ms": {"prefill": 40.0, "decode": 60.0},
+        "blocks_held": 4})
+    attribution.observe("m", "t-blocks", {
+        "device_ms": {"decode": 10.0}, "blocks_held": 9})
+    by_cost = attribution.top(2, window_s=120.0, by="device_ms",
+                              now=now)
+    assert [r["total_device_ms"] for r in by_cost] == [100.0, 10.0]
+    by_blocks = attribution.top(2, window_s=120.0, by="held_blocks",
+                                now=now)
+    assert [r.get("blocks_held") for r in by_blocks] == [9, 4]
+    # No window: the 500 ms record from 5 minutes ago tops the list.
+    assert attribution.top(1)[0]["total_device_ms"] == 500.0
+    with pytest.raises(ValueError):
+        attribution.top(3, by="latency")
+
+
+# --------------------------------------- flight-recorder filtering --
+def test_flightrecorder_dump_filters_pin_type_and_since_ts():
+    rec = FlightRecorder(size=16, pinned_size=16)
+    rec.record({"kind": "plain"})  # unpinned ring entry
+    rec.record({"kind": "storm", "ts": 100.0}, pin="eviction_storm")
+    rec.record({"kind": "trend", "ts": 200.0}, pin="trend_series_a")
+    rec.record({"kind": "trend", "ts": 300.0}, pin="trend_series_b")
+    dump = rec.dump(pin_type="trend")
+    assert [e["pinned"] for e in dump["pinned"]] == \
+        ["trend_series_a", "trend_series_b"]
+    # Unpinned ring entries are excluded once a pin filter is on.
+    assert [e["pinned"] for e in dump["entries"]] == \
+        ["trend_series_a", "trend_series_b"]
+    dump = rec.dump(pin_type="trend_series_b", since_ts=250.0)
+    assert [e["ts"] for e in dump["pinned"]] == [300.0]
+    dump = rec.dump(since_ts=150.0, pinned_only=True)
+    assert [e["ts"] for e in dump["pinned"]] == [200.0, 300.0]
+
+
+def test_flightrecorder_pin_listener_tap():
+    rec = FlightRecorder(size=8, pinned_size=8)
+    seen = []
+    rec.add_pin_listener(seen.append)
+    rec.record({"kind": "plain"})  # unpinned: listener must not fire
+    rec.record({"kind": "storm"}, pin="eviction_storm")
+    assert [e["pinned"] for e in seen] == ["eviction_storm"]
+    # A raising listener is swallowed, later listeners still run.
+    def boom(entry):
+        raise RuntimeError("tap broke")
+    rec._pin_listeners.insert(0, boom)
+    rec.record({"kind": "storm2"}, pin="eviction_storm")
+    assert len(seen) == 2
+    rec.remove_pin_listener(seen.append)
+    rec.record({"kind": "storm3"}, pin="eviction_storm")
+    assert len(seen) == 2
+
+
+# ----------------------------------------------- manager state machine --
+async def test_manager_opens_attaches_and_closes_on_cooldown():
+    mgr = IncidentManager(cooldown_s=30.0, dedup_window_s=120.0,
+                          evidence_window_s=10.0)
+    t0 = 1000.0
+    mgr.trigger("eviction_storm", ts=t0)
+    assert await mgr.drain(now=t0) == 1
+    rep = mgr.report()
+    assert rep["open"] == 1 and rep["total_opened"] == 1
+    [summary] = rep["incidents"]
+    assert summary["state"] == "open" and summary["model"] is None
+    assert summary["root_cause"] == "eviction_thrash"
+    # Second firing inside the dedup window ATTACHES (no new record)
+    # and the re-ranked score moves with the storm count.
+    mgr.trigger("eviction_storm", ts=t0 + 5)
+    await mgr.drain(now=t0 + 5)
+    rep = mgr.report()
+    assert rep["total_opened"] == 1
+    [summary] = rep["incidents"]
+    assert summary["trigger_counts"] == {"eviction_storm": 2}
+    assert summary["top_hypothesis"]["score"] == pytest.approx(0.9)
+    # Quiet for the cooldown -> closed; gauge drops to zero.
+    await mgr.drain(now=t0 + 5 + 30.0)
+    assert mgr.report()["open"] == 0
+    [summary] = mgr.list(state="closed")
+    assert summary["closed_ts"] == t0 + 35.0
+    assert _counter("kfserving_tpu_incident_open",
+                    model="_server") == 0.0
+    full = mgr.get(summary["id"])
+    assert full["state"] == "closed"
+    assert full["evidence"]["window"]["span_s"] == 10.0
+
+
+async def test_manager_slo_breach_holds_open_until_recovery():
+    mgr = IncidentManager(cooldown_s=30.0, dedup_window_s=120.0)
+    t0 = 2000.0
+    mgr.trigger("slo_breach", model="m", ts=t0,
+                detail={"burn_rates": {"fast": 9.0}})
+    await mgr.drain(now=t0)
+    # Way past the cooldown but still alerting: never closes.
+    await mgr.drain(now=t0 + 500.0)
+    rep = mgr.report()
+    assert rep["open"] == 1
+    mgr.on_slo_transition("m", False, {})
+    await mgr.drain(now=t0 + 600.0)
+    assert mgr.report()["open"] == 0
+    [summary] = mgr.list()
+    assert summary["state"] == "closed" and summary["model"] == "m"
+
+
+async def test_manager_stale_open_incident_starts_new_episode():
+    mgr = IncidentManager(cooldown_s=1e9, dedup_window_s=60.0)
+    t0 = 3000.0
+    mgr.trigger("failover", ts=t0)
+    await mgr.drain(now=t0)
+    # A firing past the dedup window is a NEW episode: the stale
+    # record closes and a second one opens.
+    mgr.trigger("failover", ts=t0 + 120.0)
+    await mgr.drain(now=t0 + 120.0)
+    rep = mgr.report()
+    assert rep["total_opened"] == 2 and rep["open"] == 1
+    states = [i["state"] for i in rep["incidents"]]
+    assert sorted(states) == ["closed", "open"]
+
+
+async def test_manager_bounded_queue_drops_and_counts():
+    mgr = IncidentManager(queue_size=2)
+    dropped0 = _counter("kfserving_tpu_incident_failures_total",
+                        reason="dropped")
+    for _ in range(5):
+        mgr.trigger("trend", model="m")
+    assert len(mgr._queue) == 2
+    assert _counter("kfserving_tpu_incident_failures_total",
+                    reason="dropped") == dropped0 + 3
+
+
+def test_manager_spools_json_records(tmp_path):
+    mgr = IncidentManager(spool_dir=str(tmp_path),
+                          evidence_window_s=5.0)
+    # No running loop here: the spool hands the write to a short-
+    # lived thread (never the calling thread) — wait for the file.
+    mgr._process({"kind": "eviction_storm", "model": None,
+                  "detail": {}, "ts": 4000.0}, now=4000.0)
+    [summary] = mgr.list()
+    path = tmp_path / f"{summary['id']}.json"
+    deadline = time.time() + 5.0
+    while not path.exists() and time.time() < deadline:
+        time.sleep(0.01)
+    assert path.exists()
+    spooled = json.loads(path.read_text())
+    assert spooled["id"] == summary["id"]
+    assert spooled["root_cause"] == "eviction_thrash"
+    assert spooled["evidence"]["window"]["span_s"] == 5.0
+
+
+def test_manager_evidence_consistency_within_ten_percent():
+    """Acceptance: the bundle's attributed device-ms agrees with the
+    engine timeline's device-track busy time for the same window to
+    within ±10% (here they're the same synthetic 300 ms)."""
+    now = time.time()
+    for i in range(3):
+        attribution.observe("m", f"t{i}", {
+            "device_ms": {"prefill": 40.0, "decode": 60.0}})
+    for j in range(6):
+        TIMELINE.record("device", "decode.wave", dur_s=0.05,
+                        t_end=now - 0.01 * j)
+    TIMELINE.record("host", "engine.prepare", dur_s=5.0, t_end=now)
+    mgr = IncidentManager(top_k=5, evidence_window_s=60.0)
+    evidence = mgr._evidence("_server", now)
+    consistency = evidence["consistency"]
+    assert consistency["attribution_device_ms"] == pytest.approx(300.0)
+    # Host-track time must NOT count as device time.
+    assert consistency["timeline_device_ms"] == pytest.approx(300.0)
+    assert consistency["delta_ratio"] <= 0.1
+    assert "attribution" in evidence["sources"]
+    assert "timeline" in evidence["sources"]
+
+
+# ------------------------------------------------ e2e: device step --
+@pytest.mark.chaos
+async def test_e2e_injected_infer_latency_one_device_compute_incident(
+        monkeypatch):
+    """The ISSUE 18 acceptance scenario: healthy warmup, then an
+    injected `dataplane.infer` latency step -> EXACTLY ONE incident,
+    classified device_compute (not queue_wait), >= 3 evidence
+    sources, closed again after recovery + cooldown."""
+    monkeypatch.setenv("KFS_HISTORY_WATCH",
+                       "kfserving_tpu_request_latency_ms_p99")
+    async with running_server([_EchoModel("m")]) as server:
+        port = server.http_port
+        await server.history.stop()     # tick by hand
+        await server.incidents.stop()   # drain by hand
+
+        async def burst(n=3):
+            results = await asyncio.gather(*(
+                http_json(port, "POST", "/v1/models/m:predict",
+                          {"instances": [[1]]}) for _ in range(n)))
+            assert all(status == 200 for status, _ in results)
+
+        t0 = time.time()
+        server.history.tick(now=t0)  # histogram baseline
+        for i in range(1, 26):  # healthy warmup
+            await burst()
+            server.history.tick(now=t0 + i)
+        await server.incidents.drain(now=t0 + 25)
+        assert server.incidents.report()["total_opened"] == 0
+        faults.configure({fault_sites.DATAPLANE_INFER: {
+            "latency_ms": 150.0}})
+        for i in range(26, 33):
+            await burst()
+            server.history.tick(now=t0 + i)
+        faults.reset()  # recovery
+        now = time.time()
+        await server.incidents.drain(now=now)
+
+        report = server.incidents.report()
+        assert report["open"] == 1
+        assert report["total_opened"] == 1  # ONE incident, not five
+        [summary] = report["incidents"]
+        assert summary["model"] == "m"
+        assert summary["trigger_counts"].get("trend", 0) >= 1
+        incident = server.incidents.get(summary["id"])
+        assert incident["root_cause"] == "device_compute"
+        scores = {h["cause"]: h["score"]
+                  for h in incident["hypotheses"]}
+        assert scores.get("queue_wait", 0.0) < \
+            scores["device_compute"]
+        sources = incident["evidence"]["sources"]
+        assert len(sources) >= 3, sources
+        assert "history" in sources and "flightrecorder" in sources
+
+        # The replica endpoint serves both views.
+        status, body = await http_json(port, "GET",
+                                       "/debug/incidents")
+        assert status == 200 and body["open"] == 1
+        status, body = await http_json(
+            port, "GET", f"/debug/incidents?id={summary['id']}")
+        assert status == 200
+        assert body["id"] == summary["id"]
+        assert body["hypotheses"][0]["cause"] == "device_compute"
+        status, _ = await http_json(port, "GET",
+                                    "/debug/incidents?id=inc-nope")
+        assert status == 404
+
+        # Quiet past the cooldown -> closed.
+        await server.incidents.drain(
+            now=now + server.incidents.cooldown_s + 1.0)
+        assert server.incidents.report()["open"] == 0
+        closed = server.incidents.get(summary["id"])
+        assert closed["state"] == "closed"
+        assert closed["closed_ts"] is not None
+
+
+# ------------------------------------------- e2e: eviction storm ----
+async def test_e2e_eviction_storm_classified_eviction_thrash():
+    """Pool-pressure scenario: storm pins (the exact entry shape
+    residency.py records under pool pressure) flow recorder -> pin
+    listener -> trigger -> eviction_thrash diagnosis."""
+    async with running_server([_EchoModel("m")]) as server:
+        await server.incidents.stop()
+        recorder = server.monitoring.flight_recorder
+        t0 = time.time()
+        for i in range(3):
+            recorder.record({
+                "kind": "residency_eviction_storm",
+                "evictions_in_window": 9 + i,
+                "window_s": 60.0,
+            }, pin="eviction_storm")
+        await server.incidents.drain(now=t0)
+        report = server.incidents.report()
+        assert report["open"] == 1 and report["total_opened"] == 1
+        [summary] = report["incidents"]
+        assert summary["model"] is None  # process-wide dedup key
+        incident = server.incidents.get(summary["id"])
+        assert incident["root_cause"] == "eviction_thrash"
+        assert incident["trigger_counts"] == {"eviction_storm": 3}
+        assert incident["hypotheses"][0]["score"] == 1.0
+        assert "flightrecorder" in incident["evidence"]["sources"]
+        await server.incidents.drain(
+            now=t0 + server.incidents.cooldown_s + 1.0)
+        assert server.incidents.report()["open"] == 0
+
+
+# ---------------------------------------------------- chaos (faults) --
+@pytest.mark.chaos
+async def test_chaos_raising_diagnosis_counts_failures_never_serving(
+        monkeypatch):
+    """A wedged diagnosis pipeline degrades to plain detector pins:
+    every queued trigger fails inside the fault site, failures are
+    counted, and predicts never notice."""
+    monkeypatch.setenv("KFS_INCIDENT_TICK_S", "0.05")
+    faults.configure({fault_sites.OBSERVABILITY_INCIDENT_OPEN: {
+        "error_rate": 1.0}})
+    errors0 = _counter("kfserving_tpu_incident_failures_total",
+                       reason="error")
+    async with running_server([_EchoModel("m")]) as server:
+        port = server.http_port
+        recorder = server.monitoring.flight_recorder
+        for _ in range(3):
+            recorder.record({"kind": "residency_eviction_storm"},
+                            pin="eviction_storm")
+        deadline = time.time() + 5.0
+        while _counter("kfserving_tpu_incident_failures_total",
+                       reason="error") < errors0 + 3 \
+                and time.time() < deadline:
+            await asyncio.sleep(0.05)
+        assert _counter("kfserving_tpu_incident_failures_total",
+                        reason="error") >= errors0 + 3
+        # No incident opened, but the detector pins themselves are
+        # all still there — only the JOIN was lost.
+        assert server.incidents.report()["total_opened"] == 0
+        assert len(recorder.dump(pinned_only=True)["pinned"]) == 3
+        t0 = time.perf_counter()
+        status, _ = await http_json(port, "POST",
+                                    "/v1/models/m:predict",
+                                    {"instances": [[1]]})
+        assert status == 200
+        assert time.perf_counter() - t0 < 5.0
+
+
+@pytest.mark.chaos
+async def test_chaos_hung_diagnosis_parks_only_the_worker(monkeypatch):
+    """An injected hang parks the diagnosis worker alone: predicts
+    stay fast and the debug endpoint still answers."""
+    monkeypatch.setenv("KFS_INCIDENT_TICK_S", "0.05")
+    async with running_server([_EchoModel("m")]) as server:
+        port = server.http_port
+        faults.configure({fault_sites.OBSERVABILITY_INCIDENT_OPEN: {
+            "hang_s": 60.0}})
+        server.monitoring.flight_recorder.record(
+            {"kind": "residency_eviction_storm"}, pin="eviction_storm")
+        await asyncio.sleep(0.2)  # worker picks the trigger and hangs
+        t0 = time.perf_counter()
+        status, _ = await http_json(port, "POST",
+                                    "/v1/models/m:predict",
+                                    {"instances": [[1]]})
+        assert status == 200
+        assert time.perf_counter() - t0 < 5.0  # never waits the hang
+        status, body = await http_json(port, "GET",
+                                       "/debug/incidents")
+        assert status == 200 and body["enabled"]
+        assert body["total_opened"] == 0  # parked mid-diagnosis
+    # server.stop_async() cancelled the wedged worker cleanly.
+
+
+# ------------------------------------------------ endpoints & knobs --
+async def test_debug_endpoints_filters_top_cost_and_disabled(
+        monkeypatch):
+    monkeypatch.setenv("KFS_INCIDENTS", "0")
+    async with running_server([_EchoModel("m")]) as server:
+        port = server.http_port
+        assert server.incidents is None
+        status, body = await http_json(port, "GET",
+                                       "/debug/incidents")
+        assert status == 200
+        assert body == {"enabled": False, "open": 0, "incidents": []}
+        recorder = server.monitoring.flight_recorder
+        recorder.record({"kind": "storm", "ts": time.time() - 100.0},
+                        pin="eviction_storm")
+        recorder.record({"kind": "trend", "series": "s"},
+                        pin="trend_s")
+        status, body = await http_json(
+            port, "GET", "/debug/flightrecorder?pin_type=trend")
+        assert status == 200
+        assert [e["pinned"] for e in body["pinned"]] == ["trend_s"]
+        since = time.time() - 50.0
+        status, body = await http_json(
+            port, "GET", f"/debug/flightrecorder?since_ts={since}")
+        assert status == 200
+        assert "eviction_storm" not in [e["pinned"] for e
+                                        in body["pinned"]]
+        status, _ = await http_json(
+            port, "GET", "/debug/flightrecorder?since_ts=nope")
+        assert status == 400
+        attribution.observe("m", "t1", {
+            "device_ms": {"decode": 50.0}, "blocks_held": 4})
+        attribution.observe("m", "t2", {
+            "device_ms": {"decode": 10.0}, "blocks_held": 9})
+        status, body = await http_json(port, "GET",
+                                       "/debug/cache?top_cost=2")
+        assert status == 200
+        top_cost = body["top_cost"]
+        assert top_cost["by_device_ms"][0]["total_device_ms"] == 50.0
+        assert top_cost["by_held_blocks"][0]["blocks_held"] == 9
+        status, body = await http_json(port, "GET", "/debug/cache")
+        assert status == 200 and "top_cost" not in body
+        status, _ = await http_json(port, "GET",
+                                    "/debug/cache?top_cost=nope")
+        assert status == 400
+
+
+# --------------------------------------------- router federation ----
+def _summary(incident_id, host_cause, model, state, opened, updated,
+             score=0.9):
+    return {"id": incident_id, "state": state, "model": model,
+            "opened_ts": opened, "updated_ts": updated,
+            "closed_ts": None if state == "open" else updated,
+            "root_cause": host_cause,
+            "top_hypothesis": {"cause": host_cause, "score": score,
+                               "summary": "s", "evidence": {}},
+            "trigger_counts": {"trend": 1},
+            "evidence_sources": ["history"]}
+
+
+async def test_router_federates_incidents_with_fleet_dedup(
+        monkeypatch):
+    """The same root cause on N replicas merges into ONE fleet
+    incident listing the replicas it hit; the router's own admission
+    state rides the body."""
+    router = IngressRouter(Controller(FakeOrchestrator()))
+    bodies = {
+        "h1": {"enabled": True, "open": 1, "incidents": [
+            _summary("inc-1-10", "device_compute", "m", "open",
+                     100.0, 130.0)]},
+        "h2": {"enabled": True, "open": 0, "incidents": [
+            _summary("inc-1-20", "device_compute", "m", "open",
+                     90.0, 120.0),
+            _summary("inc-2-30", "eviction_thrash", None, "closed",
+                     50.0, 60.0)]},
+    }
+    paths = []
+
+    async def fake_scrape(hosts, path):
+        paths.append(path)
+        return [(h, bodies[h]) for h in hosts]
+
+    monkeypatch.setattr(router, "_scrape_json_all", fake_scrape)
+    monkeypatch.setattr(router, "_replica_hosts",
+                        lambda: ["h1", "h2"])
+    resp = await router._debug_incidents(Request(
+        "GET", "/debug/incidents", {"state": "open", "limit": "10"},
+        {}, b""))
+    assert resp.status == 200
+    assert "limit=10" in paths[0] and "state=open" in paths[0]
+    body = json.loads(resp.body)
+    assert set(body["replicas"]) == {"h1", "h2"}
+    assert body["open"] == 1
+    fleet = body["fleet"]
+    assert len(fleet) == 2
+    merged = fleet[0]  # open incidents sort first
+    assert merged["root_cause"] == "device_compute"
+    assert merged["count"] == 2
+    assert merged["replicas"] == ["h1", "h2"]
+    assert merged["open"] is True
+    assert merged["first_opened_ts"] == 90.0
+    assert merged["last_updated_ts"] == 130.0
+    assert merged["top_hypothesis"]["cause"] == "device_compute"
+    assert fleet[1]["root_cause"] == "eviction_thrash"
+    assert fleet[1]["open"] is False
+    router_state = body["router"]
+    assert "brownout_levels" in router_state
+    assert "inflight" in router_state and "breakers" in router_state
+
+    # ?id= pulls the full record from whichever replica owns it.
+    async def fake_scrape_id(hosts, path):
+        assert "id=inc-1-20" in path
+        return [("h2", {"id": "inc-1-20", "state": "open",
+                        "hypotheses": []})]
+
+    monkeypatch.setattr(router, "_scrape_json_all", fake_scrape_id)
+    resp = await router._debug_incidents(Request(
+        "GET", "/debug/incidents", {"id": "inc-1-20"}, {}, b""))
+    assert resp.status == 200
+    detail = json.loads(resp.body)
+    assert detail["replica"] == "h2" and detail["id"] == "inc-1-20"
+
+    async def fake_scrape_none(hosts, path):
+        return []
+
+    monkeypatch.setattr(router, "_scrape_json_all", fake_scrape_none)
+    resp = await router._debug_incidents(Request(
+        "GET", "/debug/incidents", {"id": "inc-gone"}, {}, b""))
+    assert resp.status == 404
+    resp = await router._debug_incidents(Request(
+        "GET", "/debug/incidents", {"limit": "nope"}, {}, b""))
+    assert resp.status == 400
+
+
+async def test_router_flightrecorder_passes_filters_through(
+        monkeypatch):
+    router = IngressRouter(Controller(FakeOrchestrator()))
+    paths = []
+
+    async def fake_scrape(hosts, path):
+        paths.append(path)
+        return [("h1", {"entries": [], "pinned": [
+            {"pinned": "trend_s", "ts": 500.0}]})]
+
+    monkeypatch.setattr(router, "_scrape_json_all", fake_scrape)
+    monkeypatch.setattr(router, "_replica_hosts", lambda: ["h1"])
+    resp = await router._debug_flightrecorder(Request(
+        "GET", "/debug/flightrecorder",
+        {"pin_type": "trend", "since_ts": "400"}, {}, b""))
+    assert resp.status == 200
+    assert "pin_type=trend" in paths[0] and "since_ts=400" in paths[0]
+    body = json.loads(resp.body)
+    assert body["pinned"][0]["replica"] == "h1"
+    resp = await router._debug_flightrecorder(Request(
+        "GET", "/debug/flightrecorder", {"since_ts": "nope"}, {},
+        b""))
+    assert resp.status == 400
+
+
+# ----------------------------------------------------------- CLI ----
+def test_cli_renders_incidents_all_wire_shapes():
+    from kfserving_tpu.client.cli import _render_incidents
+
+    fleet_body = {
+        "replicas": {"h1": {}, "h2": {}},
+        "open": 1,
+        "fleet": [{
+            "root_cause": "device_compute", "model": "m",
+            "replicas": ["h1", "h2"], "count": 2, "open": True,
+            "first_opened_ts": 90.0, "last_updated_ts": 130.0,
+            "incident_ids": [{"replica": "h1", "id": "inc-1-10"}],
+            "top_hypothesis": {"cause": "device_compute",
+                               "score": 0.91,
+                               "summary": "infer dominates",
+                               "evidence": {"infer_stage_share":
+                                            0.94}}}],
+        "router": {"brownout_levels": {"m": 2}},
+    }
+    text = _render_incidents(fleet_body)
+    assert "replicas: h1, h2" in text
+    assert "[OPEN] device_compute model=m x2 on 2 replica(s)" in text
+    assert "score 0.91" in text and "infer_stage_share=0.94" in text
+    assert "router brownout: m=L2" in text
+
+    replica_body = {"enabled": True, "open": 0, "total_opened": 1,
+                    "queued_triggers": 0, "incidents": [
+                        _summary("inc-1-10", "eviction_thrash", None,
+                                 "closed", 50.0, 60.0)]}
+    text = _render_incidents(replica_body)
+    assert "replicas: (single replica)" in text
+    assert "[closed] inc-1-10 eviction_thrash" in text
+
+    detail = {"id": "inc-1-10", "state": "open", "model": "m",
+              "root_cause": "device_compute",
+              "opened_ts": 100.0, "updated_ts": 130.0,
+              "closed_ts": None,
+              "trigger_counts": {"trend": 2, "slo_breach": 1},
+              "hypotheses": [{"cause": "device_compute",
+                              "score": 0.91, "summary": "s",
+                              "evidence": {}}],
+              "evidence": {"sources": ["history",
+                                       "flightrecorder"]}}
+    text = _render_incidents(detail)
+    assert "incident inc-1-10" in text
+    assert "triggers: slo_breachx1, trendx2" in text
+    assert "evidence sources: history, flightrecorder" in text
+
+    disabled = _render_incidents({"enabled": False, "open": 0,
+                                  "incidents": []})
+    assert "disabled" in disabled
+
+
+def test_cli_doctor_renders_both_shapes():
+    from kfserving_tpu.client.cli import _render_doctor
+
+    healthy = _render_doctor(
+        {"enabled": True, "open": 0, "total_opened": 0,
+         "incidents": []},
+        {"kfserving_tpu_engine_mfu": {"enabled": True, "series": [
+            {"name": "kfserving_tpu_engine_mfu", "labels": {},
+             "kind": "gauge",
+             "frames": [[0.0, 0.4], [1.0, 0.5]]}]}})
+    assert "HEALTHY" in healthy
+    assert "kfserving_tpu_engine_mfu: last=0.5" in healthy
+
+    sick = _render_doctor(
+        {"replicas": {"h1": {}}, "open": 1, "fleet": [{
+            "root_cause": "queue_wait", "model": "m",
+            "replicas": ["h1"], "count": 1, "open": True,
+            "incident_ids": [], "top_hypothesis": None}],
+         "router": {}},
+        {"kfserving_tpu_trend_slope_per_second": {
+            "_error": "connection refused"}})
+    assert "ATTENTION — 1 open incident(s)" in sick
+    assert "unavailable (connection refused)" in sick
+
+
+async def test_cli_doctor_against_live_replica():
+    """`kfs doctor` end-to-end against a bare replica (acceptance:
+    renders without a router in front)."""
+    from kfserving_tpu.client import cli
+
+    async with running_server([_EchoModel("m")]) as server:
+        port = server.http_port
+        status, _ = await http_json(port, "POST",
+                                    "/v1/models/m:predict",
+                                    {"instances": [[1]]})
+        assert status == 200
+        server.history.tick()
+        args = cli.parser.parse_args(
+            ["--ingress-url", f"http://127.0.0.1:{port}", "doctor"])
+        result = await cli._run(args)
+        text = result["_rendered"]
+        assert text.startswith("kfs doctor: HEALTHY")
+        assert "-- incidents --" in text
+        assert "replicas: (single replica)" in text
+        assert "-- signals --" in text
+
+        args = cli.parser.parse_args(
+            ["--ingress-url", f"http://127.0.0.1:{port}",
+             "incidents"])
+        result = await cli._run(args)
+        assert "replicas: (single replica)" in result["_rendered"]
+
+
+def test_causes_taxonomy_is_complete():
+    """The metric help text, classifier, and check_metrics smoke all
+    enumerate the same taxonomy — pin it."""
+    assert CAUSES == ("queue_wait", "device_compute",
+                      "cache_miss_storm", "eviction_thrash",
+                      "recompile_host_sync", "brownout_shed",
+                      "failover")
