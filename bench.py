@@ -169,6 +169,17 @@ def _compact_configs(results: dict) -> dict:
                 "ttft_p50_ms")
             c["host_tier_tokens_saved"] = (r.get("tier") or {}).get(
                 "tokens_saved_total")
+        elif name == "kvhandoff":
+            c.update(pick(r, "ttft_p50_handoff_over_cold",
+                          "cold_arm_saved_nothing"))
+            c["handoff_ttft_p50_ms"] = (r.get("handoff") or {}).get(
+                "ttft_p50_ms")
+            c["cold_ttft_p50_ms"] = (r.get("cold") or {}).get(
+                "ttft_p50_ms")
+            c["handoff_tokens_saved"] = (r.get("handoff") or {}).get(
+                "tokens_saved_total")
+            c["export_dropped"] = (r.get("export") or {}).get(
+                "dropped")
         elif name == "history":
             c.update(pick(r, "overhead_pct", "stress_overhead_pct",
                           "within_budget", "live_series"))
@@ -230,6 +241,7 @@ def main():
         "generate_stream_wire": C.bench_generate_stream_wire,
         "cache": C.bench_cache,
         "kvtier": C.bench_kvtier,
+        "kvhandoff": C.bench_kvhandoff,
         "history": C.bench_history,
     }
     results = {}

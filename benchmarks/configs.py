@@ -2739,6 +2739,223 @@ async def bench_kvtier(smoke: bool) -> Dict[str, Any]:
         await server.stop_async()
 
 
+async def bench_kvhandoff(smoke: bool) -> Dict[str, Any]:
+    """Durable KV handoff A/B (ISSUE 19 acceptance): recycle a replica
+    mid-conversation and measure the return visit.  Each rep of each
+    arm is a full simulated recycle — serve, seed every conversation's
+    context, tear the incumbent down, boot a successor, and time the
+    conversations' return visits on the fresh process.  The "handoff"
+    arm points `host_tier_dir` at a shared persistent directory and
+    runs the SIGTERM drain parachute (`engine.export_kv`) before
+    teardown, so the successor adopts the predecessor's generation and
+    serves the returning conversations as tier fault-backs; the "cold"
+    arm keeps the default ephemeral tier, which dies with the process,
+    so every return visit is a full re-prefill.  The device pool is
+    sized to hold all conversations, so the ONLY delta between arms is
+    what survives the recycle.  Arms interleave with order flip,
+    median-of-N.  Evidence committed to BENCH_kvhandoff.json:
+    return-visit TTFT p50/p99 per arm, re-prefill tokens saved (cold
+    arm must be exactly zero), adopted-block counts from the successor
+    tier, and the honest export ledger — exported/dropped/failed
+    straight from the drain, nothing smoothed over."""
+    import shutil
+
+    import aiohttp
+
+    from kfserving_tpu.predictors.llm import GenerativeModel
+
+    if smoke:
+        cfg = {
+            "arch_kwargs": {"num_layers": 2, "hidden_size": 64,
+                            "num_heads": 2, "intermediate_size": 128,
+                            "max_seq": 256},
+            "max_slots": 2, "max_seq": 256,
+            "prefill_buckets": [32, 64, 128, 256],
+            "block_size": 32, "cache_blocks": 24,
+            "prefill_chunk_tokens": 32,
+            "steps_per_call": 2,
+        }
+        n_convs, reps, max_tokens = 4, 3, 4
+        ctx_len, host_tier_blocks = 96, 64
+    else:
+        cfg = {
+            "arch_kwargs": {"vocab_size": 32000, "hidden_size": 768,
+                            "num_layers": 12, "num_heads": 12,
+                            "intermediate_size": 3072,
+                            "max_seq": 4096},
+            "max_slots": 4, "max_seq": 4096,
+            "prefill_buckets": [512, 2048, 4096],
+            "block_size": 128, "cache_blocks": 120,
+            "prefill_chunk_tokens": 512,
+            "steps_per_call": int(os.environ.get("BENCH_GEN_K", "16")),
+        }
+        n_convs, reps, max_tokens = 6, 3, 16
+        ctx_len, host_tier_blocks = 1920, 256
+    arch_kwargs = cfg.pop("arch_kwargs")
+    bs = cfg["block_size"]
+    arch = "decoder_tiny" if smoke else "decoder"
+    export_budget_s = 10.0
+    # kfslint: disable=async-blocking — bench setup: one tempdir
+    # create before any server exists.
+    kv_dir = tempfile.mkdtemp(prefix="bench_kvhandoff_")
+    loop = asyncio.get_running_loop()
+
+    # Same leading-salt convention as bench_kvtier: each conversation
+    # owns its block-aligned chain, so a return visit must recover ITS
+    # state — there is no cross-conversation prefix to hide behind.
+    def context(conv):
+        head = f"conversation {conv:04d} "
+        return (head + "history " * 400)[:ctx_len]
+
+    def prompt(conv, turn):
+        return context(conv) + f" turn {turn:03d}"
+
+    async def one(session, base, conv, turn, ttfts):
+        body = json.dumps({"text_input": prompt(conv, turn),
+                           "max_tokens": max_tokens}).encode()
+        await _sse_measure(
+            session, f"{base}/v2/models/kvhandoff/generate_stream",
+            body, [], ttfts)
+
+    async def incarnation(extra):
+        """One replica process stand-in: fresh model + server."""
+        # kfslint: disable=async-blocking — bench setup: one tiny
+        # config.json write before the incarnation's server exists.
+        model_dir = _write_jax_model_dir(
+            arch, arch_kwargs, **cfg,
+            host_tier_blocks=host_tier_blocks, **extra)
+        model = GenerativeModel("kvhandoff", model_dir)
+        model.load()
+        server = await _serve([model])
+        return model, server, f"http://127.0.0.1:{server.http_port}"
+
+    async def run_rep(arm):
+        extra = ({"host_tier_dir": kv_dir} if arm == "handoff"
+                 else {})
+        rec: Dict[str, Any] = {}
+        async with aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=1800)) as s:
+            # Incumbent: seed every conversation, then recycle.
+            model, server, base = await incarnation(extra)
+            try:
+                for conv in range(n_convs):
+                    await one(s, base, conv, 0, [])
+                if arm == "handoff":
+                    # The drain parachute, exactly as the SIGTERM
+                    # path runs it (off the async loop).
+                    eng = model.engine
+                    rec["export"] = await loop.run_in_executor(
+                        None,
+                        lambda: eng.export_kv(export_budget_s))
+            finally:
+                await server.stop_async()
+                await model.close()
+
+            # Successor: adopts the predecessor's generation (handoff
+            # arm) or starts empty (cold arm), then serves the return
+            # visits.
+            model, server, base = await incarnation(extra)
+            try:
+                ttfts: List[float] = []
+                t0 = time.perf_counter()
+                for conv in range(n_convs):
+                    await one(s, base, conv, 1, ttfts)
+                rec["wall_s"] = round(time.perf_counter() - t0, 3)
+                st = model.engine.stats()
+                ht = dict(st.get("host_tier") or {})
+                rec.update({
+                    "ttft_p50_ms": round(float(np.percentile(
+                        np.asarray(ttfts), 50)), 2),
+                    "ttft_p99_ms": round(float(np.percentile(
+                        np.asarray(ttfts), 99)), 2),
+                    "tokens_saved": st.get("paged", {}).get(
+                        "host_tier_tokens_saved", 0),
+                    "adopted_blocks": (ht.get("handoff") or {}).get(
+                        "adopted", 0),
+                    "faulted_blocks": ht.get("faulted_blocks", 0),
+                })
+            finally:
+                await server.stop_async()
+                await model.close()
+        return rec
+
+    arms = ("handoff", "cold")
+    rep_records: Dict[str, List[Dict[str, Any]]] = \
+        {a: [] for a in arms}
+    _reset_timeline()
+    try:
+        for r_i in range(reps):
+            order = arms if r_i % 2 == 0 else tuple(reversed(arms))
+            for arm in order:
+                rep_records[arm].append(await run_rep(arm))
+            # Wipe the shared tier directory between reps so every
+            # rep's adoption starts from exactly one predecessor
+            # generation (both incarnations are closed — no flocks).
+            # kfslint: disable=async-blocking — between-rep cleanup
+            # with every server torn down; nothing is being served.
+            shutil.rmtree(kv_dir, ignore_errors=True)
+            # kfslint: disable=async-blocking — same window as above.
+            os.makedirs(kv_dir, exist_ok=True)
+
+        out: Dict[str, Any] = {
+            "conversations": n_convs, "repetitions": reps,
+            "context_tokens": ctx_len, "context_blocks": ctx_len // bs,
+            "block_size": bs, "host_tier_blocks": host_tier_blocks,
+            "cache_blocks": cfg["cache_blocks"],
+            "export_budget_s": export_budget_s,
+        }
+        for arm in arms:
+            recs = rep_records[arm]
+            out[arm] = {
+                **{k: round(float(np.median([r[k] for r in recs])), 2)
+                   for k in ("ttft_p50_ms", "ttft_p99_ms",
+                             "tokens_saved")},
+                "tokens_saved_total": sum(r["tokens_saved"]
+                                          for r in recs),
+                "adopted_blocks_total": sum(r["adopted_blocks"]
+                                            for r in recs),
+                "faulted_blocks_total": sum(r["faulted_blocks"]
+                                            for r in recs),
+                "reps": recs,
+            }
+        # The honest export ledger: what the drain actually shipped,
+        # dropped on deadline, or failed — summed across reps.
+        exp = [r.get("export") or {}
+               for r in rep_records["handoff"]]
+        out["export"] = {k: sum(e.get(k, 0) for e in exp)
+                         for k in ("exported", "skipped", "dropped",
+                                   "failed")}
+        out["cold_arm_saved_nothing"] = \
+            out["cold"]["tokens_saved_total"] == 0
+        out["ttft_p50_handoff_over_cold"] = round(
+            out["handoff"]["ttft_p50_ms"]
+            / max(1e-9, out["cold"]["ttft_p50_ms"]), 3)
+        out["timeline"] = _timeline_summary()
+        record = {
+            "scenario": "kv_handoff_recycle_ab",
+            "smoke": smoke,
+            **{k: out[k] for k in
+               ("conversations", "repetitions", "context_tokens",
+                "context_blocks", "block_size", "host_tier_blocks",
+                "cache_blocks", "export_budget_s", "handoff", "cold",
+                "export", "cold_arm_saved_nothing",
+                "ttft_p50_handoff_over_cold")},
+        }
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        # kfslint: disable=async-blocking — evidence commit after the
+        # measured waves; every server is already torn down.
+        with open(os.path.join(root, "BENCH_kvhandoff.json"),
+                  "w") as f:
+            # kfslint: disable=async-blocking — same write as above.
+            json.dump(record, f, indent=2)
+        return out
+    finally:
+        # kfslint: disable=async-blocking — final teardown; every
+        # server is already stopped.
+        shutil.rmtree(kv_dir, ignore_errors=True)
+
+
 async def bench_history(smoke: bool) -> Dict[str, Any]:
     """History sampler overhead A/B (ISSUE 17 acceptance): serving
     throughput on the same live server with the ring-TSDB sampler

@@ -135,10 +135,14 @@ p_incidents.add_argument("--json", action="store_true",
                          help="raw wire body instead of the rendered "
                               "digest")
 
-sub.add_parser(
+p_doctor = sub.add_parser(
     "doctor",
     help="one-shot fleet health digest: open incidents with top "
          "hypotheses, trend slopes, latency/MFU/occupancy snapshot")
+p_doctor.add_argument(
+    "--exit-code", action="store_true",
+    help="exit nonzero (2) when any incident is open — makes the "
+         "doctor scriptable as a CI / cron health gate")
 
 p_creds = sub.add_parser(
     "credentials",
@@ -436,8 +440,14 @@ async def _run(args) -> dict:
                     # A partial digest still diagnoses: a replica
                     # without the history ring just loses sparklines.
                     histories[name] = {"_error": str(e)}
-            return {"_rendered": _render_doctor(incidents_body,
-                                                histories)}
+            out = {"_rendered": _render_doctor(incidents_body,
+                                               histories)}
+            if getattr(args, "exit_code", False) and \
+                    (incidents_body.get("open", 0) or 0):
+                # Health-gate mode: open incidents flip the process
+                # exit status so cron/CI wrappers need no parsing.
+                out["_exit_code"] = 2
+            return out
         if args.command == "history":
             labels = None
             if args.labels:
@@ -508,7 +518,7 @@ def main(argv=None) -> int:
         return 1
     if isinstance(result, dict) and "_rendered" in result:
         print(result["_rendered"])
-        return 0
+        return int(result.get("_exit_code", 0))
     print(json.dumps(result, indent=2))
     return 0
 

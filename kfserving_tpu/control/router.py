@@ -1330,12 +1330,23 @@ class IngressRouter:
                                 name, cname, host, set()))
                     # The leading blank line terminates any partial
                     # SSE line the upstream death left dangling, so
-                    # the event always parses as its own event.
-                    yield (b'\n\ndata: {"error": "replica failed '
-                           b'mid-stream; standby promotion in '
-                           b'progress", "finish_reason": "failover", '
-                           b'"retriable": true, '
-                           b'"retry_after_ms": 250}\n\n')
+                    # the event always parses as its own event.  The
+                    # predecessor identity is the KV fetch hint
+                    # (ISSUE 19): the client's retry forwards it
+                    # (x-kfs-kv-peer, which the proxy retry path also
+                    # injects itself) so the successor can pull the
+                    # dead conversation's spilled KV from a surviving
+                    # peer before re-prefilling.
+                    event = json.dumps({
+                        "error": ("replica failed mid-stream; "
+                                  "standby promotion in progress"),
+                        "finish_reason": "failover",
+                        "retriable": True,
+                        "retry_after_ms": 250,
+                        "predecessor": host,
+                    })
+                    yield b"\n\ndata: " + \
+                        event.encode("utf-8") + b"\n\n"
                     return
                 yield (b'\n\ndata: {"error": "upstream stream '
                        b'interrupted", "finish_reason": "error"}\n\n')
@@ -1663,6 +1674,12 @@ class IngressRouter:
                     await self._mark_failed_and_evict(
                         name, cname, host, failed,
                         resolved=resolved)
+                    # Failover fetch hint: the retry attempt names the
+                    # evicted predecessor so the successor can pull
+                    # this session's KV (peer transfer) before it
+                    # re-prefills from scratch.  Last eviction wins —
+                    # that replica's tier holds the freshest chains.
+                    headers["x-kfs-kv-peer"] = f"http://{host}"
                 except aiohttp.ClientError as e:
                     # Mid-request/-response failure (reset after
                     # dispatch, truncated read).  Disambiguate with a
@@ -1699,6 +1716,10 @@ class IngressRouter:
                     await self._mark_failed_and_evict(
                         name, cname, host, failed,
                         resolved=resolved)
+                    # Same fetch hint as the pre-dispatch branch: the
+                    # retry carries the dead replica's address for the
+                    # successor's peer KV pull.
+                    headers["x-kfs-kv-peer"] = f"http://{host}"
                 finally:
                     if held_host is not None:
                         self._host_release(held_host)

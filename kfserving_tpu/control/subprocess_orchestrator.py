@@ -775,6 +775,10 @@ class SubprocessOrchestrator:
         t1 = loop.time()
         await self.delete_replica(replica)
         drain_s = loop.time() - t1
+        # Incumbent gone (drain exported its live KV, exit released
+        # its manifest flock): the successor adopts the generation so
+        # returning conversations fault back instead of re-prefilling.
+        await self._kv_reattach(standby.host)
         # The successor was serving before the incumbent left
         # rotation — no unavailability window.
         self.swap_windows_s.append(0.0)
@@ -835,6 +839,10 @@ class SubprocessOrchestrator:
                 await asyncio.shield(
                     self._terminate(standby.handle.process))
         window = loop.time() - t0
+        if activated:
+            # Outside the announced window (it just cleared): adopt
+            # the drained incumbent's KV generation best-effort.
+            await self._kv_reattach(standby.host)
         self.swap_windows_s.append(round(window, 3))
         self.swap_breakdown.append({
             "mode": "exclusive_standby",
@@ -971,6 +979,30 @@ class SubprocessOrchestrator:
         except Exception:
             return True
 
+    async def _kv_reattach(self, host: str) -> None:
+        """Best-effort: tell a just-promoted successor to rescan the
+        durable KV tier directory for its predecessor's generation.
+        The predecessor's manifest flock releases on ANY process death
+        (SIGKILL included), so by the time the successor is in
+        rotation the adoption can take the orphaned manifest.  Runs
+        AFTER the swap window clears — adoption must never extend
+        unavailability, it only warms the fault-back path.  Failure is
+        non-fatal: without a persistent tier the replica answers with
+        an empty adoption, and a dead endpoint just means the session
+        re-prefills."""
+        import aiohttp
+
+        try:
+            async with aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=5.0)) as s:
+                async with s.post(f"http://{host}/kv/reattach",
+                                  json={}) as resp:
+                    body = await resp.read()
+                    logger.info("kv reattach on %s: %d %s", host,
+                                resp.status, body[:200])
+        except Exception as e:
+            logger.info("kv reattach on %s skipped: %s", host, e)
+
     async def _supervise_crashes(self) -> None:
         """One supervisor pass: replicas whose process exited (or that
         failed health_fail_threshold consecutive probes) are replaced
@@ -1100,6 +1132,13 @@ class SubprocessOrchestrator:
                         loop.time() - t_spawn, 3)
             finally:
                 self.clear_swap(cid)
+            if promoted_host is not None:
+                # Crash failover: the corpse's flock auto-released on
+                # death, so the successor can adopt its durable KV
+                # generation — the returning conversation faults back
+                # instead of paying a full re-prefill.  Best-effort,
+                # after the window clears.
+                await self._kv_reattach(promoted_host)
             phases["total_s"] = round(loop.time() - t0, 3)
             self.promotions += 1
             obs.lifecycle_promotions_total().labels(

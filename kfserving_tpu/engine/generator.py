@@ -1446,6 +1446,202 @@ class GenerationEngine:
                                "ms": round(elapsed_ms, 3)})
         return True
 
+    # -- durable handoff (ISSUE 19): drain parachute & peer import ---------
+    def export_kv(self, budget_s: float = 2.0) -> Dict[str, int]:
+        """Drain parachute: export live slots' device KV blocks plus
+        the hot prefix-index chains into the host tier under a bounded
+        budget, so a successor process (or a peer pulling over
+        /kv/chains) can serve the returning conversations as warm
+        fault-backs instead of full re-prefills.
+
+        BLOCKING — call off the event loop (the server wraps it in
+        run_in_executor on the SIGTERM/announce_swap drain path).  The
+        worker rides the single-worker enqueue executor so its gather
+        dispatches are FIFO-ordered against any still-inflight wave
+        enqueues (the same ordering proof as `_drain_spills`).
+        Deadline-aware: candidates are ordered hottest-first (live
+        slots, then prefix chains by reuse depth) and whatever the
+        budget cannot cover is counted dropped — the export never
+        stretches the swap window."""
+        zeros = {"exported": 0, "skipped": 0, "dropped": 0,
+                 "failed": 0}
+        if self.block_size is None or self.kv_tier is None:
+            return zeros
+        deadline = time.monotonic() + max(0.0, float(budget_s))
+        try:
+            fut = self._enqueue_executor.submit(
+                self._export_kv_worker, deadline)
+        except RuntimeError:
+            return zeros  # executor already shut down
+        return fut.result()
+
+    def _export_kv_worker(self, deadline: float) -> Dict[str, int]:
+        """ENQUEUE-executor side of the drain parachute.  Candidate
+        order is the eviction-value order: live slots first (the
+        conversation is literally mid-flight — its return is the most
+        certain), then registered prefix chains hottest-first by
+        reuse depth.  TRANSACTIONAL per block: the tier index only
+        publishes complete digest-recorded payloads, and the
+        `engine.kv_export` chaos site fails the whole pass BEFORE any
+        tier write (every candidate counted outcome=failed — the
+        drain degrades to the no-handoff baseline)."""
+        import hashlib
+
+        from kfserving_tpu.reliability import fault_sites
+        from kfserving_tpu.reliability.faults import (
+            FaultInjected,
+            faults,
+        )
+
+        out = {"exported": 0, "skipped": 0, "dropped": 0, "failed": 0}
+        t0 = time.perf_counter()
+        bs = self.block_size
+        cand: List[Tuple[bytes, int]] = []
+        seen: set = set()
+        with self._block_lock:
+            for si, s in enumerate(self._slots):
+                if s is None or s.prefilling:
+                    continue
+                ids = s.req.prompt_ids
+                n = int(ids.size)
+                ext = max(0, int(s.length) - n)
+                if ext > 0 and s.tokens:
+                    # The return visit's prompt extends prompt+output,
+                    # so chains over the CONCATENATION are what its
+                    # plan will probe (same int32 bytes _submit
+                    # normalizes to).
+                    allids = np.concatenate(
+                        [ids, np.asarray(s.tokens[:ext], np.int32)])
+                else:
+                    allids = ids
+                full = min(int(s.length), int(allids.size)) // bs
+                chain = b""
+                for c in range(full):
+                    chain = hashlib.blake2b(
+                        chain
+                        + allids[c * bs:(c + 1) * bs].tobytes(),
+                        digest_size=16).digest()
+                    blk = int(self._tables[si, c])
+                    if blk < 0 or chain in seen:
+                        continue
+                    seen.add(chain)
+                    if self.kv_tier.contains(chain):
+                        out["skipped"] += 1
+                        continue
+                    cand.append((chain, blk))
+            hot = sorted(
+                ((self._chain_hits.get(ch, 0), ch, blk)
+                 for ch, blk in self._prefix_index.items()
+                 if ch not in seen),
+                key=lambda t: t[0], reverse=True)
+            for _depth, ch, blk in hot:
+                seen.add(ch)
+                if self.kv_tier.contains(ch):
+                    out["skipped"] += 1
+                    continue
+                cand.append((ch, blk))
+        try:
+            if cand and faults.configured(fault_sites.ENGINE_KV_EXPORT):
+                faults.inject_sync(fault_sites.ENGINE_KV_EXPORT,
+                                   key=self.name)
+        except FaultInjected:
+            # Chaos: the whole pass fails BEFORE any tier write.
+            out["failed"] = len(cand)
+            cand = []
+        jnp = self._jnp
+        for i in range(0, len(cand), 32):
+            if time.monotonic() >= deadline:
+                # Budget exhausted: the remaining (coldest) tail is
+                # dropped, honestly counted — never stall the swap.
+                out["dropped"] += len(cand) - i
+                break
+            grp = cand[i:i + 32]
+            padded = 1
+            while padded < len(grp):
+                padded *= 2
+            idx = np.asarray(
+                [b for _, b in grp]
+                + [grp[0][1]] * (padded - len(grp)), np.int32)
+            try:
+                self._note_program("kv_gather", padded)
+                snap = self._gather_blocks(self._caches,
+                                           jnp.asarray(idx))
+                with sanitizer.sanctioned_fetch():
+                    # kfslint: disable=host-sync — sanctioned fetch
+                    # site: the drain parachute's D2H join, off-loop
+                    # on the enqueue executor during the swap window.
+                    host = [(np.asarray(k), np.asarray(v))
+                            for k, v in snap]
+            except Exception:
+                logger.exception("kv export gather failed")
+                out["failed"] += len(grp)
+                continue
+            for row, (chain, _blk) in enumerate(grp):
+                payload = b"".join(
+                    part for k, v in host
+                    for part in (k[row].tobytes(), v[row].tobytes()))
+                out["exported" if self.kv_tier.put(chain, payload)
+                    else "failed"] += 1
+        elapsed_ms = (time.perf_counter() - t0) * 1000.0
+        for outcome, count in out.items():
+            if count:
+                obs.kv_handoff_exported_blocks_total().labels(
+                    model=self.name, outcome=outcome).inc(count)
+        obs.kv_handoff_export_ms().labels(
+            model=self.name).observe(elapsed_ms)
+        TIMELINE.record("host", "kv.export",
+                        attrs={**out, "ms": round(elapsed_ms, 3)})
+        if any(out.values()):
+            logger.info(
+                "kv export (%s): exported=%d skipped=%d dropped=%d "
+                "failed=%d in %.1fms", self.name, out["exported"],
+                out["skipped"], out["dropped"], out["failed"],
+                elapsed_ms)
+        return out
+
+    def kv_import(self, pairs: List[Tuple[bytes, bytes]]
+                  ) -> Dict[str, int]:
+        """Admit peer-transferred (chain, payload) pairs into the host
+        tier (the /kv/reattach pull path; payloads were already
+        digest-verified against the wire header by the server).
+        BLOCKING but dispatch-free — plain tier writes, safe from any
+        executor thread.  TRANSACTIONAL: the `engine.kv_import` chaos
+        site rejects the whole batch BEFORE any tier publication
+        (every pair counted outcome=failed), so a failed import
+        leaves the tier untouched and the returning turn degrades to
+        a clean re-prefill."""
+        from kfserving_tpu.reliability import fault_sites
+        from kfserving_tpu.reliability.faults import (
+            FaultInjected,
+            faults,
+        )
+
+        out = {"imported": 0, "skipped": 0, "failed": 0}
+        if self.block_size is None or self.kv_tier is None or \
+                not pairs:
+            return out
+        try:
+            if faults.configured(fault_sites.ENGINE_KV_IMPORT):
+                faults.inject_sync(fault_sites.ENGINE_KV_IMPORT,
+                                   key=self.name)
+        except FaultInjected:
+            out["failed"] = len(pairs)
+            obs.kv_handoff_peer_blocks_total().labels(
+                model=self.name, outcome="failed").inc(len(pairs))
+            return out
+        for chain, payload in pairs:
+            if self.kv_tier.contains(chain):
+                out["skipped"] += 1
+                continue
+            out["imported" if self.kv_tier.put(chain, payload)
+                else "failed"] += 1
+        for outcome, count in out.items():
+            if count:
+                obs.kv_handoff_peer_blocks_total().labels(
+                    model=self.name, outcome=outcome).inc(count)
+        TIMELINE.record("host", "kv.import", attrs=dict(out))
+        return out
+
     def _plan_prompt_blocks(self, req: _Request, slot: int,
                             chunk_regs: Optional[Dict[int, Tuple[
                                 bytes, int]]] = None,

@@ -11,7 +11,7 @@ device index → host tier → re-prefill.  A warm host fault is one mmap
 read + one jitted pool insert (milliseconds) versus a multi-second
 re-prefill of a long history.
 
-Robustness contract (the point of this module, per ISSUE 16):
+Robustness contract (the point of this module, per ISSUEs 16 and 19):
 
 - **Transactional spill**: the in-memory index entry publishes only
   AFTER the slot's full payload is written — a half-spilled chain can
@@ -29,16 +29,39 @@ Robustness contract (the point of this module, per ISSUE 16):
 - **Single-flight fault-in**: `begin_fault` refcounts in-flight chains;
   concurrent returning turns coalesce on the same physical read
   (counted as outcome=coalesced).
-- **Observable**: occupancy/spill/fault registry families, a `debug()`
+- **Durable handoff (ISSUE 19)**: under a persistent directory
+  (`KFS_KV_TIER_DIR` / an explicit `directory=`), each process writes
+  its payload file plus a versioned, crash-safe JSONL *manifest*
+  (`kv_tier-<model>-<nonce>.manifest`) and holds an exclusive
+  `flock` on it for its lifetime.  The flock IS the liveness
+  authority: it releases on ANY process death, including SIGKILL.  A
+  successor process (armed standby, promoted crash-failover survivor,
+  or plain restart) adopts every unlocked generation it finds — every
+  entry is digest-verified against the manifest record before
+  admission, torn/truncated/corrupt/version-skewed entries drop
+  individually (never served, never crash the boot), and the drained
+  generation's files self-delete.  Ephemeral tiers (no directory
+  given) keep the pre-ISSUE-19 behavior: a private tempdir, no
+  manifest, nothing survives the process.
+- **Observable**: occupancy/spill/fault registry families plus the
+  `kv_handoff_reattached_blocks_total` adoption outcomes, a `debug()`
   block federated under `/debug/cache`, and a flight-recorder pin when
-  fault-backs storm (`KFS_KV_TIER_STORM_*` — a storm means the device
-  pool is churning conversations through the tier faster than they
-  finish, the thrash evidence an operator needs pinned).
+  fault-backs storm (`KFS_KV_TIER_STORM_*`).
 
 Storage follows PR 7's param-cache mmap discipline: page-aligned slot
 stride, one preallocated file, read-only consumers never see torn
-writes (publication is the in-memory index, which dies with the
-process — the file carries no cross-restart authority).
+writes (publication is the in-memory index; in persistent mode the
+manifest record lands BEFORE the index publishes, so the on-disk view
+never claims a chain whose payload isn't fully written — a crash
+between payload write and manifest append leaves an unreferenced slot,
+and a crash mid-append leaves a torn JSON line the replay skips).
+
+Path containment (ISSUE 19 satellite): the configured directory is
+resolved once; every file this module creates, reads, or deletes is
+containment-checked against that resolved root — a symlink smuggled
+into the tier dir cannot steer a delete outside it, and a
+non-directory target fails construction with a clear error instead of
+a traceback from mmap.
 
 Threading: `put()` runs on the engine's fetch executor, `read()` on the
 enqueue executor, `contains`/`begin_fault` on the scheduler loop — all
@@ -47,6 +70,9 @@ mmap happens under it (slots are small: one block's k/v).  Nothing here
 ever runs ON the scheduler loop thread except dict probes.
 """
 
+import fcntl
+import hashlib
+import json
 import logging
 import mmap
 import os
@@ -54,7 +80,7 @@ import tempfile
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 from kfserving_tpu.observability import metrics as obs
 
@@ -70,12 +96,33 @@ _ALIGN = 4096
 # a tenant that evicts it.
 _HOST_MEM_FRACTION = 0.5
 
+# Manifest record schema version.  Replay skips records whose `v`
+# differs (counted as version_skew) — a rolling upgrade where old and
+# new replicas share one tier dir drops only the unreadable entries.
+_MANIFEST_V = 1
+
+# Payload digests are 16-byte blake2b — same construction as the
+# prefix-index chain digests, so verification cost stays proportional
+# to one block's bytes.
+_DIGEST_SIZE = 16
+
+_ADOPT_OUTCOMES = ("adopted", "duplicate", "corrupt", "truncated",
+                   "torn", "version_skew", "dropped_capacity",
+                   "failed")
+
 
 def _env_int(name: str, default: int) -> int:
     try:
         return int(float(os.environ.get(name, default)))
     except (TypeError, ValueError):
         return default
+
+
+def payload_digest(payload: bytes) -> str:
+    """Hex digest a block payload is verified against: on manifest
+    replay, on peer-transfer receipt (`/kv/chains/<chain>`), and in
+    the response header the peer endpoint serves."""
+    return hashlib.blake2b(payload, digest_size=_DIGEST_SIZE).hexdigest()
 
 
 class HostKVTier:
@@ -85,7 +132,7 @@ class HostKVTier:
     all layers; `capacity_blocks` bounds the ledger (clamped against
     available host memory).  The tier never touches device state — the
     engine owns gather/insert dispatches; this class owns bytes,
-    the LRU index, and the telemetry.
+    the LRU index, the durable manifest, and the telemetry.
     """
 
     def __init__(self, *, block_bytes: int, capacity_blocks: int,
@@ -117,11 +164,34 @@ class HostKVTier:
                 capacity_blocks = max_blocks
         self.capacity_blocks = max(1, capacity_blocks)
 
+        # A caller-provided directory means the tier is PERSISTENT:
+        # its files outlive this process for a successor to adopt.  No
+        # directory means the pre-ISSUE-19 ephemeral tempdir.
         self._owns_dir = directory is None
-        directory = directory or tempfile.mkdtemp(
-            prefix=f"kfs-kvtier-{model}-")
+        self.persistent = directory is not None
+        if directory is not None:
+            directory = os.path.realpath(directory)
+            if os.path.exists(directory) and \
+                    not os.path.isdir(directory):
+                raise ValueError(
+                    f"KV tier dir {directory!r} exists and is not a "
+                    "directory — point KFS_KV_TIER_DIR (or the "
+                    "model's host_tier_dir) at a directory path")
+        else:
+            directory = os.path.realpath(tempfile.mkdtemp(
+                prefix=f"kfs-kvtier-{model}-"))
         os.makedirs(directory, exist_ok=True)
-        self.path = os.path.join(directory, "kv_tier.bin")
+        self.directory = directory
+
+        if self.persistent:
+            # Per-process generation naming: pid + random nonce, so
+            # two replicas sharing the dir never collide and a
+            # successor can tell its own files from a predecessor's.
+            nonce = f"{os.getpid():x}-{os.urandom(4).hex()}"
+            base = f"kv_tier-{model}-{nonce}"
+        else:
+            base = "kv_tier"
+        self.path = os.path.join(directory, base + ".bin")
         size = self.capacity_blocks * self.slot_bytes
         fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o600)
         try:
@@ -139,6 +209,37 @@ class HostKVTier:
         self._inflight: Dict[bytes, int] = {}
         self._closed = False
 
+        # -- durable manifest (persistent mode only) -------------------
+        self._manifest_path = os.path.join(
+            directory, base + ".manifest")
+        self._mfd: Optional[int] = None
+        self._digests: Dict[bytes, str] = {}
+        self._manifest_records = 0
+        self.manifest_failures = 0
+        # Compaction bound: the manifest is append-only, so a
+        # long-lived churny tier would grow it without this.
+        self._manifest_max_records = max(
+            1024, 8 * self.capacity_blocks)
+        if self.persistent:
+            self._mfd = os.open(
+                self._manifest_path,
+                os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o600)
+            # The flock IS the liveness authority for adoption: held
+            # for this process's lifetime, auto-released on any death
+            # (SIGKILL included) — a successor that can take it knows
+            # the generation is orphaned.
+            fcntl.flock(self._mfd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            header = {
+                "kind": "kfs-kv-tier", "v": _MANIFEST_V,
+                "model": self.model,
+                "block_bytes": self.block_bytes,
+                "slot_bytes": self.slot_bytes,
+                "capacity_blocks": self.capacity_blocks,
+            }
+            os.write(self._mfd,
+                     (json.dumps(header) + "\n").encode("utf-8"))
+            self._manifest_records = 1
+
         # -- counters (ints under the lock; registry twins emitted at
         # the event site) ----------------------------------------------
         self.spills = 0
@@ -153,6 +254,14 @@ class HostKVTier:
         #                            fault-back (presumed unusable)
         self._fault_ms: deque = deque(maxlen=512)
 
+        # Lifetime adoption tallies (per-outcome block counts plus
+        # generation-level bookkeeping), surfaced in debug().
+        self.handoff: Dict[str, int] = {
+            k: 0 for k in _ADOPT_OUTCOMES}
+        self.handoff["generations_adopted"] = 0
+        self.handoff["generations_live"] = 0
+        self.handoff["generations_rejected"] = 0
+
         # -- fault-back storm detection (flight-recorder pin) ----------
         self.storm_window_s = float(os.environ.get(
             "KFS_KV_TIER_STORM_WINDOW_S", "10"))
@@ -161,6 +270,13 @@ class HostKVTier:
         self._fault_times: deque = deque(maxlen=1024)
         self._storm_pinned_at = 0.0
         self._flight_recorder = None
+
+        if self.persistent:
+            # Boot-time adoption: drain every orphaned predecessor
+            # generation in the shared dir (exclusive-swap successors
+            # and plain restarts get their warm chains here; warm
+            # swaps and crash promotions re-scan via reattach()).
+            self._adopt_generations()
 
     # -- wiring ------------------------------------------------------------
     def attach_flight_recorder(self, recorder) -> None:
@@ -172,6 +288,12 @@ class HostKVTier:
     def contains(self, chain: bytes) -> bool:
         with self._lock:
             return chain in self._index
+
+    def chains(self) -> List[str]:
+        """Hex chain digests currently resident (MRU last) — the
+        peer-transfer index `GET /kv/chains` serves."""
+        with self._lock:
+            return [c.hex() for c in self._index]
 
     def begin_fault(self, chain: bytes) -> bool:
         """Mark `chain` in-flight for fault-back (single-flight
@@ -198,10 +320,88 @@ class HostKVTier:
             else:
                 self._inflight[chain] = n
 
+    # -- path containment (ISSUE 19 satellite) -----------------------------
+    def _contained(self, path: str) -> bool:
+        """True when `path` resolves inside the tier directory — the
+        gate every unlink/rename candidate passes before the
+        filesystem call (a symlink planted in a shared tier dir must
+        not steer a delete outside it)."""
+        try:
+            rp = os.path.realpath(path)
+            return os.path.commonpath(
+                [rp, self.directory]) == self.directory
+        except (OSError, ValueError):
+            return False
+
+    # -- durable manifest --------------------------------------------------
+    def _manifest_append_locked(self, record: Dict[str, Any]) -> None:
+        """Append one record (caller holds the lock).  A failed append
+        is non-fatal — the in-memory tier keeps serving; the entry
+        just won't survive a handoff (counted)."""
+        if self._mfd is None:
+            return
+        try:
+            os.write(self._mfd,
+                     (json.dumps(record) + "\n").encode("utf-8"))
+            self._manifest_records += 1
+            if self._manifest_records > self._manifest_max_records:
+                self._compact_manifest_locked()
+        except OSError:
+            self.manifest_failures += 1
+
+    def _compact_manifest_locked(self) -> None:
+        """Rewrite the manifest as header + one put per live entry.
+        The tmp file is flocked BEFORE the rename so there is no
+        instant where the published manifest is unlocked (a scanning
+        successor would otherwise adopt a live generation)."""
+        tmp = self._manifest_path + ".tmp"
+        if not (self._contained(tmp)
+                and self._contained(self._manifest_path)):
+            self.manifest_failures += 1
+            return
+        header = {
+            "kind": "kfs-kv-tier", "v": _MANIFEST_V,
+            "model": self.model,
+            "block_bytes": self.block_bytes,
+            "slot_bytes": self.slot_bytes,
+            "capacity_blocks": self.capacity_blocks,
+        }
+        lines = [json.dumps(header)]
+        for chain, slot in self._index.items():
+            digest = self._digests.get(chain)
+            if digest is None:
+                continue
+            lines.append(json.dumps({
+                "op": "put", "v": _MANIFEST_V, "chain": chain.hex(),
+                "slot": slot, "digest": digest}))
+        fd = os.open(tmp, os.O_RDWR | os.O_CREAT | os.O_TRUNC
+                     | os.O_APPEND, 0o600)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            os.write(fd, ("\n".join(lines) + "\n").encode("utf-8"))
+            os.replace(tmp, self._manifest_path)
+        except OSError:
+            self.manifest_failures += 1
+            os.close(fd)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return
+        old = self._mfd
+        self._mfd = fd
+        self._manifest_records = len(lines)
+        if old is not None:
+            try:
+                os.close(old)
+            except OSError:
+                pass
+
     # -- spill (fetch-executor thread) -------------------------------------
     def put(self, chain: bytes, payload: bytes) -> bool:
         """Admit one block's payload.  Transactional: the index entry
-        publishes only after the slot holds the complete payload, so a
+        publishes only after the slot holds the complete payload (and,
+        in persistent mode, after the manifest records it), so a
         failure at any point leaves the tier without the chain (the
         eviction that produced it degrades to a plain drop).  Returns
         False on failure; never raises."""
@@ -226,6 +426,16 @@ class HostKVTier:
                         "kv tier full: every entry is mid-fault-in")
                 off = slot * self.slot_bytes
                 self._mm[off:off + self.block_bytes] = payload
+                if self.persistent:
+                    digest = payload_digest(payload)
+                    self._digests[chain] = digest
+                    # Record BEFORE publication: the on-disk view
+                    # never claims a chain whose payload isn't fully
+                    # written (replay digest-verifies regardless).
+                    self._manifest_append_locked({
+                        "op": "put", "v": _MANIFEST_V,
+                        "chain": chain.hex(), "slot": slot,
+                        "digest": digest})
                 # Publication point: a reader can only find the chain
                 # AFTER the full payload landed.
                 self._index[chain] = slot
@@ -266,6 +476,13 @@ class HostKVTier:
                     model=self.model, reason="skipped_inflight").inc()
                 continue
             slot = self._index.pop(chain)
+            self._digests.pop(chain, None)
+            # No drop record: the put that triggered this eviction
+            # writes a put record for the SAME slot, and replay is
+            # last-writer-wins per slot — the evicted chain is
+            # superseded on disk the moment the admission lands.  A
+            # crash in between leaves a record whose payload digest
+            # no longer matches; replay drops it as corrupt.
             self.evictions += 1
             obs.generator_kv_tier_evictions_total().labels(
                 model=self.model, reason="capacity").inc()
@@ -314,10 +531,273 @@ class HostKVTier:
             if slot is None:
                 return
             self._free.append(slot)
+            self._digests.pop(chain, None)
+            if self.persistent:
+                self._manifest_append_locked({
+                    "op": "drop", "v": _MANIFEST_V,
+                    "chain": chain.hex()})
             self.dropped += 1
         obs.generator_kv_tier_evictions_total().labels(
             model=self.model, reason="faultback_failed").inc()
         self._publish_occupancy()
+
+    # -- durable handoff: adopting predecessor generations -----------------
+    def reattach(self) -> Dict[str, int]:
+        """Re-scan the tier dir and adopt any orphaned predecessor
+        generation (POST /kv/reattach; the orchestrator calls it on
+        the successor after a warm swap or crash promotion).  Returns
+        this invocation's per-outcome block tallies.  No-op for
+        ephemeral tiers."""
+        if not self.persistent:
+            return {}
+        return self._adopt_generations()
+
+    def _adopt_generations(self) -> Dict[str, int]:
+        out: Dict[str, int] = {k: 0 for k in _ADOPT_OUTCOMES}
+        out["generations_adopted"] = 0
+        out["generations_live"] = 0
+        out["generations_rejected"] = 0
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return out
+        own = os.path.realpath(self._manifest_path)
+        for name in names:
+            if not (name.startswith("kv_tier-")
+                    and name.endswith(".manifest")):
+                continue
+            mpath = os.path.join(self.directory, name)
+            if os.path.realpath(mpath) == own:
+                continue
+            if not self._contained(mpath):
+                out["generations_rejected"] += 1
+                continue
+            self._adopt_one(mpath, out)
+        for outcome in _ADOPT_OUTCOMES:
+            if out[outcome]:
+                obs.kv_handoff_reattached_blocks_total().labels(
+                    model=self.model, outcome=outcome).inc(
+                        out[outcome])
+        with self._lock:
+            for k, v in out.items():
+                self.handoff[k] = self.handoff.get(k, 0) + v
+        if out["adopted"] or out["generations_rejected"] or any(
+                out[k] for k in ("corrupt", "truncated", "torn",
+                                 "version_skew")):
+            logger.info(
+                "kv tier handoff (%s): adopted=%d duplicate=%d "
+                "corrupt=%d truncated=%d torn=%d version_skew=%d "
+                "dropped_capacity=%d generations=%d/%d live=%d",
+                self.model, out["adopted"], out["duplicate"],
+                out["corrupt"], out["truncated"], out["torn"],
+                out["version_skew"], out["dropped_capacity"],
+                out["generations_adopted"],
+                out["generations_adopted"]
+                + out["generations_rejected"],
+                out["generations_live"])
+        recorder = self._flight_recorder
+        if recorder is not None and (
+                out["adopted"] or out["generations_rejected"]):
+            try:
+                recorder.record({
+                    "kind": "kv_handoff_reattach",
+                    "model": self.model, **out,
+                }, pin="kv_handoff_reattach")
+            except Exception:
+                pass
+        return out
+
+    def _adopt_one(self, mpath: str, out: Dict[str, int]) -> None:
+        """Adopt (or discard) one foreign generation.  The flock probe
+        decides everything: held → the owner is alive, skip entirely;
+        acquired → the generation is orphaned, drain it and delete its
+        files.  Every admitted payload is digest-verified first."""
+        try:
+            fd = os.open(mpath, os.O_RDWR)
+        except OSError:
+            return
+        try:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                # Owner alive (another replica of this model sharing
+                # the dir) — its generation is not ours to touch.
+                out["generations_live"] += 1
+                os.close(fd)
+                return
+            try:
+                with open(fd, "r", encoding="utf-8",
+                          errors="replace", closefd=False) as f:
+                    lines = f.read().splitlines()
+            except OSError:
+                lines = []
+            header = None
+            if lines:
+                try:
+                    header = json.loads(lines[0])
+                except (ValueError, TypeError):
+                    header = None
+            if (not isinstance(header, dict)
+                    or header.get("kind") != "kfs-kv-tier"):
+                # Unrecognizable generation: self-delete (torn header
+                # from a crash mid-create, or junk in the dir).
+                out["generations_rejected"] += 1
+                self._discard_generation(mpath)
+                return
+            if header.get("model") != self.model:
+                # Another model's tier sharing the dir — not ours.
+                return
+            if header.get("v") != _MANIFEST_V:
+                out["generations_rejected"] += 1
+                out["version_skew"] += max(0, len(lines) - 1)
+                self._discard_generation(mpath)
+                return
+            if header.get("block_bytes") != self.block_bytes:
+                # Geometry changed across the restart (model config
+                # edit): payloads are uninterpretable — discard.
+                out["generations_rejected"] += 1
+                self._discard_generation(mpath)
+                return
+            try:
+                foreign_stride = int(header.get(
+                    "slot_bytes", self.slot_bytes))
+            except (TypeError, ValueError):
+                foreign_stride = self.slot_bytes
+            state = self._replay_records(lines[1:], out)
+            if state:
+                self._admit_entries(mpath, foreign_stride, state, out)
+            out["generations_adopted"] += 1
+            self._discard_generation(mpath)
+        finally:
+            try:
+                os.close(fd)  # releases the flock last
+            except OSError:
+                pass
+
+    @staticmethod
+    def _replay_records(lines: List[str],
+                        out: Dict[str, int]) -> "OrderedDict":
+        """Last-writer-wins replay, keyed per chain AND per slot: a
+        later put to the same slot supersedes the earlier chain (how
+        evictions are represented without drop records), and a drop
+        removes the chain.  Torn JSON lines (crash mid-append) and
+        version-skewed records each drop only themselves."""
+        state: "OrderedDict[bytes, Any]" = OrderedDict()
+        slot_owner: Dict[int, bytes] = {}
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except (ValueError, TypeError):
+                out["torn"] += 1
+                continue
+            if not isinstance(rec, dict):
+                out["torn"] += 1
+                continue
+            if rec.get("v") != _MANIFEST_V:
+                out["version_skew"] += 1
+                continue
+            op = rec.get("op")
+            try:
+                if op == "put":
+                    chain = bytes.fromhex(rec["chain"])
+                    slot = int(rec["slot"])
+                    digest = str(rec["digest"])
+                    prev = slot_owner.get(slot)
+                    if prev is not None and prev != chain:
+                        state.pop(prev, None)
+                    state.pop(chain, None)
+                    state[chain] = (slot, digest)
+                    slot_owner[slot] = chain
+                elif op == "drop":
+                    chain = bytes.fromhex(rec["chain"])
+                    old = state.pop(chain, None)
+                    if old is not None and \
+                            slot_owner.get(old[0]) == chain:
+                        slot_owner.pop(old[0], None)
+                else:
+                    out["torn"] += 1
+            except (KeyError, ValueError, TypeError):
+                out["torn"] += 1
+        return state
+
+    def _admit_entries(self, mpath: str, foreign_stride: int,
+                       state: "OrderedDict",
+                       out: Dict[str, int]) -> None:
+        bin_path = mpath[:-len(".manifest")] + ".bin"
+        if not self._contained(bin_path):
+            out["truncated"] += len(state)
+            return
+        try:
+            bf = open(bin_path, "rb")
+        except OSError:
+            # Payload file gone: every surviving record is unservable.
+            out["truncated"] += len(state)
+            return
+        try:
+            try:
+                bin_size = os.fstat(bf.fileno()).st_size
+            except OSError:
+                bin_size = 0
+            # Manifest order is admission order, so iterating it keeps
+            # the predecessor's LRU shape: the hottest (most recently
+            # put) chains land last and become our MRU.
+            for chain, (slot, digest) in state.items():
+                off = slot * foreign_stride
+                if off + self.block_bytes > bin_size:
+                    out["truncated"] += 1
+                    continue
+                try:
+                    bf.seek(off)
+                    payload = bf.read(self.block_bytes)
+                except OSError:
+                    out["truncated"] += 1
+                    continue
+                if len(payload) != self.block_bytes:
+                    out["truncated"] += 1
+                    continue
+                if payload_digest(payload) != digest:
+                    out["corrupt"] += 1
+                    continue
+                with self._lock:
+                    if self._closed:
+                        out["failed"] += 1
+                        continue
+                    if chain in self._index:
+                        out["duplicate"] += 1
+                        continue
+                    if not self._free:
+                        # Adoption never evicts our own live entries —
+                        # the successor's working set outranks the
+                        # predecessor's cold tail.
+                        out["dropped_capacity"] += 1
+                        continue
+                    slot2 = self._free.popleft()
+                    off2 = slot2 * self.slot_bytes
+                    self._mm[off2:off2 + self.block_bytes] = payload
+                    self._digests[chain] = digest
+                    self._manifest_append_locked({
+                        "op": "put", "v": _MANIFEST_V,
+                        "chain": chain.hex(), "slot": slot2,
+                        "digest": digest})
+                    self._index[chain] = slot2
+                out["adopted"] += 1
+        finally:
+            bf.close()
+        self._publish_occupancy()
+
+    def _discard_generation(self, mpath: str) -> None:
+        """Delete one foreign generation's files (containment-checked:
+        nothing outside the tier dir is ever unlinked)."""
+        for path in (mpath, mpath[:-len(".manifest")] + ".bin"):
+            if not self._contained(path):
+                continue
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
     # -- storm pin ---------------------------------------------------------
     def _note_storm(self, blocks: int) -> None:
@@ -386,6 +866,10 @@ class HostKVTier:
                 "eviction_skips": self.eviction_skips,
                 "dropped": self.dropped,
                 "faultback_ms": {"p50": pct(0.50), "p99": pct(0.99)},
+                "persistent": self.persistent,
+                "manifest_records": self._manifest_records,
+                "manifest_failures": self.manifest_failures,
+                "handoff": dict(self.handoff),
             }
 
     def close(self) -> None:
@@ -395,10 +879,20 @@ class HostKVTier:
             self._closed = True
             self._index.clear()
             self._inflight.clear()
+            self._digests.clear()
             try:
                 self._mm.close()
             except Exception:
                 pass
+            if self._mfd is not None:
+                try:
+                    os.close(self._mfd)  # releases the flock
+                except OSError:
+                    pass
+                self._mfd = None
+        if self.persistent:
+            # The whole point: files STAY for the successor to adopt.
+            return
         try:
             os.unlink(self.path)
             if self._owns_dir:

@@ -246,6 +246,59 @@ def generator_kv_tier_tokens_saved_total():
         "distinct so the drop-vs-spill economics stay attributable")
 
 
+# -- KV handoff (ISSUE 19): conversation state surviving the replica
+# process — drain-parachute exports, manifest re-attach adoption, and
+# the replica-to-replica peer transfer path ---------------------------
+def kv_handoff_exported_blocks_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_kv_handoff_exported_blocks_total",
+        "Device KV blocks offered to the durable host tier by the "
+        "drain parachute (SIGTERM / swap-window export of live slots "
+        "and hot prefix chains), by outcome: exported = payload "
+        "landed in the tier; skipped = already host-resident; "
+        "dropped = the drain budget deadline passed first (hottest-"
+        "first order, so drops are the coldest tail — counted, never "
+        "hidden); failed = the export machinery failed (chaos site "
+        "engine.kv_export or a gather/fetch error)")
+
+
+def kv_handoff_reattached_blocks_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_kv_handoff_reattached_blocks_total",
+        "Predecessor-generation tier entries processed on re-attach "
+        "(boot-time adoption or POST /kv/reattach), by outcome: "
+        "adopted = digest-verified and admitted as a warm fault-"
+        "back; duplicate = already resident; corrupt = payload "
+        "digest mismatch (entry self-deleted, never served); "
+        "truncated = payload file short of the recorded slot; torn "
+        "= unparseable manifest line (crash mid-append); "
+        "version_skew = record schema version unknown to this "
+        "build; dropped_capacity = adoption never evicts the "
+        "successor's own live entries; failed = admission failed")
+
+
+def kv_handoff_peer_blocks_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_kv_handoff_peer_blocks_total",
+        "KV blocks pulled over the replica-to-replica transfer path "
+        "(GET /kv/chains/<chain> on the predecessor named by the "
+        "router's failover hint), by outcome: imported = digest-"
+        "verified on receipt and admitted; digest_mismatch = wire "
+        "payload failed verification (discarded, never served); "
+        "skipped = already resident locally; failed = fetch error "
+        "or the engine.kv_import chaos site (the turn degrades to a "
+        "clean re-prefill)")
+
+
+def kv_handoff_export_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_kv_handoff_export_ms",
+        "Wall time of one drain-parachute export pass (gather + D2H "
+        "fetch + tier writes for all surviving candidates) — must "
+        "sit inside the drain budget (KFS_KV_EXPORT_BUDGET_S), "
+        "never stretch the swap window")
+
+
 def generator_prefix_reuse_depth_hits():
     return REGISTRY.histogram(
         "kfserving_tpu_generator_prefix_reuse_depth_hits",

@@ -84,6 +84,22 @@ SITES: Dict[str, tuple] = {
         "error fails the read BEFORE any pool insert dispatches, "
         "proving the admission plan rolls back and the turn falls "
         "through to a normal re-prefill with bit-exact generation"),
+    "ENGINE_KV_EXPORT": (
+        "engine.kv_export",
+        "GenerationEngine drain-parachute export of live-slot and "
+        "hot prefix-chain KV into the durable host tier, keyed by "
+        "engine name — an injected error fails the export BEFORE "
+        "any tier write, proving the drain degrades to the no- "
+        "handoff baseline (every candidate counted outcome=failed) "
+        "and the returning conversation re-prefills bit-exact"),
+    "ENGINE_KV_IMPORT": (
+        "engine.kv_import",
+        "GenerationEngine admission of peer-transferred KV payloads "
+        "(the /kv/reattach pull path), keyed by engine name — an "
+        "injected error rejects the batch BEFORE any tier "
+        "publication, proving a failed import leaves the tier "
+        "untouched and the turn degrades to a clean re-prefill with "
+        "bit-exact output"),
     "OBSERVABILITY_HISTORY_TICK": (
         "observability.history_tick",
         "HistorySampler background tick (probed via the async hook "
@@ -123,5 +139,7 @@ ENGINE_RESIDENCY_SWAP = "engine.residency_swap"
 ROUTER_AFFINITY_PICK = "router.affinity_pick"
 ENGINE_KV_SPILL = "engine.kv_spill"
 ENGINE_KV_FAULTBACK = "engine.kv_faultback"
+ENGINE_KV_EXPORT = "engine.kv_export"
+ENGINE_KV_IMPORT = "engine.kv_import"
 OBSERVABILITY_HISTORY_TICK = "observability.history_tick"
 OBSERVABILITY_INCIDENT_OPEN = "observability.incident_open"

@@ -247,6 +247,13 @@ class ModelServer:
         # touching) model load; set via standby_model().
         self._standby_fn = None
         self._standby_state = "none"  # none | armed | activating | done
+        # Durable KV handoff (ISSUE 19): single-flight peer pulls —
+        # the router's x-kfs-kv-peer retry hint can arrive on many
+        # concurrent failover retries at once; one pull per
+        # predecessor serves them all (the lock serializes, the set
+        # dedups for the process life).
+        self._kv_peer_lock = asyncio.Lock()
+        self._kv_peers_pulled: set = set()
 
     def standby_model(self, activate_fn) -> None:
         """Arm standby mode: the server starts with NO model and
@@ -308,6 +315,16 @@ class ModelServer:
         # boots with imports/download done but the device untouched;
         # the orchestrator POSTs here once the old chip owner exits.
         r.add("POST", "/standby/activate", self._standby_activate)
+        # Durable KV handoff (ISSUE 19): the peer-transfer surface.
+        # The chain index, single-chain payload pulls (digest header
+        # verified by the receiver), and the re-attach trigger — a
+        # bare POST re-scans the persistent tier dir for orphaned
+        # predecessor generations; a body naming a peer pulls its
+        # resident chains over HTTP instead (the disaggregation
+        # substrate ROADMAP item 3 names).
+        r.add("GET", "/kv/chains", self._kv_chains)
+        r.add("GET", "/kv/chains/{chain}", self._kv_chain_payload)
+        r.add("POST", "/kv/reattach", self._kv_reattach)
         # Online monitoring surface (ISSUE 3): SLO health the router
         # federates, and the flight recorder's recent/pinned request
         # timelines.
@@ -566,6 +583,11 @@ class ModelServer:
         return _json(response)
 
     async def _generate(self, req: Request) -> Response:
+        # Failover fetch hint (ISSUE 19): warm the tier from the
+        # predecessor before dispatch — one single-flight pull per
+        # peer; the set probe makes the steady-state cost zero.
+        await self._maybe_peer_import(req.headers,
+                                      req.path_params["name"])
         # Cheap pre-scan avoids a duplicate json.loads on the hot
         # non-streaming path (_inference decodes the body itself).
         if b'"stream"' in req.body:
@@ -588,6 +610,7 @@ class ModelServer:
         )
 
         name = req.path_params["name"]
+        await self._maybe_peer_import(req.headers, name)
         rid = ensure_request_id(req.headers)
         # Budget applies to submission AND rides into the engine
         # request (captured at submit): a stream whose budget expires
@@ -737,6 +760,277 @@ class ModelServer:
             "param_source": getattr(model, "param_source", None),
             "phases": startup.phases(),
         })
+
+    # -- durable KV handoff (ISSUE 19) -------------------------------------
+    def _kv_tier_models(self, name: Optional[str] = None):
+        """(model, engine, tier) triples for every registered model
+        with a host KV tier (optionally filtered by model name)."""
+        out = []
+        for model in self.repository.get_models():
+            if name is not None and model.name != name:
+                continue
+            engine = getattr(model, "engine", None)
+            tier = getattr(engine, "kv_tier", None)
+            if tier is not None:
+                out.append((model, engine, tier))
+        return out
+
+    async def _kv_chains(self, req: Request) -> Response:
+        """Peer-transfer index: every host-tier-resident chain digest
+        per model, with the block geometry a puller needs to validate
+        compatibility before moving payload bytes."""
+        name = req.query.get("model")
+        models: Dict[str, Any] = {}
+        for model, _engine, tier in self._kv_tier_models(name):
+            models[model.name] = {
+                "block_bytes": tier.block_bytes,
+                "chains": tier.chains(),
+            }
+        return _json({"models": models})
+
+    async def _kv_chain_payload(self, req: Request) -> Response:
+        """One chain's block payload, streamed to a pulling peer.
+        The digest header lets the receiver verify the bytes before
+        admission — a corrupted transfer is discarded there, never
+        served."""
+        from kfserving_tpu.engine.kv_tier import payload_digest
+
+        chain_hex = req.path_params["chain"]
+        try:
+            chain = bytes.fromhex(chain_hex)
+        except ValueError:
+            return _json({"error": "chain must be a hex digest"},
+                         status=400)
+        name = req.query.get("model")
+        loop = asyncio.get_running_loop()
+        for model, _engine, tier in self._kv_tier_models(name):
+            try:
+                # Off-loop: the read copies one block's bytes out of
+                # the tier mmap under its lock.
+                payload = await loop.run_in_executor(
+                    None, tier.read, chain)
+            except KeyError:
+                continue
+            return Response(
+                payload,
+                headers={
+                    "content-type": "application/octet-stream",
+                    "x-kfs-kv-digest": payload_digest(payload),
+                    "x-kfs-kv-block-bytes": str(tier.block_bytes),
+                    "x-kfs-kv-model": model.name,
+                })
+        return _json({"error": f"chain {chain_hex} is not resident"},
+                     status=404)
+
+    async def _kv_reattach(self, req: Request) -> Response:
+        """Re-attach conversation KV after a process boundary.  A
+        bare POST re-scans the persistent tier dir and adopts any
+        orphaned predecessor generation (digest-verified, per-entry);
+        a body naming a `peer` base URL pulls that replica's resident
+        chains over /kv/chains instead — the crash-failover path,
+        where the predecessor's host died but a surviving replica
+        still holds the conversation's blocks."""
+        body: Dict[str, Any] = {}
+        if req.body:
+            try:
+                parsed = json.loads(req.body)
+                if isinstance(parsed, dict):
+                    body = parsed
+            except ValueError:
+                return _json({"error": "malformed JSON body"},
+                             status=400)
+        peer = body.get("peer")
+        name = body.get("model")
+        try:
+            budget_s = float(body.get(
+                "budget_s",
+                os.environ.get("KFS_KV_PEER_BUDGET_S", "2")))
+        except (TypeError, ValueError):
+            budget_s = 2.0
+        if peer:
+            results = await self._kv_pull_peer(
+                str(peer).rstrip("/"), budget_s, name=name)
+            return _json({"peer": peer, "models": results})
+        loop = asyncio.get_running_loop()
+        results = {}
+        for model, _engine, tier in self._kv_tier_models(name):
+            try:
+                results[model.name] = await loop.run_in_executor(
+                    None, tier.reattach)
+            except Exception as e:
+                logger.exception("kv reattach for %s failed",
+                                 model.name)
+                results[model.name] = {"error": str(e)}
+        if results:
+            self.monitoring.flight_recorder.record(
+                {"kind": "kv_handoff_reattach", "models": results},
+                pin="kv_handoff_reattach")
+        return _json({"models": results})
+
+    async def _kv_pull_peer(self, peer: str, budget_s: float,
+                            name: Optional[str] = None
+                            ) -> Dict[str, Any]:
+        """Pull a peer's resident chains into the local tier:
+        index fetch, per-chain payload pulls digest-verified on
+        receipt, then one transactional engine.kv_import per model.
+        Bounded by `budget_s` — a slow peer costs the returning
+        conversation a re-prefill, never a stalled request."""
+        from kfserving_tpu.observability import metrics as obs
+        from kfserving_tpu.engine.kv_tier import payload_digest
+
+        import aiohttp
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(0.1, budget_s)
+        results: Dict[str, Any] = {}
+        timeout = aiohttp.ClientTimeout(total=max(0.1, budget_s))
+        try:
+            async with aiohttp.ClientSession(timeout=timeout) as s:
+                async with s.get(f"{peer}/kv/chains") as resp:
+                    if resp.status != 200:
+                        return {"error": f"peer index {resp.status}"}
+                    index = await resp.json()
+                for mname, info in (index.get("models")
+                                    or {}).items():
+                    if name is not None and mname != name:
+                        continue
+                    triples = self._kv_tier_models(mname)
+                    if not triples:
+                        continue
+                    _model, engine, tier = triples[0]
+                    if info.get("block_bytes") != tier.block_bytes:
+                        results[mname] = {
+                            "error": "block geometry mismatch"}
+                        continue
+                    pairs = []
+                    mismatches = 0
+                    failed = 0
+                    for ch_hex in info.get("chains") or []:
+                        if loop.time() >= deadline:
+                            break
+                        try:
+                            chain = bytes.fromhex(ch_hex)
+                        except ValueError:
+                            continue
+                        if tier.contains(chain):
+                            continue
+                        try:
+                            async with s.get(
+                                    f"{peer}/kv/chains/{ch_hex}",
+                                    params={"model": mname}) as r:
+                                if r.status != 200:
+                                    failed += 1
+                                    continue
+                                payload = await r.read()
+                                want = r.headers.get(
+                                    "x-kfs-kv-digest")
+                        except (aiohttp.ClientError,
+                                asyncio.TimeoutError):
+                            failed += 1
+                            continue
+                        if len(payload) != tier.block_bytes or (
+                                want and payload_digest(payload)
+                                != want):
+                            # Wire corruption: discard, never admit.
+                            mismatches += 1
+                            continue
+                        pairs.append((chain, payload))
+                    res = dict(await loop.run_in_executor(
+                        None, engine.kv_import, pairs))
+                    if mismatches:
+                        res["digest_mismatch"] = mismatches
+                        obs.kv_handoff_peer_blocks_total().labels(
+                            model=mname,
+                            outcome="digest_mismatch").inc(
+                                mismatches)
+                    if failed:
+                        res["failed"] = res.get("failed", 0) + failed
+                        obs.kv_handoff_peer_blocks_total().labels(
+                            model=mname, outcome="failed").inc(
+                                failed)
+                    results[mname] = res
+        except (aiohttp.ClientError, asyncio.TimeoutError,
+                OSError) as e:
+            results.setdefault("error", f"peer pull failed: {e!r}")
+        if results:
+            self.monitoring.flight_recorder.record(
+                {"kind": "kv_handoff_peer_pull", "peer": peer,
+                 "models": {k: v for k, v in results.items()
+                            if isinstance(v, dict)}},
+                pin="kv_handoff_peer_pull")
+        return results
+
+    async def _maybe_peer_import(self, headers: Dict[str, str],
+                                 name: str) -> None:
+        """Honor the router's failover fetch hint: an x-kfs-kv-peer
+        header names the predecessor replica this request was retried
+        away from.  One bounded single-flight pull per peer warms the
+        local tier before dispatch; any failure degrades to a plain
+        re-prefill — the request itself never fails on the hint."""
+        peer = None
+        for k, v in headers.items():
+            if k.lower() == "x-kfs-kv-peer":
+                peer = v.strip()
+                break
+        if not peer:
+            return
+        peer = peer.rstrip("/")
+        if peer in self._kv_peers_pulled:
+            return
+        if not self._kv_tier_models(name):
+            return
+        async with self._kv_peer_lock:
+            if peer in self._kv_peers_pulled:
+                return
+            self._kv_peers_pulled.add(peer)
+            try:
+                budget = float(os.environ.get(
+                    "KFS_KV_PEER_BUDGET_S", "2"))
+            except ValueError:
+                budget = 2.0
+            if budget <= 0:
+                return
+            try:
+                await self._kv_pull_peer(peer, budget, name=name)
+            except Exception:
+                logger.exception("kv peer pull from %s failed", peer)
+
+    async def export_kv(self, budget_s: Optional[float] = None
+                        ) -> Dict[str, Any]:
+        """Drain parachute: export every engine's live-slot and hot
+        prefix-chain KV into its PERSISTENT host tier (ephemeral
+        tiers die with the process — exporting into one would be
+        theater).  Runs on the SIGTERM drain path between drain()
+        and stop_async(), bounded by KFS_KV_EXPORT_BUDGET_S so it
+        can never stretch the orchestrator's swap window; 0
+        disables."""
+        if budget_s is None:
+            try:
+                budget_s = float(os.environ.get(
+                    "KFS_KV_EXPORT_BUDGET_S", "2"))
+            except ValueError:
+                budget_s = 2.0
+        results: Dict[str, Any] = {}
+        if budget_s <= 0:
+            return results
+        loop = asyncio.get_running_loop()
+        for model, engine, tier in self._kv_tier_models():
+            fn = getattr(engine, "export_kv", None)
+            if fn is None or not getattr(tier, "persistent", False):
+                continue
+            try:
+                res = await loop.run_in_executor(None, fn, budget_s)
+            except Exception:
+                logger.exception("kv export for %s failed",
+                                 model.name)
+                continue
+            results[model.name] = res
+        if results:
+            self.monitoring.flight_recorder.record(
+                {"kind": "kv_handoff_export", "budget_s": budget_s,
+                 "models": results},
+                pin="kv_handoff_export")
+        return results
 
     async def _load(self, req: Request) -> Response:
         name = req.path_params["name"]
@@ -1295,6 +1589,14 @@ class ModelServer:
             grace = float(os.environ.get("KFS_DRAIN_GRACE_S", "8"))
             if grace > 0:
                 await self.drain(grace)
+            # Drain parachute (ISSUE 19): whatever conversation KV is
+            # still device-resident — live slots included — exports
+            # into the persistent host tier before the engines close,
+            # so the successor serves returning users via warm
+            # fault-backs instead of full re-prefills.  Bounded by
+            # KFS_KV_EXPORT_BUDGET_S; a no-op without a persistent
+            # tier dir.
+            await self.export_kv()
             await self.stop_async()
 
         logging.basicConfig(level=logging.INFO)

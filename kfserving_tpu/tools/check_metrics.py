@@ -226,6 +226,25 @@ async def smoke() -> List[str]:
         model="metrics-probe").inc(512)
     obs.request_host_tier_saved_tokens().labels(
         model="metrics-probe").observe(512)
+    # Session-continuity KV handoff families (ISSUE 19): the drain
+    # parachute's export outcomes, re-attach adoption outcomes, the
+    # peer-transfer pull outcomes, and the export wall-time histogram —
+    # representative samples so names, label shapes, and unit suffixes
+    # always lint.
+    for outcome in ("exported", "skipped", "dropped", "failed"):
+        obs.kv_handoff_exported_blocks_total().labels(
+            model="metrics-probe", outcome=outcome).inc()
+    for outcome in ("adopted", "duplicate", "corrupt", "truncated",
+                    "torn", "version_skew", "dropped_capacity",
+                    "failed"):
+        obs.kv_handoff_reattached_blocks_total().labels(
+            model="metrics-probe", outcome=outcome).inc()
+    for outcome in ("imported", "digest_mismatch", "skipped",
+                    "failed"):
+        obs.kv_handoff_peer_blocks_total().labels(
+            model="metrics-probe", outcome=outcome).inc()
+    obs.kv_handoff_export_ms().labels(
+        model="metrics-probe").observe(14.0)
     # Model residency & affinity routing families (ISSUE 15): the
     # residency state/fault-in telemetry, the admission-aware
     # eviction-skip counter, and the router's affinity-pick outcomes —

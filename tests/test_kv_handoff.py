@@ -1,0 +1,556 @@
+"""Session continuity (ISSUE 19): durable KV handoff across recycles
+and crash failover, with a replica-to-replica transfer path.
+
+The discriminating bar is the same as ISSUE 16's, extended across the
+PROCESS boundary: every arm — drain-parachute export + successor
+adoption, peer pull, export chaos, import chaos — produces BIT-EXACT
+output versus an unbroken session.  The handoff only ever changes
+where KV bytes wait out the recycle, never what the model computes; a
+failed export or import degrades to a clean re-prefill and the drops
+are counted, never hidden.
+"""
+
+import asyncio
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfserving_tpu.engine.generator import GenerationEngine
+from kfserving_tpu.models.decoder import DecoderLM, decoder_tiny
+from kfserving_tpu.observability import REGISTRY, attribution
+from kfserving_tpu.reliability import faults
+
+MAX_SEQ = 64
+BS = 16
+
+# Two-block conversation (P1) and a three-block eviction driver (P2) —
+# the same return-visit workload the tier tests use.
+P1 = list(range(1, 2 * BS + 1))
+P2 = list(range(40, 40 + 3 * BS))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = decoder_tiny(num_layers=2, hidden_size=64, num_heads=2,
+                       intermediate_size=128, max_seq=MAX_SEQ,
+                       vocab_size=96)
+    module = DecoderLM(cfg)
+    variables = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+    return module, variables, cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    attribution.clear()
+    faults.reset()
+    yield
+    faults.reset()
+    attribution.clear()
+
+
+def make_paged(tiny, **kw):
+    module, variables, _ = tiny
+    kw.setdefault("max_slots", 1)
+    kw.setdefault("max_seq", MAX_SEQ)
+    kw.setdefault("prefill_buckets", [16, 32, MAX_SEQ])
+    kw.setdefault("block_size", BS)
+    return GenerationEngine(module, variables, name=kw.pop(
+        "name", "kvhandoff"), **kw)
+
+
+def _counter_value(family_name, **labels):
+    fam = REGISTRY.family(family_name)
+    if fam is None:
+        return 0
+    want = {(k, str(v)) for k, v in labels.items()}
+    total = 0
+    for sample_labels, child in fam.samples():
+        if want <= set(sample_labels.items()):
+            total += child.value
+    return total
+
+
+async def _settle_pool(eng, timeout_s=10.0):
+    total = eng.stats()["paged"]["pool_blocks"]
+    for _ in range(int(timeout_s / 0.05)):
+        await asyncio.sleep(0.05)
+        st = eng.stats()["paged"]
+        if st["free_blocks"] + st["reclaimable_blocks"] == total:
+            return st
+    raise AssertionError(f"pool never settled: {eng.stats()['paged']}")
+
+
+async def _complete(eng, prompt, n=3):
+    toks, reason = await eng.complete(prompt, max_new_tokens=n)
+    assert reason == "length"
+    await _settle_pool(eng)
+    return toks
+
+
+async def _baseline_turn(tiny, prompt, n=3):
+    eng = make_paged(tiny, cache_blocks=8, name="kvhandoff-base")
+    try:
+        return await _complete(eng, prompt, n)
+    finally:
+        await eng.close()
+
+
+# ===================================== drain parachute -> adoption
+
+
+async def test_drain_export_successor_adopts_bit_exact(tiny,
+                                                       tmp_path):
+    """Tentpole acceptance (warm recycle shape, engine level): the
+    incumbent's drain export lands its hot prefix chains in the
+    persistent tier; a successor process' engine adopts them at boot
+    and serves the returning conversation via fault-back — tokens
+    identical to an unbroken session."""
+    want = await _baseline_turn(tiny, P1)
+    d = str(tmp_path / "kv")
+
+    eng1 = make_paged(tiny, cache_blocks=8, host_tier_blocks=8,
+                      host_tier_dir=d, name="kvhandoff-drain")
+    try:
+        got1 = await _complete(eng1, P1)
+        assert got1 == want
+        res = eng1.export_kv(budget_s=10.0)
+        # P1 registered two full chains in the prefix index; the
+        # parachute exported both (nothing dropped or failed).
+        assert res["exported"] >= 2, res
+        assert res["failed"] == 0 and res["dropped"] == 0
+        assert eng1.kv_tier.debug()["used_blocks"] >= 2
+    finally:
+        await eng1.close()
+
+    # "Successor process": a second engine of the same model opening
+    # the same tier dir.  The incumbent's flock died with close().
+    eng2 = make_paged(tiny, cache_blocks=8, host_tier_blocks=8,
+                      host_tier_dir=d, name="kvhandoff-drain")
+    try:
+        assert eng2.kv_tier.handoff["adopted"] >= 2
+        got2 = await _complete(eng2, P1)
+        assert got2 == want, "handoff changed model output"
+        ht = eng2.stats()["host_tier"]
+        # The return visit came back through the tier, not re-prefill.
+        assert ht["faulted_blocks"] >= 2
+        assert _counter_value(
+            "kfserving_tpu_kv_handoff_exported_blocks_total",
+            model="kvhandoff-drain", outcome="exported") >= 2
+        assert _counter_value(
+            "kfserving_tpu_kv_handoff_reattached_blocks_total",
+            model="kvhandoff-drain", outcome="adopted") >= 2
+    finally:
+        await eng2.close()
+
+
+async def test_export_deadline_drops_are_counted(tiny, tmp_path):
+    """A zero budget means the deadline has already passed when the
+    export worker runs: every candidate is DROPPED (counted, never
+    hidden) and the tier stays empty — the no-handoff baseline."""
+    d = str(tmp_path / "kv")
+    eng = make_paged(tiny, cache_blocks=8, host_tier_blocks=8,
+                     host_tier_dir=d, name="kvhandoff-budget")
+    try:
+        await _complete(eng, P1)
+        res = eng.export_kv(budget_s=0.0)
+        assert res["exported"] == 0
+        assert res["dropped"] >= 2
+        assert eng.kv_tier.debug()["used_blocks"] == 0
+        assert _counter_value(
+            "kfserving_tpu_kv_handoff_exported_blocks_total",
+            model="kvhandoff-budget", outcome="dropped") >= 2
+    finally:
+        await eng.close()
+
+
+# ============================================ chaos: export site
+
+
+@pytest.mark.chaos
+async def test_export_chaos_degrades_to_no_handoff(tiny, tmp_path):
+    """engine.kv_export at error_rate=1.0: the export fails BEFORE any
+    tier write, every candidate counts outcome=failed, and the
+    returning conversation re-prefills on the successor with
+    bit-exact output."""
+    want = await _baseline_turn(tiny, P1)
+    d = str(tmp_path / "kv")
+    faults.configure({"engine.kv_export": {"error_rate": 1.0}})
+    eng1 = make_paged(tiny, cache_blocks=8, host_tier_blocks=8,
+                      host_tier_dir=d, name="kvhandoff-exchaos")
+    try:
+        assert await _complete(eng1, P1) == want
+        res = eng1.export_kv(budget_s=10.0)
+        assert res["exported"] == 0
+        assert res["failed"] >= 2
+        assert eng1.kv_tier.debug()["used_blocks"] == 0
+    finally:
+        await eng1.close()
+    faults.reset()
+
+    eng2 = make_paged(tiny, cache_blocks=8, host_tier_blocks=8,
+                      host_tier_dir=d, name="kvhandoff-exchaos")
+    try:
+        assert eng2.kv_tier.handoff["adopted"] == 0
+        # Clean re-prefill, identical output.
+        assert await _complete(eng2, P1) == want
+        assert eng2.stats()["host_tier"]["faulted_blocks"] == 0
+        assert _counter_value(
+            "kfserving_tpu_kv_handoff_exported_blocks_total",
+            model="kvhandoff-exchaos", outcome="failed") >= 2
+    finally:
+        await eng2.close()
+
+
+# ============================================ chaos: import site
+
+
+@pytest.mark.chaos
+async def test_import_chaos_rejects_batch_before_publication(tiny):
+    """engine.kv_import at error_rate=1.0: the peer batch is rejected
+    BEFORE any tier publication — the tier stays untouched and the
+    turn degrades to a clean re-prefill with identical output."""
+    want = await _baseline_turn(tiny, P1)
+    eng = make_paged(tiny, cache_blocks=8, host_tier_blocks=8,
+                     name="kvhandoff-imchaos")
+    try:
+        payload = b"\x5a" * eng.kv_tier.block_bytes
+        pairs = [(b"p" * 16, payload), (b"q" * 16, payload)]
+        faults.configure({"engine.kv_import": {"error_rate": 1.0}})
+        res = eng.kv_import(pairs)
+        assert res == {"imported": 0, "skipped": 0, "failed": 2}
+        assert eng.kv_tier.debug()["used_blocks"] == 0
+
+        faults.reset()
+        assert await _complete(eng, P1) == want
+
+        # Healthy import admits; a duplicate is skipped, not failed.
+        res = eng.kv_import(pairs)
+        assert res["imported"] == 2 and res["failed"] == 0
+        assert eng.kv_import(pairs[:1])["skipped"] == 1
+        assert _counter_value(
+            "kfserving_tpu_kv_handoff_peer_blocks_total",
+            model="kvhandoff-imchaos", outcome="failed") == 2
+        assert _counter_value(
+            "kfserving_tpu_kv_handoff_peer_blocks_total",
+            model="kvhandoff-imchaos", outcome="imported") == 2
+    finally:
+        await eng.close()
+
+
+# ===================================== peer transfer (server level)
+
+
+def _write_gen_dir(tmp_path, name="llm", **overrides):
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    cfg = {
+        "architecture": "decoder_tiny",
+        "arch_kwargs": {"num_layers": 2, "hidden_size": 64,
+                        "num_heads": 2, "intermediate_size": 128,
+                        "max_seq": MAX_SEQ},
+        "max_slots": 2,
+        "max_seq": MAX_SEQ,
+        "prefill_buckets": [16, 32, MAX_SEQ],
+        "max_new_tokens": 8,
+        "tokenizer": "byte",
+        "block_size": BS,
+        "cache_blocks": 8,
+        "host_tier_blocks": 8,
+    }
+    cfg.update(overrides)
+    (d / "config.json").write_text(json.dumps(cfg))
+    return str(d)
+
+
+async def test_peer_transfer_pull_verifies_and_serves(tmp_path):
+    """The replica-to-replica path end to end, in process: replica A
+    holds a conversation's chains in its tier; replica B receives the
+    router's failover hint (x-kfs-kv-peer) on a generate, pulls A's
+    chains digest-verified, and serves the returning conversation via
+    fault-back — output identical to A's."""
+    import aiohttp
+
+    from kfserving_tpu.predictors.llm import GenerativeModel
+    from kfserving_tpu.server.app import ModelServer
+
+    prompt = "s" * 32  # +BOS = 33 ids: two full 16-token blocks
+    model_a = GenerativeModel("gen", _write_gen_dir(tmp_path, "a"))
+    model_a.load()
+    server_a = ModelServer(http_port=0)
+    await server_a.start_async([model_a], host="127.0.0.1")
+    base_a = f"http://127.0.0.1:{server_a.http_port}"
+    model_b = GenerativeModel("gen", _write_gen_dir(tmp_path, "b"))
+    model_b.load()
+    server_b = ModelServer(http_port=0)
+    await server_b.start_async([model_b], host="127.0.0.1")
+    base_b = f"http://127.0.0.1:{server_b.http_port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base_a}/v1/models/gen:generate",
+                              json={"prompt": prompt,
+                                    "max_tokens": 4}) as r:
+                assert r.status == 200, await r.text()
+                out_a = (await r.json())["text_output"]
+            # Park A's chains in its tier (the drain parachute's
+            # engine seam, called directly — A stays alive as the
+            # transfer source).
+            loop = asyncio.get_running_loop()
+            res = await loop.run_in_executor(
+                None, model_a.engine.export_kv, 10.0)
+            assert res["exported"] >= 2, res
+
+            # The transfer index + payload endpoints.
+            async with s.get(f"{base_a}/kv/chains") as r:
+                assert r.status == 200
+                index = (await r.json())["models"]["gen"]
+            assert len(index["chains"]) >= 2
+            ch = index["chains"][0]
+            async with s.get(f"{base_a}/kv/chains/{ch}") as r:
+                assert r.status == 200
+                payload = await r.read()
+                assert len(payload) == index["block_bytes"]
+                assert r.headers["x-kfs-kv-digest"]
+            async with s.get(f"{base_a}/kv/chains/zz-not-hex") as r:
+                assert r.status == 400
+            async with s.get(f"{base_a}/kv/chains/{'00' * 16}") as r:
+                assert r.status == 404
+
+            # B's first sight of the conversation arrives WITH the
+            # router's failover hint: the single-flight pull warms
+            # B's tier before the request plans.
+            async with s.post(f"{base_b}/v1/models/gen:generate",
+                              json={"prompt": prompt,
+                                    "max_tokens": 4},
+                              headers={"x-kfs-kv-peer":
+                                       base_a}) as r:
+                assert r.status == 200, await r.text()
+                out_b = (await r.json())["text_output"]
+            assert out_b == out_a, "peer transfer changed output"
+            ht = model_b.engine.stats()["host_tier"]
+            assert ht["faulted_blocks"] >= 2
+            assert _counter_value(
+                "kfserving_tpu_kv_handoff_peer_blocks_total",
+                model="gen", outcome="imported") >= 2
+
+            # Explicit pull (the orchestrator's /kv/reattach with a
+            # peer body): everything is already resident — skipped,
+            # nothing double-admitted.
+            async with s.post(f"{base_b}/kv/reattach",
+                              json={"peer": base_a}) as r:
+                assert r.status == 200
+                body = await r.json()
+            assert body["models"]["gen"]["imported"] == 0
+
+            # The hint is single-flight per peer: a second request
+            # with the same header never re-pulls (the pulled set
+            # remembers), and a DEAD peer hint degrades to a plain
+            # generate — never a request failure.
+            async with s.post(f"{base_b}/v1/models/gen:generate",
+                              json={"prompt": prompt,
+                                    "max_tokens": 4},
+                              headers={"x-kfs-kv-peer":
+                                       "http://127.0.0.1:9"}) as r:
+                assert r.status == 200
+    finally:
+        await server_b.stop_async()
+        await server_a.stop_async()
+        await model_b.close()
+        await model_a.close()
+
+
+# ================================== e2e: recycle & crash failover
+
+
+async def _wait_for(predicate, timeout_s=60.0, interval_s=0.2):
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    while asyncio.get_running_loop().time() < deadline:
+        result = predicate()
+        if result:
+            return result
+        await asyncio.sleep(interval_s)
+    raise AssertionError("condition not met within "
+                         f"{timeout_s}s: {predicate}")
+
+
+async def _generate_via(session, base, prompt, max_tokens=4):
+    async with session.post(
+            f"{base}/v1/models/gen:generate",
+            json={"prompt": prompt, "max_tokens": max_tokens}) as r:
+        assert r.status == 200, await r.text()
+        return (await r.json())["text_output"]
+
+
+async def _replica_debug_cache(session, host):
+    async with session.get(f"http://{host}/debug/cache") as r:
+        assert r.status == 200
+        return await r.json()
+
+
+def _host_tier_block(dbg):
+    return (dbg.get("host_tier") or {}).get("gen") or {}
+
+
+async def _poll_host_tier(session, host, predicate, timeout_s=30.0):
+    """Poll a replica's /debug/cache host_tier block until `predicate`
+    accepts it (the adoption/spill commits race the test's clock)."""
+    deadline = asyncio.get_running_loop().time() + timeout_s
+    ht = {}
+    while asyncio.get_running_loop().time() < deadline:
+        ht = _host_tier_block(
+            await _replica_debug_cache(session, host))
+        if predicate(ht):
+            return ht
+        await asyncio.sleep(0.3)
+    return ht
+
+
+def _e2e_stack(tmp_path, kv_dir):
+    from kfserving_tpu.control.controller import Controller
+    from kfserving_tpu.control.router import IngressRouter
+    from kfserving_tpu.control.subprocess_orchestrator import (
+        RecyclePolicy,
+        SubprocessOrchestrator,
+    )
+
+    orch = SubprocessOrchestrator(
+        env_overrides={"JAX_PLATFORMS": "cpu",
+                       "KFS_KV_TIER_DIR": str(kv_dir),
+                       "KFS_DRAIN_GRACE_S": "1"},
+        recycle=RecyclePolicy(check_interval_s=0.3, min_age_s=0.0))
+    controller = Controller(orch)
+    router = IngressRouter(controller, buffer_deadline_s=30.0)
+    return orch, controller, router
+
+
+@pytest.mark.chaos
+async def test_e2e_warm_recycle_preserves_conversation(tmp_path):
+    """Acceptance flow 1: a mid-conversation WARM RECYCLE.  The
+    incumbent's SIGTERM drain exports the conversation's chains into
+    the shared persistent tier; the orchestrator re-attaches the
+    successor after the swap; the returning visit through the router
+    is served by the successor via fault-back, bit-exact with the
+    unbroken session."""
+    import aiohttp
+
+    from kfserving_tpu.control.spec import (
+        InferenceService,
+        PredictorSpec,
+    )
+
+    d = _write_gen_dir(tmp_path, "llm")
+    kv_dir = tmp_path / "kvtier"
+    orch, controller, router = _e2e_stack(tmp_path, kv_dir)
+    await router.start_async()
+    cid = "default/gen/predictor"
+    prompt = "w" * 32
+    try:
+        await controller.apply(InferenceService(
+            name="gen",
+            predictor=PredictorSpec(framework="generative",
+                                    storage_uri=f"file://{d}")))
+        replica = (await _wait_for(lambda: orch.replicas(cid)))[0]
+        await _wait_for(
+            lambda: orch._standbys.get((cid, replica.revision)))
+        base = f"http://127.0.0.1:{router.http_port}"
+        async with aiohttp.ClientSession() as session:
+            before = await _generate_via(session, base, prompt)
+
+            await orch._recycle_replica(replica, "test-handoff")
+            successor = (await _wait_for(
+                lambda: orch.replicas(cid)))[0]
+            assert successor.host != replica.host
+
+            # The drain parachute + post-swap reattach landed the
+            # conversation in the successor's tier.
+            ht = await _poll_host_tier(
+                session, successor.host,
+                lambda h: (h.get("handoff") or {}).get(
+                    "adopted", 0) >= 2)
+            assert (ht.get("handoff") or {}).get(
+                "adopted", 0) >= 2, ht
+
+            after = await _generate_via(session, base, prompt)
+            assert after == before, \
+                "recycle changed the conversation's output"
+            ht = _host_tier_block(await _replica_debug_cache(
+                session, successor.host))
+            assert ht.get("faulted_blocks", 0) >= 2, ht
+    finally:
+        await router.stop_async()
+        await orch.shutdown()
+
+
+@pytest.mark.chaos
+async def test_e2e_sigkill_failover_adopts_spilled_state(tmp_path):
+    """Acceptance flow 2: SIGKILL crash failover.  No drain ran — what
+    survives is what the tier already held (capacity-spilled chains,
+    durably manifested as they landed).  The promoted standby adopts
+    the corpse's generation (its flock died with it) and serves the
+    returning conversation via fault-back, bit-exact."""
+    import aiohttp
+
+    from kfserving_tpu.control.spec import (
+        InferenceService,
+        PredictorSpec,
+    )
+
+    # cache_blocks=4: the second conversation (3 blocks + growth)
+    # evicts the first's chains into the persistent tier pre-crash.
+    d = _write_gen_dir(tmp_path, "llm", cache_blocks=4)
+    kv_dir = tmp_path / "kvtier"
+    orch, controller, router = _e2e_stack(tmp_path, kv_dir)
+    await router.start_async()
+    cid = "default/gen/predictor"
+    p_return = "r" * 32          # the conversation that must survive
+    p_pressure = "z" * 48        # the eviction driver
+    try:
+        await controller.apply(InferenceService(
+            name="gen",
+            predictor=PredictorSpec(framework="generative",
+                                    storage_uri=f"file://{d}")))
+        replica = (await _wait_for(lambda: orch.replicas(cid)))[0]
+        await _wait_for(
+            lambda: orch._standbys.get((cid, replica.revision)))
+        base = f"http://127.0.0.1:{router.http_port}"
+        async with aiohttp.ClientSession() as session:
+            before = await _generate_via(session, base, p_return)
+            await _generate_via(session, base, p_pressure)
+
+            # The spills must have committed durably BEFORE the kill.
+            ht = await _poll_host_tier(
+                session, replica.host,
+                lambda h: h.get("used_blocks", 0) >= 2)
+            assert ht.get("used_blocks", 0) >= 2, \
+                "pressure never spilled to the tier"
+
+            os.kill(replica.handle.process.pid, signal.SIGKILL)
+            await _wait_for(lambda: orch.promotions >= 1,
+                            timeout_s=30.0)
+            successor = (await _wait_for(
+                lambda: orch.replicas(cid)))[0]
+            assert successor.host != replica.host
+
+            # Post-promotion reattach: the corpse's generation is
+            # adopted (flock auto-released by death).
+            ht = await _poll_host_tier(
+                session, successor.host,
+                lambda h: (h.get("handoff") or {}).get(
+                    "adopted", 0) >= 2)
+            assert (ht.get("handoff") or {}).get("adopted", 0) >= 2, \
+                "successor never adopted the corpse"
+
+            after = await _generate_via(session, base, p_return)
+            assert after == before, \
+                "crash failover changed the conversation's output"
+            ht = _host_tier_block(await _replica_debug_cache(
+                session, successor.host))
+            assert ht.get("faulted_blocks", 0) >= 2, ht
+    finally:
+        await router.stop_async()
+        await orch.shutdown()

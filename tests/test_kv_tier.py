@@ -11,6 +11,9 @@ attribution, tier telemetry) stay additive throughout.
 """
 
 import asyncio
+import glob
+import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -482,6 +485,262 @@ def test_faultback_storm_pins_flight_recorder_once_per_window():
         assert len(rec.dump(10)["pinned"]) == 1
     finally:
         tier.close()
+
+
+# ================== durable manifest & predecessor adoption (ISSUE 19)
+
+
+def _persistent(d, model="handoff", **kw):
+    kw.setdefault("block_bytes", 64)
+    kw.setdefault("capacity_blocks", 4)
+    return HostKVTier(directory=str(d), model=model, **kw)
+
+
+def _payload(seed, size=64):
+    return np.random.default_rng(seed).integers(
+        0, 256, size, dtype=np.uint8).tobytes()
+
+
+def _gen_files(d, suffix):
+    return sorted(glob.glob(os.path.join(str(d), f"kv_tier-*{suffix}")))
+
+
+def test_persistent_reattach_roundtrip(tmp_path):
+    """A successor opening the same tier dir adopts the predecessor's
+    entries bit-exactly, drains the old generation's files, and the
+    adoption is visible in handoff tallies + the registry twin."""
+    c1, c2 = b"1" * 16, b"2" * 16
+    p1, p2 = _payload(1), _payload(2)
+    a = _persistent(tmp_path, model="handoff-rt")
+    assert a.persistent and a.put(c1, p1) and a.put(c2, p2)
+    a.close()
+    # Persistent close keeps the generation on disk for the successor.
+    assert len(_gen_files(tmp_path, ".manifest")) == 1
+    assert len(_gen_files(tmp_path, ".bin")) == 1
+
+    b = _persistent(tmp_path, model="handoff-rt")
+    try:
+        assert b.handoff["adopted"] == 2
+        assert b.handoff["generations_adopted"] == 1
+        assert b.read(c1) == p1 and b.read(c2) == p2
+        # The predecessor's files were drained away; only the
+        # successor's own generation remains.
+        assert len(_gen_files(tmp_path, ".manifest")) == 1
+        assert _counter_value(
+            "kfserving_tpu_kv_handoff_reattached_blocks_total",
+            model="handoff-rt", outcome="adopted") >= 2
+        assert b.debug()["handoff"]["adopted"] == 2
+    finally:
+        b.close()
+
+
+def test_reattach_truncated_payload_drops_only_that_entry(tmp_path):
+    """Satellite: a payload file cut short of a recorded slot drops
+    ONLY that entry — the intact one still adopts."""
+    c1, c2 = b"1" * 16, b"2" * 16
+    p1 = _payload(3)
+    a = _persistent(tmp_path, model="handoff-trunc")
+    assert a.put(c1, p1) and a.put(c2, _payload(4))
+    stride = a.slot_bytes
+    a.close()
+    bin_path = _gen_files(tmp_path, ".bin")[0]
+    # c2 landed in slot 1 (slots issue in order): cut its payload off.
+    os.truncate(bin_path, stride)
+
+    b = _persistent(tmp_path, model="handoff-trunc")
+    try:
+        assert b.handoff["adopted"] == 1
+        assert b.handoff["truncated"] == 1
+        assert b.read(c1) == p1
+        assert not b.contains(c2)
+    finally:
+        b.close()
+
+
+def test_reattach_digest_mismatch_drops_only_that_entry(tmp_path):
+    """Satellite: a payload whose bytes no longer match the recorded
+    digest is counted corrupt and never served — the other entry still
+    adopts, and boot never crashes."""
+    c1, c2 = b"1" * 16, b"2" * 16
+    p2 = _payload(6)
+    a = _persistent(tmp_path, model="handoff-corrupt")
+    assert a.put(c1, _payload(5)) and a.put(c2, p2)
+    a.close()
+    bin_path = _gen_files(tmp_path, ".bin")[0]
+    with open(bin_path, "r+b") as f:
+        f.seek(0)  # c1's slot
+        byte = f.read(1)
+        f.seek(0)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+    b = _persistent(tmp_path, model="handoff-corrupt")
+    try:
+        assert b.handoff["adopted"] == 1
+        assert b.handoff["corrupt"] == 1
+        assert not b.contains(c1)
+        assert b.read(c2) == p2
+        assert _counter_value(
+            "kfserving_tpu_kv_handoff_reattached_blocks_total",
+            model="handoff-corrupt", outcome="corrupt") == 1
+    finally:
+        b.close()
+
+
+def test_reattach_torn_and_version_skew_records(tmp_path):
+    """Satellite: an unparseable manifest line (crash mid-append) and
+    a record from a future schema version each drop only themselves;
+    the healthy records still adopt."""
+    c1, c2 = b"1" * 16, b"2" * 16
+    a = _persistent(tmp_path, model="handoff-torn")
+    assert a.put(c1, _payload(7)) and a.put(c2, _payload(8))
+    a.close()
+    mpath = _gen_files(tmp_path, ".manifest")[0]
+    with open(mpath, "a") as f:
+        f.write('{"op": "put", "v":\n')          # torn mid-append
+        f.write(json.dumps({"op": "put", "v": 2,
+                            "chain": "ab" * 16, "slot": 2,
+                            "digest": "00" * 16}) + "\n")
+        f.write(json.dumps({"op": "frobnicate", "v": 1}) + "\n")
+
+    b = _persistent(tmp_path, model="handoff-torn")
+    try:
+        assert b.handoff["adopted"] == 2
+        assert b.handoff["torn"] == 2          # garbage + unknown op
+        assert b.handoff["version_skew"] == 1
+        assert b.contains(c1) and b.contains(c2)
+    finally:
+        b.close()
+
+
+def test_reattach_header_version_skew_discards_generation(tmp_path):
+    """A manifest whose HEADER schema version is unknown cannot be
+    interpreted at all: every record counts version_skew, the
+    generation is discarded, and boot continues clean."""
+    a = _persistent(tmp_path, model="handoff-hdr")
+    assert a.put(b"1" * 16, _payload(9))
+    a.close()
+    mpath = _gen_files(tmp_path, ".manifest")[0]
+    lines = open(mpath).read().splitlines()
+    header = json.loads(lines[0])
+    header["v"] = 99
+    lines[0] = json.dumps(header)
+    with open(mpath, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+    b = _persistent(tmp_path, model="handoff-hdr")
+    try:
+        assert b.handoff["adopted"] == 0
+        assert b.handoff["version_skew"] == 1
+        assert b.handoff["generations_rejected"] == 1
+        # Discarded: no predecessor files linger to be rescanned.
+        assert len(_gen_files(tmp_path, ".manifest")) == 1
+    finally:
+        b.close()
+
+
+def test_reattach_eviction_supersede_and_drop_records(tmp_path):
+    """Replay semantics: an eviction writes NO drop record — the
+    superseding put to the same slot erases the victim on replay; an
+    explicit drop() erases its chain.  Only the live entry adopts."""
+    ca, cb, cc = b"a" * 16, b"b" * 16, b"c" * 16
+    pb = _payload(11)
+    a = _persistent(tmp_path, model="handoff-replay",
+                    capacity_blocks=1)
+    assert a.put(ca, _payload(10))
+    assert a.put(cb, pb)       # evicts ca: same-slot supersede
+    a.close()
+    b = _persistent(tmp_path, model="handoff-replay",
+                    capacity_blocks=4)
+    try:
+        assert b.handoff["adopted"] == 1
+        assert not b.contains(ca)
+        assert b.read(cb) == pb
+        # Explicit drop: the record survives the handoff too.
+        assert b.put(cc, _payload(12))
+        b.drop(cc)
+    finally:
+        b.close()
+    c = _persistent(tmp_path, model="handoff-replay",
+                    capacity_blocks=4)
+    try:
+        assert c.read(cb) == pb
+        assert not c.contains(cc)
+    finally:
+        c.close()
+
+
+def test_reattach_live_generation_is_never_stolen(tmp_path):
+    """The flock is the liveness authority: a generation whose owner
+    still runs (holds the lock) is skipped entirely — no adoption, no
+    deletion."""
+    live = _persistent(tmp_path, model="handoff-live")
+    assert live.put(b"1" * 16, _payload(13))
+    try:
+        b = _persistent(tmp_path, model="handoff-live")
+        try:
+            assert b.handoff["adopted"] == 0
+            assert b.handoff["generations_live"] == 1
+            assert live.contains(b"1" * 16)
+        finally:
+            b.close()
+        # Both generations still on disk: nothing was stolen.
+        assert len(_gen_files(tmp_path, ".manifest")) == 2
+    finally:
+        live.close()
+
+
+def test_reattach_capacity_never_evicts_own_entries(tmp_path):
+    """Adoption takes only FREE slots: the successor's live working
+    set outranks the predecessor's cold tail (dropped_capacity counts
+    the overflow honestly)."""
+    own = b"o" * 16
+    po = _payload(14)
+    b = _persistent(tmp_path, model="handoff-cap", capacity_blocks=1)
+    try:
+        assert b.put(own, po)
+        a = _persistent(tmp_path, model="handoff-cap",
+                        capacity_blocks=4)
+        assert a.put(b"1" * 16, _payload(15))
+        assert a.put(b"2" * 16, _payload(16))
+        a.close()
+        res = b.reattach()
+        assert res["adopted"] == 0
+        assert res["dropped_capacity"] == 2
+        assert b.read(own) == po
+    finally:
+        b.close()
+
+
+def test_reattach_model_mismatch_leaves_generation_alone(tmp_path):
+    """A different model's generation sharing the dir is neither
+    adopted nor deleted — its rightful successor still finds it."""
+    c1 = b"1" * 16
+    p1 = _payload(17)
+    a = _persistent(tmp_path, model="handoff-m1")
+    assert a.put(c1, p1)
+    a.close()
+    other = _persistent(tmp_path, model="handoff-m2")
+    try:
+        assert other.handoff["adopted"] == 0
+        assert not other.contains(c1)
+    finally:
+        other.close()
+    heir = _persistent(tmp_path, model="handoff-m1")
+    try:
+        assert heir.handoff["adopted"] == 1
+        assert heir.read(c1) == p1
+    finally:
+        heir.close()
+
+
+def test_tier_dir_non_directory_target_fails_clean(tmp_path):
+    """Satellite: KFS_KV_TIER_DIR pointing at a FILE is a clear
+    startup error, not a traceback from some later mmap call."""
+    target = tmp_path / "not-a-dir"
+    target.write_text("occupied")
+    with pytest.raises(ValueError, match="not a directory"):
+        HostKVTier(block_bytes=64, capacity_blocks=2,
+                   directory=str(target), model="handoff-baddir")
 
 
 # ================================================== sanitizer smoke
