@@ -169,6 +169,16 @@ def _compact_configs(results: dict) -> dict:
                 "ttft_p50_ms")
             c["host_tier_tokens_saved"] = (r.get("tier") or {}).get(
                 "tokens_saved_total")
+        elif name == "specdec":
+            c.update(pick(r, "parity_all_arms",
+                          "tokens_per_s_ngram_over_off",
+                          "tokens_per_s_draft_over_off"))
+            for arm in ("off", "ngram", "draft"):
+                c[f"{arm}_tokens_per_s"] = (r.get(arm) or {}).get(
+                    "tokens_per_s")
+            for arm in ("ngram", "draft"):
+                c[f"{arm}_acceptance"] = ((r.get(arm) or {}).get(
+                    "speculative") or {}).get("acceptance_rate")
         elif name == "kvhandoff":
             c.update(pick(r, "ttft_p50_handoff_over_cold",
                           "cold_arm_saved_nothing"))
@@ -241,6 +251,7 @@ def main():
         "generate_stream_wire": C.bench_generate_stream_wire,
         "cache": C.bench_cache,
         "kvtier": C.bench_kvtier,
+        "specdec": C.bench_specdec,
         "kvhandoff": C.bench_kvhandoff,
         "history": C.bench_history,
     }

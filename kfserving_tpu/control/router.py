@@ -106,7 +106,13 @@ class IngressRouter:
         # component's replicas, so a fleet fronting a multi-model
         # repository PARTITIONS the model set — each replica's HBM
         # working set shrinks to its ring arc instead of every replica
-        # thrashing the whole catalog.  The breaker/health machinery
+        # thrashing the whole catalog.  "prefix" (ISSUE 20) hashes the
+        # normalized prompt's first-N-block chain digest instead, so
+        # conversations sharing a prompt prefix land on the replica
+        # whose engine-side prefix index already holds those KV blocks
+        # (the digest construction mirrors the engine's, so equal keys
+        # really mean shareable blocks).  Both modes ride the SAME
+        # ring/vnode/spill machinery, and the breaker/health machinery
         # stays the escape hatch: an unhealthy or overloaded primary
         # spills to the next ring position, and a ring that yields
         # nothing (or an injected `router.affinity_pick` fault) falls
@@ -121,6 +127,17 @@ class IngressRouter:
         self.affinity_spill = (
             affinity_spill if affinity_spill is not None
             else int(os.environ.get("KFS_ROUTER_AFFINITY_SPILL", "8")))
+        # Prefix-affinity key shape: how many leading prompt blocks of
+        # how many tokens feed the chain digest.  The block size should
+        # match the serving engine's `block_size` so the router's key
+        # equals the engine's prefix-index chain for those blocks;
+        # the block COUNT bounds both hashing cost and key cardinality
+        # (deeper chains over-shard conversations that share a long
+        # system prompt but diverge late).
+        self.affinity_prefix_blocks = int(os.environ.get(
+            "KFS_ROUTER_AFFINITY_PREFIX_BLOCKS", "4"))
+        self.affinity_prefix_block_tokens = int(os.environ.get(
+            "KFS_ROUTER_AFFINITY_PREFIX_BLOCK", "128"))
         self._host_inflight: Dict[str, int] = {}
         self._ring_cache: Dict[tuple, List[Tuple[int, str]]] = {}
         self._rng = random.Random(seed)
@@ -371,6 +388,47 @@ class IngressRouter:
         return self.controller.get(tm.inference_service,
                                    tm.namespace), name
 
+    def _prefix_affinity_key(self, body) -> Optional[str]:
+        """Chain digest of the request prompt's first N blocks — the
+        affinity key for `KFS_ROUTER_AFFINITY=prefix`.  The prompt is
+        normalized exactly the way the serving engine will see it
+        (byte-tokenizer ids: BOS 256 + utf-8 bytes, int32
+        little-endian), then chained with blake2b-16 per block of
+        `affinity_prefix_block_tokens` tokens — the identical
+        construction the engine's prefix index keys full prompt blocks
+        by, so two requests hashing to the same key really do share
+        cached KV on the replica the ring pins them to.  A prompt
+        shorter than one full block digests whole (short prompts still
+        pin consistently); an unparsable body returns None (the caller
+        keeps whatever key `_lookup_service` produced)."""
+        if not body:
+            return None
+        try:
+            payload = json.loads(body)
+        except Exception:
+            return None
+        inst: Any = payload
+        if isinstance(payload, dict):
+            insts = payload.get("instances")
+            if isinstance(insts, list) and insts:
+                inst = insts[0]
+        if isinstance(inst, dict):
+            inst = inst.get("prompt", inst.get("text_input"))
+        if not isinstance(inst, str) or not inst:
+            return None
+        raw = b"".join(
+            t.to_bytes(4, "little")
+            for t in [256] + list(inst.encode("utf-8")))
+        bs = 4 * max(1, self.affinity_prefix_block_tokens)
+        full = min(len(raw) // bs, max(1, self.affinity_prefix_blocks))
+        chain = b""
+        for c in range(full):
+            chain = hashlib.blake2b(chain + raw[c * bs:(c + 1) * bs],
+                                    digest_size=16).digest()
+        if not full:
+            chain = hashlib.blake2b(raw, digest_size=16).digest()
+        return chain.hex()
+
     def _entry_component(self, isvc, verb: str) -> str:
         if verb == "explain":
             if isvc.explainer is not None:
@@ -442,6 +500,7 @@ class IngressRouter:
             if breaker is not None and not breaker.allow():
                 continue
             obs.router_affinity_total().labels(
+                mode=self.affinity,
                 outcome="ring" if primary else "spill").inc()
             return host
         return None
@@ -480,7 +539,7 @@ class IngressRouter:
             # Ring exhausted (every host overloaded or breaker-vetoed):
             # the round-robin escape hatch below still applies.
             obs.router_affinity_total().labels(
-                outcome="fallback").inc()
+                mode=self.affinity, outcome="fallback").inc()
         for _ in range(len(replicas)):
             idx = self._rr.get(cid, 0)
             self._rr[cid] = idx + 1
@@ -582,7 +641,7 @@ class IngressRouter:
             return None, cname, None, \
                 f"no traffic targets for {name}/{cname}"
         cid = self.controller.reconciler.component_id(isvc, cname)
-        if self.affinity != "model" or verb == "health":
+        if self.affinity not in ("model", "prefix") or verb == "health":
             affinity_key = None
         if affinity_key is not None and faults.configured(
                 fault_sites.ROUTER_AFFINITY_PICK):
@@ -594,7 +653,7 @@ class IngressRouter:
                 # degrades to the blind round-robin spray, never to an
                 # unroutable request.
                 obs.router_affinity_total().labels(
-                    outcome="fallback").inc()
+                    mode=self.affinity, outcome="fallback").inc()
                 affinity_key = None
         host = self._pick_replica(cid, revision, exclude=exclude,
                                   affinity_key=affinity_key)
@@ -1489,6 +1548,16 @@ class IngressRouter:
         # predictive loop read — per-TM keys would leave a busy
         # multi-model fleet looking idle (and scaled to zero).
         resolved = self._lookup_service(name)
+        # Prefix-affinity key (ISSUE 20): computed HERE — the one
+        # place the request body is in hand — and threaded through
+        # `resolved` so the per-attempt _resolve loop never re-parses
+        # the payload.  A body with no extractable prompt keeps the
+        # model-name key _lookup_service produced (multi-model
+        # partitioning remains the backstop).
+        if self.affinity == "prefix" and verb != "health":
+            pkey = self._prefix_affinity_key(req.body)
+            if pkey is not None:
+                resolved = (resolved[0], pkey)
         svc = resolved[0]
         svc_name = svc.name if svc is not None else name
         if verb != "health":

@@ -133,6 +133,13 @@ class _Request:
     # request can reach any terminal path.
     host_tier_hit_blocks: int = 0
     host_tier_saved_tokens: int = 0
+    # Speculative decoding: the draft/verify split of this request's
+    # decode device time.  These REFINE decode_device_ms (they are a
+    # breakdown of the same busy intervals, not additive terms) — the
+    # conservation invariant "prefill + decode sums to engine device
+    # time" is untouched.
+    spec_draft_ms: float = 0.0
+    spec_verify_ms: float = 0.0
 
 
 @dataclass
@@ -190,6 +197,7 @@ class GenerationEngine:
                  host_tier_blocks: Optional[int] = None,
                  host_tier_dir: Optional[str] = None,
                  adaptive_depth: bool = True,
+                 speculative: Optional[Dict[str, Any]] = None,
                  rng_seed: int = 0,
                  logprob_topk: int = 5,
                  mesh=None,
@@ -428,6 +436,43 @@ class GenerationEngine:
                     f"two would fit neither the bucketed nor the "
                     f"chunked prefill path)")
 
+        # -- speculative decoding (ROADMAP item 2) ---------------------
+        # `speculative` = {"tokens": K >= 1, optional "draft_module",
+        # "draft_variables", "draft_window"}.  When None, the
+        # KFS_SPECDEC_TOKENS env twin can switch on the n-gram
+        # (prompt-lookup) proposer; 0 / unset = off, and the engine is
+        # byte-identical to a build without this feature.  With a
+        # draft module configured, proposals come from a jitted
+        # rolling-window draft scan instead.
+        if speculative is None:
+            try:
+                env_spec = int(os.environ.get("KFS_SPECDEC_TOKENS",
+                                              "0"))
+            except ValueError:
+                env_spec = 0
+            if env_spec > 0:
+                speculative = {"tokens": env_spec}
+        self.spec_tokens = 0
+        self._draft_module = None
+        self._draft_variables = None
+        self._draft_window = 0
+        if speculative:
+            self.spec_tokens = int(speculative.get("tokens", 0))
+            if self.spec_tokens < 0:
+                raise InvalidInput(
+                    "speculative tokens must be >= 0")
+            if self.spec_tokens > 0:
+                self._draft_module = speculative.get("draft_module")
+                self._draft_variables = speculative.get(
+                    "draft_variables")
+                if self._draft_module is not None:
+                    from kfserving_tpu.engine.speculative import (
+                        DEFAULT_DRAFT_WINDOW,
+                    )
+
+                    self._draft_window = int(speculative.get(
+                        "draft_window", DEFAULT_DRAFT_WINDOW))
+
         if mesh is not None:
             # Tensor parallelism: the cache shards on the heads axis,
             # exactly like the q/k/v projections that fill it
@@ -626,6 +671,81 @@ class GenerationEngine:
             self._chunk_prefill = jax.jit(chunk_prefill_fn,
                                           donate_argnums=(1,))
 
+        self._spec_draft_fn = None
+        if self.spec_tokens > 0:
+            spec_kp1 = self.spec_tokens + 1
+
+            def spec_verify_fn(variables, caches, table, last_tokens,
+                               draft_toks, positions, temps, top_ks,
+                               top_ps, seeds):
+                """Verify K draft tokens per slot in ONE Lq=K+1
+                dispatch.  Row i feeds [last_token, draft_0..K-1] at
+                absolute positions [L, L+K] (parked rows ride the
+                max_seq sentinel: their writes drop / clamp and their
+                samples are discarded).  logit_positions asks the LM
+                head for ALL K+1 positions — position j's logits see
+                exactly the prefix a sequential decode would have at
+                step j, so sampling them with the SAME per-row
+                (seed, position) noise keys reproduces sequential
+                decode's draws bit-exactly.  Exact-match acceptance of
+                the longest agreeing prefix is then rejection sampling
+                under the slot's deterministic noise key: the target's
+                draw at a position is a point, and accept-iff-equal is
+                the degenerate (and parity-exact) rejection rule.
+                Rollback past the first rejection needs NO cache
+                surgery — the host length pointer simply does not
+                advance over rejected positions, and the garbage k/v
+                written there is overwritten by later waves before any
+                query can attend it (writes precede attention in every
+                dispatch, and positions advance monotonically)."""
+                tokens = jnp.concatenate(
+                    [last_tokens[:, None], draft_toks], axis=1)
+                kv = ([(k, v, table) for k, v in caches] if paged
+                      else caches)
+                s_rows = tokens.shape[0]
+                gather = jnp.broadcast_to(
+                    jnp.arange(spec_kp1, dtype=jnp.int32)[None, :],
+                    (s_rows, spec_kp1))
+                logits, new_caches = module.apply(
+                    variables, tokens, positions=positions,
+                    kv_cache=kv, logit_positions=gather)
+                flat = logits.reshape(s_rows * spec_kp1, -1)
+
+                def rep(a):
+                    return jnp.repeat(a, spec_kp1)
+
+                # noise index = length of the prefix each draw
+                # extends: position p's sample starts a prefix of
+                # p + 1 tokens — identical keying to decode_fn.
+                samples = sample(flat, rep(temps), rep(top_ks),
+                                 rep(top_ps), rep(seeds),
+                                 (positions + 1).reshape(-1))
+                chosen_lp, top_ids, top_lps = logprob_of(flat,
+                                                         samples)
+                # draft_toks are echoed through so the host reads
+                # proposals + verdicts in the same fetch: the draft
+                # arm costs ONE host round trip per spec wave, same
+                # as a plain decode wave.
+                return (samples.reshape(s_rows, spec_kp1), draft_toks,
+                        new_caches,
+                        chosen_lp.reshape(s_rows, spec_kp1),
+                        top_ids.reshape(s_rows, spec_kp1, lp_n),
+                        top_lps.reshape(s_rows, spec_kp1, lp_n))
+
+            self._spec_verify = jax.jit(spec_verify_fn,
+                                        donate_argnums=(1,))
+            from kfserving_tpu.engine.speculative import NGramProposer
+
+            self._ngram = NGramProposer(self.spec_tokens)
+            if self._draft_module is not None:
+                from kfserving_tpu.engine.speculative import (
+                    make_draft_proposer,
+                )
+
+                self._spec_draft_fn = make_draft_proposer(
+                    jax, self._draft_module, self.max_slots,
+                    self._draft_window, self.spec_tokens)
+
         if paged:
             from kfserving_tpu.ops.paged_attention import paged_insert
 
@@ -720,6 +840,18 @@ class GenerationEngine:
         # pipeline last ran at.
         self.suppressed_waves = 0
         self._depth_effective = self.pipeline_depth
+        # Speculative-decoding accounting (engine twins of the
+        # kfserving_tpu_specdec_* registry families).
+        self.spec_waves = 0
+        self.spec_proposed_tokens = 0   # K per live row per wave
+        self.spec_accepted_tokens = 0   # draft tokens that matched
+        self.spec_emitted_tokens = 0    # accepted + the bonus draws
+        self.spec_fallbacks: Dict[str, int] = {}
+        # Bounded accepted-length reservoir for the stats()/cache
+        # p50/p99 (full-fidelity histogram lives in the registry).
+        self._spec_lengths: deque = deque(maxlen=4096)
+        self._spec_draft_s = 0.0
+        self._spec_verify_s = 0.0
         self._occupied_slot_steps = 0
         self._wasted_token_steps = 0  # garbage steps past a finish
         # Union of enqueue->fetch intervals (overlap-corrected at
@@ -1060,7 +1192,41 @@ class GenerationEngine:
                     "chunks_dispatched": self.prefill_chunks,
                     "chunks_skipped_shared": self.prefill_chunks_skipped,
                 }
+        if self.spec_tokens:
+            out["speculative"] = self.spec_debug()
         return out
+
+    def spec_debug(self) -> Dict[str, Any]:
+        """Speculative-decoding snapshot for stats() and the
+        /debug/cache body (the router federates per-replica acceptance
+        rates from here, like the prefix census)."""
+        lengths = sorted(self._spec_lengths)
+
+        def lpct(q: float) -> int:
+            if not lengths:
+                return 0
+            return lengths[min(len(lengths) - 1,
+                               int(len(lengths) * q))]
+
+        proposed = self.spec_proposed_tokens
+        return {
+            "tokens": self.spec_tokens,
+            "proposer": ("draft" if self._spec_draft_fn is not None
+                         else "ngram"),
+            "waves": self.spec_waves,
+            "proposed_tokens": proposed,
+            "accepted_tokens": self.spec_accepted_tokens,
+            "emitted_tokens": self.spec_emitted_tokens,
+            "acceptance_rate": (round(
+                self.spec_accepted_tokens / proposed, 4)
+                if proposed else 0.0),
+            "accepted_length_p50": lpct(0.50),
+            "accepted_length_p99": lpct(0.99),
+            "draft_device_s": round(self._spec_draft_s, 4),
+            "verify_device_s": round(self._spec_verify_s, 4),
+            "draft_param_bytes": self.draft_param_bytes(),
+            "fallbacks": dict(self.spec_fallbacks),
+        }
 
     def cache_debug(self, top_k: int = 10) -> Dict[str, Any]:
         """The per-replica `GET /debug/cache` body: prefix-index
@@ -1070,7 +1236,10 @@ class GenerationEngine:
         LRU HBM residency manager (item 4) will read, federated by
         the router under the `replica` label."""
         if self.block_size is None:
-            return {"paged": False}
+            out = {"paged": False}
+            if self.spec_tokens:
+                out["speculative"] = self.spec_debug()
+            return out
         with self._block_lock:
             census = {chain: self._chain_hits.get(chain, 0)
                       for chain in self._prefix_index}
@@ -1083,7 +1252,7 @@ class GenerationEngine:
 
         hot = sorted(census.items(), key=lambda kv: (-kv[1], kv[0]))
         hot = hot[:max(0, int(top_k))]
-        return {
+        ret = {
             "paged": True,
             "index_entries": len(census),
             "reuse_depth": {
@@ -1099,6 +1268,9 @@ class GenerationEngine:
             # census hold above.
             "pool": self.stats()["paged"],
         }
+        if self.spec_tokens:
+            ret["speculative"] = self.spec_debug()
+        return ret
 
     # -- paged-cache bookkeeping -------------------------------------------
     # All mutation happens under _block_lock: the enqueue thread
@@ -1927,6 +2099,11 @@ class GenerationEngine:
             return []
         bs = self.block_size
         horizon = self.steps_per_call * self.pipeline_depth + 1
+        if self.spec_tokens:
+            # A spec wave writes K+1 positions past the host length in
+            # one dispatch (spec runs depth-1, but the widest single
+            # dispatch sets the write horizon).
+            horizon = max(horizon, self.spec_tokens + 2)
         failed: List[int] = []
         with self._block_lock:
             for i, s in enumerate(self._slots):
@@ -2362,13 +2539,21 @@ class GenerationEngine:
         timeout/cancel, because the timed-out request is exactly the
         one the flight recorder pins and must find cost evidence
         for."""
+        device_ms = {
+            "prefill": round(req.prefill_device_ms, 3),
+            "decode": round(req.decode_device_ms, 3),
+        }
+        if self.spec_tokens:
+            # Draft/verify REFINE the decode figure (same busy
+            # intervals, finer phase) — consumers summing
+            # prefill+decode across requests still reconcile against
+            # engine device time.
+            device_ms["spec_draft"] = round(req.spec_draft_ms, 3)
+            device_ms["spec_verify"] = round(req.spec_verify_ms, 3)
         attribution.observe(self.name, req.trace_id, {
             "trace_id": req.trace_id,
             "finish_reason": finished,
-            "device_ms": {
-                "prefill": round(req.prefill_device_ms, 3),
-                "decode": round(req.decode_device_ms, 3),
-            },
+            "device_ms": device_ms,
             "prefill_tokens": int(req.prompt_ids.size),
             "decode_tokens": req.tokens_out,
             "blocks_held": req.blocks_held,
@@ -2638,31 +2823,44 @@ class GenerationEngine:
             decodable = [] if held else [
                 s for s in self._slots
                 if s is not None and not s.prefilling]
-            waves = sum(1 for it in inflight if it[0] == "decode")
-            while decodable and waves < self.pipeline_depth:
-                if (self.adaptive_depth and waves >= 1 and all(
-                        s.req.max_new_tokens - s.generated
-                        <= waves * self.steps_per_call
-                        for s in decodable)):
-                    # Adaptive depth: every active stream finishes (by
-                    # token budget) within the waves already in
-                    # flight — a speculative wave here could only
-                    # decode garbage (the fixed-depth-2 failure mode:
-                    # ~45% wasted dispatches when finishes cluster,
-                    # r5 A/B depth_speedup 0.98).  Staggered traffic
-                    # keeps remaining work past the horizon and still
-                    # gets the full configured depth.
-                    self.suppressed_waves += 1
-                    obs.generator_suppressed_waves_total().inc()
-                    TIMELINE.record("host", "wave.suppressed")
-                    break
-                kind_, toks_h, lp_h, snap, t0_ = \
-                    await loop.run_in_executor(
-                        self._enqueue_executor, self._enqueue_wave)
-                fut = loop.run_in_executor(
-                    self._executor, self._fetch_wave, toks_h, lp_h)
-                inflight.append((kind_, fut, snap, t0_))
-                waves += 1
+            waves = sum(1 for it in inflight
+                        if it[0] in ("decode", "spec"))
+            if self.spec_tokens > 0 and decodable and waves == 0:
+                # Speculative mode runs depth-1: spec waves are
+                # host-fed (the proposer needs each slot's committed
+                # history), so wave N+1 cannot chain off wave N's
+                # device feed — it waits for N's verdicts.  The
+                # throughput lever here is K+1 tokens per dispatch,
+                # not dispatch overlap; the adaptive-depth governor
+                # has nothing to govern at depth 1.
+                await self._spec_or_fallback_wave(loop, inflight)
+                waves = 1
+            elif self.spec_tokens == 0:
+                while decodable and waves < self.pipeline_depth:
+                    if (self.adaptive_depth and waves >= 1 and all(
+                            s.req.max_new_tokens - s.generated
+                            <= waves * self.steps_per_call
+                            for s in decodable)):
+                        # Adaptive depth: every active stream finishes
+                        # (by token budget) within the waves already
+                        # in flight — a speculative wave here could
+                        # only decode garbage (the fixed-depth-2
+                        # failure mode: ~45% wasted dispatches when
+                        # finishes cluster, r5 A/B depth_speedup
+                        # 0.98).  Staggered traffic keeps remaining
+                        # work past the horizon and still gets the
+                        # full configured depth.
+                        self.suppressed_waves += 1
+                        obs.generator_suppressed_waves_total().inc()
+                        TIMELINE.record("host", "wave.suppressed")
+                        break
+                    kind_, toks_h, lp_h, snap, t0_ = \
+                        await loop.run_in_executor(
+                            self._enqueue_executor, self._enqueue_wave)
+                    fut = loop.run_in_executor(
+                        self._executor, self._fetch_wave, toks_h, lp_h)
+                    inflight.append((kind_, fut, snap, t0_))
+                    waves += 1
             if decodable and waves != self._depth_effective:
                 self._depth_effective = waves
                 obs.generator_pipeline_depth().set(waves)
@@ -2719,7 +2917,52 @@ class GenerationEngine:
             # pool-occupancy counter sample.
             wall = time.time()
             dev_dur = max(0.0, busy)
-            if kind == "decode":
+            if kind == "spec":
+                self._decode_device_s += busy
+                self._decode_wait_s += wait_s
+                samples, draft, draft_ready_s = fetched
+                entries, host_draft_ms = meta
+                if self._spec_draft_fn is not None:
+                    # The draft program completes before verify in
+                    # device order (verify consumes its output), so
+                    # the draft handle's ready time splits the busy
+                    # interval into draft / verify device slices.
+                    draft_ms = min(max(draft_ready_s, 0.0),
+                                   dev_dur) * 1000.0
+                    TIMELINE.record(
+                        "device", "spec.draft",
+                        dur_s=draft_ms / 1000.0, t_end=wall,
+                        attrs={"k": self.spec_tokens})
+                else:
+                    # n-gram proposals are host work measured at
+                    # proposal time; the whole device interval is
+                    # verify.
+                    draft_ms = host_draft_ms
+                    TIMELINE.record(
+                        "host", "spec.draft",
+                        dur_s=draft_ms / 1000.0, t_end=wall,
+                        attrs={"k": self.spec_tokens})
+                verify_ms = dev_dur * 1000.0
+                if self._spec_draft_fn is not None:
+                    verify_ms = max(0.0, verify_ms - draft_ms)
+                TIMELINE.record(
+                    "device", "spec.verify",
+                    dur_s=verify_ms / 1000.0, t_end=wall,
+                    attrs={"k": self.spec_tokens,
+                           "rows": len(entries),
+                           "wait_ms": round(wait_s * 1000.0, 3)})
+                for slot_i, s in entries:
+                    if self._slots[slot_i] is s:
+                        TIMELINE.record("slot", "spec.decode",
+                                        dur_s=dev_dur, t_end=wall,
+                                        trace_id=s.req.trace_id,
+                                        slot=slot_i)
+                self._record_pool_sample()
+                self._distribute_spec(samples, draft, lp, entries,
+                                      device_ms=dev_dur * 1000.0,
+                                      draft_ms=draft_ms,
+                                      verify_ms=verify_ms)
+            elif kind == "decode":
                 self._decode_device_s += busy
                 self._decode_wait_s += wait_s
                 TIMELINE.record(
@@ -3099,6 +3342,307 @@ class GenerationEngine:
             self._decode_hbm_bytes += k * (
                 self._param_read_bytes
                 + resident_tokens * self._kv_bytes_per_token)
+
+    # -- speculative decoding ----------------------------------------------
+    async def _spec_or_fallback_wave(self, loop, inflight) -> None:
+        """Enqueue exactly one wave in speculative mode: a draft/verify
+        spec wave over the host-feedable slots, or a plain resynced
+        decode wave when chaos trips a spec fault site or no slot has
+        a host-visible last token yet (a monolithic prefill's first
+        token can still be in the FIFO — its device feed row is
+        correct, so the plain wave decodes it; the slot joins spec
+        waves once the fetch lands).  Either way the OUTPUT tokens are
+        bit-identical to non-speculative decode — only the dispatch
+        shape differs."""
+        eligible = [(i, s) for i, s in enumerate(self._slots)
+                    if s is not None and not s.prefilling
+                    and s.last_token >= 0]
+        fall_site = await self._probe_spec_fault() if eligible else None
+        if eligible and fall_site is None:
+            ngram = None
+            windows = None
+            host_ms = 0.0
+            if self._spec_draft_fn is not None:
+                windows = self._build_draft_windows(eligible)
+            else:
+                ngram, host_ms = self._propose_ngram(eligible)
+            kind_, handles, lp_h, meta_, t0_ = \
+                await loop.run_in_executor(
+                    self._enqueue_executor, self._enqueue_spec_wave,
+                    eligible, ngram, windows, host_ms)
+            fut = loop.run_in_executor(
+                self._executor, self._fetch_spec, handles, lp_h)
+            inflight.append((kind_, fut, meta_, t0_))
+            return
+        if fall_site is not None:
+            self._count_spec_fallback(fall_site)
+        kind_, toks_h, lp_h, snap, t0_ = await loop.run_in_executor(
+            self._enqueue_executor, self._enqueue_resynced_wave)
+        fut = loop.run_in_executor(
+            self._executor, self._fetch_wave, toks_h, lp_h)
+        inflight.append((kind_, fut, snap, t0_))
+
+    async def _probe_spec_fault(self) -> Optional[str]:
+        """Chaos seams of the speculative path, probed ON the loop
+        (async injected latency never blocks the scheduler).  An
+        injected error on either seam degrades THIS wave to plain
+        non-speculative decode — same tokens, fewer per dispatch.
+        configured() keeps the no-faults hot path at two dict
+        lookups."""
+        from kfserving_tpu.reliability import fault_sites
+        from kfserving_tpu.reliability.faults import (
+            FaultInjected,
+            faults,
+        )
+
+        if faults.configured(fault_sites.ENGINE_SPEC_DRAFT):
+            try:
+                await faults.inject(fault_sites.ENGINE_SPEC_DRAFT,
+                                    key=self.name)
+            except FaultInjected:
+                return "draft"
+        if faults.configured(fault_sites.ENGINE_SPEC_VERIFY):
+            try:
+                await faults.inject(fault_sites.ENGINE_SPEC_VERIFY,
+                                    key=self.name)
+            except FaultInjected:
+                return "verify"
+        return None
+
+    def _count_spec_fallback(self, site: str) -> None:
+        self.spec_fallbacks[site] = \
+            self.spec_fallbacks.get(site, 0) + 1
+        obs.specdec_fallbacks_total().labels(
+            model=self.name, site=site).inc()
+
+    def _spec_history(self, s: _Active) -> List[int]:
+        """A slot's committed token stream: prompt + emitted content
+        tokens (s.tokens ends with last_token — the _emit invariant),
+        which is exactly the prefix the next sampled token extends."""
+        return list(s.req.prompt_ids) + s.tokens
+
+    def _propose_ngram(self, eligible) -> Tuple[np.ndarray, float]:
+        """Host-side prompt-lookup proposals for the eligible rows.
+        Runs on the loop thread: pure numpy/list scanning, no device
+        work — its cost is measured and reported as the n-gram arm's
+        draft overhead."""
+        t0 = time.perf_counter()
+        draft = np.zeros((self.max_slots, self.spec_tokens), np.int32)
+        for i, s in eligible:
+            draft[i] = self._ngram.propose(self._spec_history(s))
+        return draft, (time.perf_counter() - t0) * 1000.0
+
+    def _build_draft_windows(self, eligible) -> np.ndarray:
+        from kfserving_tpu.engine.speculative import rolling_windows
+
+        return rolling_windows(
+            [self._spec_history(s) for _i, s in eligible],
+            self.max_slots, [i for i, _s in eligible],
+            self._draft_window)
+
+    def _enqueue_spec_wave(self, eligible, ngram, windows,
+                           host_draft_ms):
+        """Runs on the enqueue executor: dispatch the draft proposer
+        (when a draft model is configured) and the K+1-position verify
+        as ONE chained device program pair — the verify consumes the
+        draft's output handle, so no host round trip separates them
+        and the fetch below joins both.  Rows not in `eligible` park
+        on the max_seq position sentinel: their writes drop (paged OOB
+        sentinel / dense mode='drop') and their samples are
+        discarded."""
+        jnp = self._jnp
+        self._drain_spills()
+        S = self.max_slots
+        K = self.spec_tokens
+        last = np.zeros(S, np.int32)
+        qpos = np.full((S, K + 1), self.max_seq, np.int32)
+        for i, s in eligible:
+            last[i] = s.last_token
+            qpos[i] = s.length + np.arange(K + 1, dtype=np.int32)
+        temps, top_ks, top_ps, seeds, want_lp = \
+            self._sampling_arrays()
+        if windows is not None:
+            self._note_program("spec_draft", S, self._draft_window)
+            draft_dev = self._spec_draft_fn(self._draft_variables,
+                                            jnp.asarray(windows))
+        else:
+            draft_dev = jnp.asarray(ngram)
+        self._note_program("spec_verify", S, K + 1)
+        (samples, draft_echo, self._caches, chosen_lp, top_ids,
+         top_lps) = self._spec_verify(
+            self.variables, self._caches, self._table_device(),
+            jnp.asarray(last), draft_dev, jnp.asarray(qpos),
+            jnp.asarray(temps), jnp.asarray(top_ks),
+            jnp.asarray(top_ps), jnp.asarray(seeds))
+        self.decode_steps += 1
+        self.spec_waves += 1
+        lp_h = (chosen_lp, top_ids, top_lps) if want_lp else None
+        return ("spec", (samples, draft_echo, windows is not None),
+                lp_h, (list(eligible), host_draft_ms),
+                time.perf_counter())
+
+    def _fetch_spec(self, handles, lp_h):
+        """Runs on the fetch executor: join the spec wave's device
+        work.  The draft handle is readied FIRST — the verify program
+        consumes the draft's output, so draft-ready time is the
+        draft/verify split point of the wave's busy interval (zero
+        extra transfers: block_until_ready moves no data)."""
+        samples_h, draft_h, timed_draft = handles
+        t0 = time.perf_counter()
+        with sanitizer.sanctioned_fetch():
+            draft_ready_s = 0.0
+            if timed_draft:
+                # kfslint: disable=host-sync — sanctioned fetch site:
+                # readiness probe that splits draft vs verify device
+                # time; the verify fetch below is the real join.
+                draft_h.block_until_ready()
+                draft_ready_s = time.perf_counter() - t0
+            # kfslint: disable=host-sync — sanctioned fetch site: the
+            # spec wave's D2H join (verdicts + echoed proposals in one
+            # round trip).
+            samples = np.asarray(samples_h)
+            draft = np.asarray(draft_h)
+            lp = None
+            if lp_h is not None:
+                # kfslint: disable=host-sync — sanctioned fetch site:
+                # logprob handles fetched beside their wave's tokens.
+                lp = tuple(np.asarray(h) for h in lp_h)
+        return ((samples, draft, draft_ready_s), lp,
+                time.perf_counter() - t0)
+
+    def _enqueue_resynced_wave(self):
+        """Runs on the enqueue executor: re-sync the device feed
+        arrays from host slot state, then dispatch a plain decode
+        wave.  Spec waves are host-fed and do NOT maintain the
+        device-resident feed chain, so a fallback to the plain wave
+        path must first restore each feedable row (rows whose first
+        token is still in the FIFO — last_token < 0 — keep the values
+        the prefill enqueue scattered, which are already correct;
+        parked/free rows keep their harmless stale values)."""
+        jnp = self._jnp
+        S = self.max_slots
+        slot_arr = np.full(S, self.max_slots, np.int32)  # OOB: keep
+        toks = np.zeros(S, np.int32)
+        pos = np.zeros(S, np.int32)
+        for i, s in enumerate(self._slots):
+            if s is not None and not s.prefilling \
+                    and s.last_token >= 0:
+                slot_arr[i] = i
+                toks[i] = s.last_token
+                pos[i] = s.length
+        self._note_program("feed_resync", S)
+        self._feed_tokens, self._feed_positions = self._feed_update(
+            self._feed_tokens, self._feed_positions,
+            jnp.asarray(slot_arr), jnp.asarray(toks),
+            jnp.asarray(pos))
+        return self._enqueue_wave()
+
+    def _distribute_spec(self, samples: np.ndarray,
+                         draft: np.ndarray, lp, entries,
+                         device_ms: float = 0.0,
+                         draft_ms: float = 0.0,
+                         verify_ms: float = 0.0):
+        """samples/draft [S, K+1] / [S, K]: commit each live row's
+        longest agreeing prefix.  Row acceptance a (1..K+1) counts the
+        target's own draws that are safe to emit: draw j extends a
+        prefix that is only correct if every earlier draft token
+        matched, so emission stops at the first draft/target mismatch
+        — the mismatching TARGET draw itself is still correct (it was
+        sampled from the true prefix) and is emitted as position a-1.
+        All-K agreement emits the K+1'th \"bonus\" draw the verify got
+        for free.  No cache rollback: the host length pointer advances
+        only over emitted positions, and later waves overwrite the
+        rejected positions' k/v before any query can attend them."""
+        K = self.spec_tokens
+        kp1 = K + 1
+        self._token_steps += kp1
+        proposer = ("draft" if self._spec_draft_fn is not None
+                    else "ngram")
+        live = [(i, s) for i, s in entries if self._slots[i] is s]
+        dead = len(entries) - len(live)
+        if dead:
+            # Freed (EOS/budget/cancel) after enqueue: the device
+            # verified K+1 garbage positions for those rows.
+            self._wasted_token_steps += dead * kp1
+        share_ms = device_ms / len(live) if live else 0.0
+        draft_share = draft_ms / len(live) if live else 0.0
+        verify_share = verify_ms / len(live) if live else 0.0
+        accepted_wave = 0
+        resident_tokens = 0
+        for i, s in live:
+            a = 1
+            while a <= K and int(draft[i, a - 1]) == \
+                    int(samples[i, a - 1]):
+                a += 1
+            self.spec_proposed_tokens += K
+            self.spec_accepted_tokens += a - 1
+            accepted_wave += a - 1
+            self._spec_lengths.append(a)
+            obs.specdec_accepted_length_tokens().labels(
+                model=self.name).observe(float(a))
+            s.req.decode_device_ms += share_ms
+            s.req.spec_draft_ms += draft_share
+            s.req.spec_verify_ms += verify_share
+            if self.block_size is not None:
+                s.req.blocks_held = max(
+                    s.req.blocks_held,
+                    -(-int(s.length + a) // self.block_size))
+            # Roofline over ACCEPTED tokens only: rejected positions
+            # burn device time without useful FLOPs (that waste is the
+            # acceptance-rate trade, visible in goodput_ratio).
+            self._decode_flops += a * (self._flops_matmul_per_token
+                                       + self._attn_flops_coeff
+                                       * s.length)
+            resident_tokens += s.length
+            n_lp = s.req.logprobs
+            emitted = 0
+            for j in range(a):
+                if self._slots[i] is not s:
+                    # Finished (EOS/budget) mid-row: the rest of the
+                    # agreeing prefix is past the stream's end.
+                    break
+                s.length += 1
+                rec = None
+                if lp is not None and n_lp > 0:
+                    rec = (float(lp[0][i, j]),
+                           [(int(t), float(p)) for t, p in
+                            zip(lp[1][i, j][:n_lp],
+                                lp[2][i, j][:n_lp])])
+                self._emit(i, int(samples[i, j]), rec)
+                emitted += 1
+            self.spec_emitted_tokens += emitted
+            self._occupied_slot_steps += emitted
+            self._wasted_token_steps += kp1 - emitted
+        if live:
+            obs.specdec_proposed_tokens_total().labels(
+                model=self.name, proposer=proposer).inc(len(live) * K)
+            obs.specdec_accepted_tokens_total().labels(
+                model=self.name, proposer=proposer).inc(accepted_wave)
+            obs.specdec_draft_ms().labels(
+                model=self.name, proposer=proposer).observe(draft_ms)
+            if self.spec_proposed_tokens:
+                obs.specdec_acceptance_ratio().labels(
+                    model=self.name).set(
+                        self.spec_accepted_tokens
+                        / self.spec_proposed_tokens)
+        self._spec_draft_s += draft_ms / 1000.0
+        self._spec_verify_s += verify_ms / 1000.0
+        if resident_tokens:
+            # One parameter read serves all K+1 positions — the whole
+            # point of speculation on a bandwidth-bound decode — while
+            # each of the K+1 queries streams the resident KV.
+            self._decode_hbm_bytes += (
+                self._param_read_bytes
+                + kp1 * resident_tokens * self._kv_bytes_per_token)
+
+    def draft_param_bytes(self) -> int:
+        """HBM ledger contribution of the configured draft model (0
+        when speculation runs the n-gram head or is off)."""
+        if self._draft_variables is None:
+            return 0
+        jax = self._jax
+        return sum(int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize
+                   for x in jax.tree.leaves(self._draft_variables))
 
 
 def _pow2_buckets(max_seq: int) -> List[int]:

@@ -185,12 +185,15 @@ class DecoderLM(nn.Module):
         positions and attend per-query-causally over the cache
         (earlier chunks included), so a long prompt lands in
         block-aligned pieces between decode waves.
-    logit_positions: optional [B] int32 — compute logits ONLY at that
-        position per row (hidden gathered before the final norm +
-        LM head).  The sampled-token path never needs the [B, L, V]
-        logits cube; skipping it drops the LM-head matmul from
-        O(L·H·V) to O(H·V) per row, the dominant prefill FLOP at
-        long L.  Returns logits [B, 1, V].
+    logit_positions: optional [B] or [B, P] int32 — compute logits
+        ONLY at those positions per row (hidden gathered before the
+        final norm + LM head).  The sampled-token path never needs
+        the [B, L, V] logits cube; skipping it drops the LM-head
+        matmul from O(L·H·V) to O(P·H·V) per row, the dominant
+        prefill FLOP at long L.  [B] returns logits [B, 1, V]
+        (chunked prefill's last-token slice); [B, P] returns
+        [B, P, V] — speculative decoding's verify dispatch reads all
+        K+1 positions of a draft run from the one Lq>1 forward.
     """
 
     config: DecoderConfig
@@ -229,9 +232,11 @@ class DecoderLM(nn.Module):
             # Per-row gather BEFORE the norm + LM head: LayerNorm and
             # the tied-embedding matmul are per-position, so the
             # sliced path is numerically identical to slicing the
-            # full logits cube at the same index.
+            # full logits cube at the same indices.  reshape(b, -1, 1)
+            # accepts both the [B] single-slice form and the [B, P]
+            # multi-position form (speculative verify).
             hidden = jnp.take_along_axis(
-                hidden, logit_positions.reshape(b, 1, 1), axis=1)
+                hidden, logit_positions.reshape(b, -1, 1), axis=1)
         hidden = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                               name="final_norm")(hidden)
         logits = embed.attend(hidden.astype(embed.embedding.dtype))

@@ -821,11 +821,14 @@ def router_swap_hold_ms():
 def router_affinity_total():
     return REGISTRY.counter(
         "kfserving_tpu_router_affinity_total",
-        "Model-affinity replica picks by outcome (ring = served at the "
-        "model's primary ring position; spill = overload/breaker moved "
-        "it to the next ring position; fallback = the ring yielded no "
-        "host or an injected affinity-pick fault dropped the request "
-        "to plain round-robin)")
+        "Affinity replica picks by key mode and outcome (mode=model "
+        "hashes the model name, mode=prefix hashes the normalized "
+        "prompt's first-N-block chain digest onto the same ring; "
+        "ring = served at the key's primary ring position; spill = "
+        "overload/breaker moved it to the next ring position; "
+        "fallback = the ring yielded no host or an injected "
+        "affinity-pick fault dropped the request to plain "
+        "round-robin)")
 
 
 def router_stream_failover_total():
@@ -911,3 +914,61 @@ def incident_duration_ms():
         "recovery observed, then the cooldown window passed with no "
         "further triggers)",
         buckets=INCIDENT_DURATION_BUCKETS_MS)
+
+
+# -- speculative decoding (GenerationEngine draft/verify waves) ---------
+def specdec_proposed_tokens_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_specdec_proposed_tokens_total",
+        "Draft tokens proposed to the verify dispatch, per model and "
+        "proposer (draft = registered draft model, ngram = the "
+        "prompt-lookup head)")
+
+
+def specdec_accepted_tokens_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_specdec_accepted_tokens_total",
+        "Proposed draft tokens the target's own sampled draw agreed "
+        "with (the longest-agreeing-prefix rule), per model and "
+        "proposer — accepted/proposed is the acceptance rate")
+
+
+def specdec_fallbacks_total():
+    return REGISTRY.counter(
+        "kfserving_tpu_specdec_fallbacks_total",
+        "Speculative waves degraded to plain non-speculative decode "
+        "by an injected fault, per model and seam (site=draft|"
+        "verify) — output stays bit-exact, only tokens-per-dispatch "
+        "drops")
+
+
+# Accepted length per spec wave row is 1 (first draft token rejected;
+# the target's own draw still lands) up to K+1 (all K accepted + the
+# bonus draw) — a short linear-ish ladder, not the token-count decades.
+SPECDEC_LENGTH_BUCKETS = [1, 2, 3, 4, 6, 8, 12, 16]
+
+
+def specdec_accepted_length_tokens():
+    return REGISTRY.histogram(
+        "kfserving_tpu_specdec_accepted_length_tokens",
+        "Tokens committed per live slot per speculative wave "
+        "(1 = proposal rejected outright, K+1 = fully accepted plus "
+        "the bonus draw), per model",
+        buckets=SPECDEC_LENGTH_BUCKETS)
+
+
+def specdec_draft_ms():
+    return REGISTRY.histogram(
+        "kfserving_tpu_specdec_draft_ms",
+        "Draft-proposal overhead per speculative wave (device time "
+        "for a registered draft model, host time for the n-gram "
+        "head), per model and proposer",
+        buckets=LATENCY_BUCKETS_MS)
+
+
+def specdec_acceptance_ratio():
+    return REGISTRY.gauge(
+        "kfserving_tpu_specdec_acceptance_ratio",
+        "Running acceptance rate (accepted/proposed draft tokens, "
+        "0..1) per model — the knob that decides whether K is paying "
+        "for itself")

@@ -219,7 +219,16 @@ def paged_write(pool_k, pool_v, k_step, v_step, block_table,
     out-of-range feed-position sentinel so speculative decode waves
     cannot corrupt chunks already written) drop via OOB sentinel —
     never clamp: a clamped OOB write would land inside another
-    position's block."""
+    position's block.
+
+    Speculative verify rides the chunked shape: the K+1-position
+    dispatch writes k/v for every PROPOSED position [L, L+K], accepted
+    or not.  That needs no rollback — rejected positions hold garbage
+    the per-query causal mask keeps unreachable (no committed query
+    sits past the first rejection), and the next wave over the slot
+    re-writes those very positions before its own attention reads
+    them.  Only the drop-never-clamp rule above makes the parked-slot
+    and near-max_seq overrun cases of that scheme safe."""
     bs = pool_k.shape[1]
     mb = block_table.shape[1]
     chunked = positions.ndim == 2

@@ -56,6 +56,24 @@ config.json schema:
       "adaptive_depth": true,      # drop to depth-1 when every live
                                    #   stream finishes within the
                                    #   waves already in flight
+      "speculative": {             # speculative decoding (optional;
+        "tokens": 4,               #   default off, KFS_SPECDEC_TOKENS
+                                   #   is the env twin): propose K
+                                   #   tokens per live slot per wave,
+                                   #   verify all K+1 positions in ONE
+                                   #   target dispatch, commit the
+                                   #   longest agreeing prefix —
+                                   #   bit-exact with non-speculative
+                                   #   decode for greedy AND seeded
+                                   #   sampling.
+        "draft": {                 #   optional draft model (absent ->
+          "architecture": "...",   #   the zero-cost n-gram prompt-
+          "arch_kwargs": {...},    #   lookup head proposes); loaded
+          "model_dir": "...",      #   beside the target (model_dir
+          "window": 32             #   defaults to the target's dir),
+        }                          #   registered with the Residency-
+      },                           #   Manager as "<name>:draft" and
+                                   #   accounted in the HBM ledger.
       "mesh": {"tp": 2}            # within-replica tensor parallelism
     }
 
@@ -368,6 +386,7 @@ class GenerativeConfig:
                  host_tier_blocks: Optional[int] = None,
                  host_tier_dir: Optional[str] = None,
                  adaptive_depth: bool = True,
+                 speculative: Optional[Dict[str, Any]] = None,
                  mesh: Optional[Dict[str, int]] = None,
                  **_ignored):
         self.architecture = architecture
@@ -405,6 +424,11 @@ class GenerativeConfig:
                                  if host_tier_blocks else None)
         self.host_tier_dir = host_tier_dir
         self.adaptive_depth = bool(adaptive_depth)
+        # Speculative decoding: {"tokens": K, optional "draft":
+        # {"architecture", "arch_kwargs", "model_dir", "window"}}.
+        # None/absent defers to the engine's KFS_SPECDEC_TOKENS env
+        # twin (n-gram proposer only); see the module docstring.
+        self.speculative = dict(speculative) if speculative else None
         self.mesh = mesh or {}
 
     @classmethod
@@ -426,14 +450,20 @@ class GenerativeModel(Model):
     def __init__(self, name: str, model_dir: str,
                  config: Optional[GenerativeConfig] = None,
                  hbm: Optional[HBMManager] = None,
-                 config_overrides: Optional[Dict[str, Any]] = None):
+                 config_overrides: Optional[Dict[str, Any]] = None,
+                 residency=None):
         super().__init__(name)
         self.model_dir = model_dir
         self.config = config
         self.hbm = hbm
+        # Optional ResidencyManager: when present, a configured draft
+        # model registers beside the target as "<name>:draft" so
+        # `kfs models` shows it and the ledger accounts it.
+        self.residency = residency
         self.config_overrides = dict(config_overrides or {})
         self.engine: Optional[GenerationEngine] = None
         self.tokenizer = None
+        self._draft_handle = None
         # "mmap" | "checkpoint" | "init" once loaded.
         self.param_source: Optional[str] = None
 
@@ -478,6 +508,37 @@ class GenerativeModel(Model):
                     "params": shard_params(variables["params"], mesh),
                 }
 
+        speculative = None
+        draft_meta = None
+        if cfg.speculative and \
+                int(cfg.speculative.get("tokens", 0)) > 0:
+            speculative = {"tokens": int(cfg.speculative["tokens"])}
+            draft_cfg = cfg.speculative.get("draft")
+            if draft_cfg:
+                # The draft is just a second model materialized
+                # through the same mmap-first path, faulted in beside
+                # the target — it shares the target's dir when no
+                # model_dir of its own is given (self-draft and
+                # co-packaged drafts).
+                draft_kwargs = dict(draft_cfg.get("arch_kwargs")
+                                    or {})
+                draft_spec = create_model(draft_cfg["architecture"],
+                                          **draft_kwargs)
+                draft_dir = draft_cfg.get("model_dir")
+                draft_local = (Storage.download(draft_dir)
+                               if draft_dir else local)
+                draft_vars, _ = param_cache.load_or_materialize(
+                    draft_cfg["architecture"], draft_kwargs,
+                    draft_spec, draft_local)
+                window = int(draft_cfg.get("window", 0) or 0)
+                speculative.update({
+                    "draft_module": draft_spec.module,
+                    "draft_variables": draft_vars,
+                })
+                if window:
+                    speculative["draft_window"] = window
+                draft_meta = (draft_spec.module, draft_vars, window)
+
         engine = GenerationEngine(
             spec.module, variables,
             max_slots=cfg.max_slots, max_seq=cfg.max_seq,
@@ -492,12 +553,32 @@ class GenerativeModel(Model):
             host_tier_blocks=cfg.host_tier_blocks,
             host_tier_dir=cfg.host_tier_dir,
             adaptive_depth=cfg.adaptive_depth,
+            speculative=speculative,
             mesh=mesh, name=self.name)
         if self.hbm is not None:
-            # Generation residency = params + the slot cache pool.
+            # Generation residency = params + the slot cache pool,
+            # plus the draft model's params when speculation runs one
+            # — the ledger accounts BOTH models of the pair.
             self.hbm.admit(self.name,
-                           engine.param_bytes() + engine.cache_bytes())
+                           engine.param_bytes() + engine.cache_bytes()
+                           + engine.draft_param_bytes())
         self.engine = engine
+        if draft_meta is not None:
+            from kfserving_tpu.engine.speculative import (
+                DEFAULT_DRAFT_WINDOW,
+                DraftModel,
+            )
+
+            module_d, vars_d, window = draft_meta
+            self._draft_handle = DraftModel(
+                f"{self.name}:draft", module_d, vars_d, engine,
+                window=window or DEFAULT_DRAFT_WINDOW)
+            if self.residency is not None:
+                # Registers directly as resident (ready + engine set)
+                # and PINNED: the manager must never evict the draft
+                # out from under the serving target.
+                self.residency.register(self._draft_handle.name,
+                                        self._draft_handle)
         self.ready = True
         return True
 
@@ -505,6 +586,13 @@ class GenerativeModel(Model):
         if self.engine is not None:
             self.engine.shutdown_nowait()
             self.engine = None
+        if self._draft_handle is not None:
+            if self.residency is not None:
+                self.residency.deregister(self._draft_handle.name)
+            # Unpin: a registration that outlives this unload must not
+            # keep vetoing eviction.
+            self._draft_handle.release()
+            self._draft_handle = None
         if self.hbm is not None:
             self.hbm.release(self.name)
         self.ready = False

@@ -100,6 +100,21 @@ SITES: Dict[str, tuple] = {
         "publication, proving a failed import leaves the tier "
         "untouched and the turn degrades to a clean re-prefill with "
         "bit-exact output"),
+    "ENGINE_SPEC_DRAFT": (
+        "engine.spec_draft",
+        "GenerationEngine draft-proposal seam of a speculative "
+        "decode wave, keyed by engine name — an injected error "
+        "degrades THAT wave to plain non-speculative decode with "
+        "bit-exact output parity (counted "
+        "specdec_fallbacks_total{site=draft}); speculation resumes "
+        "when the fault clears"),
+    "ENGINE_SPEC_VERIFY": (
+        "engine.spec_verify",
+        "GenerationEngine K+1-position verify seam of a speculative "
+        "decode wave, keyed by engine name — an injected error "
+        "degrades THAT wave to plain non-speculative decode with "
+        "bit-exact output parity (counted "
+        "specdec_fallbacks_total{site=verify})"),
     "OBSERVABILITY_HISTORY_TICK": (
         "observability.history_tick",
         "HistorySampler background tick (probed via the async hook "
@@ -141,5 +156,7 @@ ENGINE_KV_SPILL = "engine.kv_spill"
 ENGINE_KV_FAULTBACK = "engine.kv_faultback"
 ENGINE_KV_EXPORT = "engine.kv_export"
 ENGINE_KV_IMPORT = "engine.kv_import"
+ENGINE_SPEC_DRAFT = "engine.spec_draft"
+ENGINE_SPEC_VERIFY = "engine.spec_verify"
 OBSERVABILITY_HISTORY_TICK = "observability.history_tick"
 OBSERVABILITY_INCIDENT_OPEN = "observability.incident_open"

@@ -258,8 +258,29 @@ async def smoke() -> List[str]:
             model="metrics-probe", outcome=outcome).inc()
     obs.hbm_eviction_skips_total().labels(
         model="metrics-probe", reason="busy").inc()
-    for outcome in ("ring", "spill", "fallback"):
-        obs.router_affinity_total().labels(outcome=outcome).inc()
+    for mode in ("model", "prefix"):
+        for outcome in ("ring", "spill", "fallback"):
+            obs.router_affinity_total().labels(
+                mode=mode, outcome=outcome).inc()
+    # Speculative-decoding families (ISSUE 20): proposal/acceptance
+    # counters split by proposer, the chaos-fallback counter split by
+    # seam, the accepted-length and draft-overhead histograms, and the
+    # bounded acceptance-rate gauge — representative samples so names,
+    # label shapes, and unit suffixes always lint.
+    for proposer in ("draft", "ngram"):
+        obs.specdec_proposed_tokens_total().labels(
+            model="metrics-probe", proposer=proposer).inc(12)
+        obs.specdec_accepted_tokens_total().labels(
+            model="metrics-probe", proposer=proposer).inc(7)
+        obs.specdec_draft_ms().labels(
+            model="metrics-probe", proposer=proposer).observe(0.4)
+    for site in ("draft", "verify"):
+        obs.specdec_fallbacks_total().labels(
+            model="metrics-probe", site=site).inc()
+    obs.specdec_accepted_length_tokens().labels(
+        model="metrics-probe").observe(3)
+    obs.specdec_acceptance_ratio().labels(
+        model="metrics-probe").set(0.58)
     # Device-discipline sanitizer families (ISSUE 14): the violation
     # counter (one sample per kind) and the armed gauge, touched with
     # representative values so names/labels/suffixes always lint.

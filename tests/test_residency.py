@@ -422,7 +422,8 @@ def _bare_router(**kwargs):
         def get(name, namespace="default"):
             return None
 
-    return IngressRouter(_Ctl(), affinity="model", **kwargs)
+    kwargs.setdefault("affinity", "model")
+    return IngressRouter(_Ctl(), **kwargs)
 
 
 def test_affinity_ring_is_deterministic_and_partitions():
@@ -468,6 +469,82 @@ def test_affinity_every_host_vetoed_returns_none():
         router._host_inflight[r.host] = router.affinity_spill
     assert router._affinity_pick("m", replicas,
                                  lambda host: None) is None
+
+
+# ------------------------------------- prefix-affinity key (ISSUE 20)
+
+
+def test_prefix_affinity_key_mirrors_engine_chain_digest():
+    """KFS_ROUTER_AFFINITY=prefix: the routing key IS the engine's
+    prefix-index chain digest over the prompt's first N blocks —
+    byte-tokenizer ids (BOS 256 + utf-8), blake2b-16 chained per
+    block — so equal keys mean shareable KV on the pinned replica."""
+    import hashlib
+    import json
+
+    import numpy as np
+
+    router = _bare_router(affinity="prefix")
+    router.affinity_prefix_block_tokens = 4
+    router.affinity_prefix_blocks = 2
+    text = "abcdefghij"  # BOS + 10 bytes = 11 ids -> 2 full 4-blocks
+    ids = np.asarray([256] + list(text.encode("utf-8")), np.int32)
+    chain = b""
+    for c in range(2):
+        chain = hashlib.blake2b(
+            chain + ids[c * 4:(c + 1) * 4].tobytes(),
+            digest_size=16).digest()
+    want = chain.hex()
+    enc = lambda obj: json.dumps(obj).encode()  # noqa: E731
+    # Every request shape normalizes to the same key.
+    assert router._prefix_affinity_key(
+        enc({"text_input": text})) == want
+    assert router._prefix_affinity_key(
+        enc({"prompt": text})) == want
+    assert router._prefix_affinity_key(
+        enc({"instances": [text]})) == want
+    assert router._prefix_affinity_key(
+        enc({"instances": [{"prompt": text,
+                            "max_tokens": 4}]})) == want
+    # Diverging tail past the first N blocks: SAME key (the whole
+    # point — shared system prompts pin together).
+    assert router._prefix_affinity_key(
+        enc({"prompt": text + " but then it diverges"})) == want
+    # A different first block: different key.
+    assert router._prefix_affinity_key(
+        enc({"prompt": "zz" + text})) != want
+    # Sub-block prompt digests whole (still pins consistently).
+    short = router._prefix_affinity_key(enc({"prompt": "hi"}))
+    assert short is not None and short != want
+    assert router._prefix_affinity_key(enc({"prompt": "hi"})) == short
+    # No extractable prompt -> None (caller keeps the lookup key).
+    assert router._prefix_affinity_key(b"") is None
+    assert router._prefix_affinity_key(b"not json {") is None
+    assert router._prefix_affinity_key(
+        enc({"instances": [[1.0, 2.0]]})) is None
+    assert router._prefix_affinity_key(enc({"prompt": 7})) is None
+
+
+def test_prefix_affinity_pick_rides_ring_with_mode_label():
+    """The prefix key rides the SAME ring machinery, and the outcome
+    counter carries the mode label."""
+    from kfserving_tpu.observability import metrics as obs
+
+    router = _bare_router(affinity="prefix")
+    replicas = _fake_replicas(
+        [f"127.0.0.1:{9100 + i}" for i in range(3)])
+    gate = lambda host: None  # noqa: E731
+    key = router._prefix_affinity_key(
+        b'{"prompt": "You are a helpful assistant. The user says:"}')
+    assert key is not None
+    before = obs.router_affinity_total().labels(
+        mode="prefix", outcome="ring").value
+    host = router._affinity_pick(key, replicas, gate)
+    assert host is not None
+    assert router._affinity_pick(key, replicas, gate) == host
+    after = obs.router_affinity_total().labels(
+        mode="prefix", outcome="ring").value
+    assert after >= before + 2
 
 
 # --------------------------------- end-to-end: fleet + trained models
@@ -556,7 +633,7 @@ async def test_affinity_fleet_e2e_with_chaos_fallback(tmp_path):
             from kfserving_tpu.observability import metrics as obs
 
             fallback = obs.router_affinity_total().labels(
-                outcome="fallback")
+                mode="model", outcome="fallback")
             assert fallback.value >= 4
     finally:
         await router.stop_async()

@@ -1,0 +1,540 @@
+"""Speculative decoding (ISSUE 20): draft/verify multi-token decode
+with exact-parity fallback.
+
+The discriminating bar mirrors the KV-tier suite: every arm — n-gram
+proposer, self-draft model, chunked-prefill prompts, mid-stream
+cancel, pool-pressure preemption, chaos on either spec seam — produces
+BIT-EXACT output versus a non-speculative engine.  Speculation only
+ever changes how many positions one dispatch scores, never what the
+model emits; the acceptance books (proposed/accepted/emitted, the
+registry twins) stay additive throughout.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kfserving_tpu.engine.generator import GenerationEngine
+from kfserving_tpu.engine.speculative import (
+    NGramProposer,
+    rolling_windows,
+)
+from kfserving_tpu.models.decoder import DecoderLM, decoder_tiny
+from kfserving_tpu.observability import REGISTRY, attribution
+from kfserving_tpu.reliability import faults
+
+MAX_SEQ = 64
+BS = 16
+
+# Repetitive tail: the prompt-lookup head actually lands acceptances
+# (generation loops locally on the tiny model too).
+REP = [5, 9, 2, 5, 9, 2, 5, 9, 2, 5, 9]
+PLAIN = [7, 3, 1, 8, 2, 6]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = decoder_tiny(num_layers=2, hidden_size=64, num_heads=2,
+                       intermediate_size=128, max_seq=MAX_SEQ,
+                       vocab_size=96)
+    module = DecoderLM(cfg)
+    variables = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))
+    return module, variables, cfg
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    attribution.clear()
+    faults.reset()
+    yield
+    faults.reset()
+    attribution.clear()
+
+
+def make_paged(tiny, **kw):
+    module, variables, _ = tiny
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_seq", MAX_SEQ)
+    kw.setdefault("prefill_buckets", [16, 32, MAX_SEQ])
+    kw.setdefault("block_size", BS)
+    return GenerationEngine(module, variables,
+                            name=kw.pop("name", "specdec"), **kw)
+
+
+def make_spec(tiny, k=3, draft=False, **kw):
+    module, variables, _ = tiny
+    spec = {"tokens": k}
+    if draft:
+        # Self-draft: the target doubles as its own proposer — the
+        # strongest-acceptance arm a test this size can afford, and it
+        # exercises the full draft-dispatch path.
+        spec.update(draft_module=module, draft_variables=variables,
+                    draft_window=16)
+    return make_paged(tiny, speculative=spec, **kw)
+
+
+def ref_greedy(module, variables, prompt, steps):
+    ids = [int(t) for t in prompt]
+    out = []
+    for _ in range(steps):
+        logits = module.apply(variables,
+                              jnp.asarray([ids], jnp.int32))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        ids.append(nxt)
+    return out
+
+
+def _counter_value(family_name, **labels):
+    fam = REGISTRY.family(family_name)
+    if fam is None:
+        return 0
+    want = {(k, str(v)) for k, v in labels.items()}
+    total = 0
+    for sample_labels, child in fam.samples():
+        if want <= set(sample_labels.items()):
+            total += child.value
+    return total
+
+
+# ==================================================== proposer units
+
+
+def test_ngram_proposer_replays_repeated_suffix():
+    p = NGramProposer(k=3)
+    # Suffix [5, 9] occurred earlier, followed by 2, 5, 9.
+    assert p.propose([5, 9, 2, 5, 9]) == [2, 5, 9]
+    # No repetition: propose repeats of the last token.
+    assert p.propose([1, 2, 3, 4]) == [4, 4, 4]
+    assert p.propose([]) == [0, 0, 0]
+
+
+def test_rolling_windows_left_pads():
+    w = rolling_windows([[1, 2], [3, 4, 5, 6]], slots=3, rows=[0, 2],
+                        window=3)
+    assert w.shape == (3, 3)
+    assert w[0].tolist() == [0, 1, 2]
+    assert w[1].tolist() == [0, 0, 0]  # unlisted row stays zero
+    assert w[2].tolist() == [4, 5, 6]
+
+
+# ================================================== greedy parity
+
+
+async def test_greedy_parity_ngram_arm(tiny):
+    """Tentpole acceptance: n-gram speculation reproduces
+    full-recompute greedy token-for-token."""
+    module, variables, _ = tiny
+    want = ref_greedy(module, variables, REP, 16)
+    eng = make_spec(tiny, k=3, max_slots=1)
+    try:
+        got, reason = await eng.complete(REP, max_new_tokens=16)
+        st = eng.stats()["speculative"]
+    finally:
+        await eng.close()
+    assert got == want
+    assert reason == "length"
+    assert st["waves"] >= 1
+    assert st["accepted_tokens"] >= 1  # speculation actually paid off
+
+
+async def test_greedy_parity_draft_arm(tiny):
+    """Self-draft speculation (jitted rolling-window proposer + the
+    chained verify dispatch) stays bit-exact too."""
+    module, variables, _ = tiny
+    want = ref_greedy(module, variables, REP, 14)
+    eng = make_spec(tiny, k=3, draft=True, max_slots=1)
+    try:
+        got, _ = await eng.complete(REP, max_new_tokens=14)
+        st = eng.stats()["speculative"]
+    finally:
+        await eng.close()
+    assert got == want
+    assert st["proposer"] == "draft"
+    assert st["draft_param_bytes"] > 0
+
+
+@pytest.mark.slow
+async def test_concurrent_slots_spec_parity(tiny):
+    """Slots sharing one spec wave must not influence each other —
+    rows with different acceptance lengths roll forward
+    independently."""
+    module, variables, _ = tiny
+    prompts = [REP, PLAIN, [3, 1, 4, 1, 5, 9, 2, 6]]
+    want = [ref_greedy(module, variables, p, 8) for p in prompts]
+    eng = make_spec(tiny, k=3, max_slots=4)
+    try:
+        got = await asyncio.gather(*[
+            eng.complete(p, max_new_tokens=8) for p in prompts])
+    finally:
+        await eng.close()
+    assert [t for t, _ in got] == want
+
+
+# ================================================== sampling parity
+
+
+async def test_seeded_sampling_parity(tiny):
+    """Exact-match acceptance under the per-(seed, position) noise key:
+    seeded temperature sampling is bit-exact versus the
+    non-speculative engine — the stronger-than-distributional
+    guarantee the deterministic sampler buys."""
+    base = make_paged(tiny, max_slots=1, name="specdec-base")
+    try:
+        want, _ = await base.complete(REP, max_new_tokens=14,
+                                      temperature=1.1, top_k=12,
+                                      seed=7)
+    finally:
+        await base.close()
+    eng = make_spec(tiny, k=3, max_slots=1)
+    try:
+        got, _ = await eng.complete(REP, max_new_tokens=14,
+                                    temperature=1.1, top_k=12, seed=7)
+    finally:
+        await eng.close()
+    assert got == want
+
+
+# ============================================= chunked-prefill parity
+
+
+@pytest.mark.slow
+async def test_chunked_prefill_spec_parity(tiny):
+    """Chunked (cold) prompts — including one ending EXACTLY on a
+    chunk boundary — hand off to speculative decode bit-exactly: the
+    final chunk's on-device first token seeds the slot, and spec waves
+    extend it."""
+    module, variables, _ = tiny
+    boundary = [(i * 7) % 90 + 1 for i in range(32)]   # 2 full chunks
+    ragged = (REP * 4)[:42]                            # 2 chunks + 10
+    want = {tuple(p): ref_greedy(module, variables, p, 10)
+            for p in (boundary, ragged)}
+    eng = make_spec(tiny, k=3, max_slots=2,
+                    prefill_chunk_tokens=16)
+    try:
+        for p in (boundary, ragged):
+            got, _ = await eng.complete(p, max_new_tokens=10)
+            assert got == want[tuple(p)], \
+                f"chunked+spec diverged for len-{len(p)} prompt"
+        stats = eng.stats()
+        assert stats["chunked_prefill"]["chunks_dispatched"] >= 2
+        assert stats["speculative"]["waves"] >= 1
+    finally:
+        await eng.close()
+
+
+# ==================================================== cancel / preempt
+
+
+async def test_cancel_mid_speculation_frees_slot(tiny):
+    """cancel() landing while a slot is riding spec waves delivers the
+    terminal event, frees the slot, and later requests stay
+    bit-exact (dead rows in flight are discarded, not emitted)."""
+    module, variables, _ = tiny
+    eng = make_spec(tiny, k=3, max_slots=1)
+    try:
+        req = eng.submit(REP, max_new_tokens=40)
+        got = []
+        async for token, fin in eng.stream(req):
+            if fin is None:
+                got.append(token)
+            if len(got) >= 3:
+                eng.cancel(req)
+        assert fin == "cancelled"
+        # The freed slot serves a fresh request exactly.
+        want = ref_greedy(module, variables, PLAIN, 8)
+        after, _ = await eng.complete(PLAIN, max_new_tokens=8)
+        assert after == want
+        assert all(s is None for s in eng._slots)
+    finally:
+        await eng.close()
+
+
+@pytest.mark.slow
+async def test_pool_pressure_preemption_spec_parity(tiny):
+    """Concurrent speculating streams whose growth exceeds the pool
+    are preempted and resumed — the resumed stream re-prefills its
+    committed tokens and produces exactly the uninterrupted result."""
+    module, variables, _ = tiny
+    prompts = [[(i * 7 + j) % 90 + 1 for j in range(42)]
+               for i in range(3)]
+    budget = 20
+    want = [ref_greedy(module, variables, p, budget) for p in prompts]
+    eng = make_spec(tiny, k=2, max_slots=4, cache_blocks=10)
+    try:
+        got = await asyncio.wait_for(asyncio.gather(*[
+            eng.complete(p, max_new_tokens=budget) for p in prompts]),
+            timeout=300)
+        stats = eng.stats()["paged"]
+    finally:
+        await eng.close()
+    assert [t for t, _ in got] == want
+    assert stats["preemptions"] >= 1  # pressure actually happened
+
+
+# ================================================ acceptance books
+
+
+async def test_acceptance_metrics_math(tiny):
+    """The acceptance ledger is additive and the registry twins agree:
+    proposed = waves x K (single live slot), accepted <= proposed,
+    emitted <= accepted + waves (each wave emits its agreeing prefix
+    plus ONE target draw), rate = accepted/proposed."""
+    eng = make_spec(tiny, k=3, max_slots=1)
+    try:
+        await eng.complete(REP, max_new_tokens=16)
+        st = eng.stats()["speculative"]
+    finally:
+        await eng.close()
+    assert st["tokens"] == 3
+    assert st["proposer"] == "ngram"
+    assert st["proposed_tokens"] == st["waves"] * 3
+    assert 0 < st["accepted_tokens"] <= st["proposed_tokens"]
+    assert st["emitted_tokens"] <= st["accepted_tokens"] + st["waves"]
+    assert st["acceptance_rate"] == round(
+        st["accepted_tokens"] / st["proposed_tokens"], 4)
+    assert 1 <= st["accepted_length_p50"] <= 4
+    assert st["accepted_length_p50"] <= st["accepted_length_p99"]
+    assert st["verify_device_s"] > 0
+    assert _counter_value(
+        "kfserving_tpu_specdec_proposed_tokens_total",
+        model="specdec", proposer="ngram") >= st["proposed_tokens"]
+    assert _counter_value(
+        "kfserving_tpu_specdec_accepted_tokens_total",
+        model="specdec", proposer="ngram") >= st["accepted_tokens"]
+
+
+async def test_attribution_splits_draft_vs_verify(tiny):
+    """Per-request cost attribution gains spec_draft/spec_verify
+    refinement keys (device_ms conservation keeps decode as the
+    umbrella phase)."""
+    from kfserving_tpu.tracing import current_request_id
+
+    eng = make_spec(tiny, k=3, max_slots=1,
+                    name="specdec-attr")
+    try:
+        token = current_request_id.set("trace-spec-1")
+        try:
+            await eng.complete(REP, max_new_tokens=12)
+        finally:
+            current_request_id.reset(token)
+        rec = attribution.lookup("trace-spec-1")
+    finally:
+        await eng.close()
+    assert rec is not None and rec["model"] == "specdec-attr"
+    assert "spec_verify" in rec["device_ms"]
+    assert "spec_draft" in rec["device_ms"]
+    assert rec["device_ms"]["spec_verify"] >= 0.0
+    # Refinement keys split the decode umbrella, never exceed it.
+    assert (rec["device_ms"]["spec_draft"]
+            + rec["device_ms"]["spec_verify"]
+            <= rec["device_ms"]["decode"] + 0.25)
+
+
+# ==================================================== chaos fallback
+
+
+@pytest.mark.parametrize("site,label", [
+    ("engine.spec_draft", "draft"),
+    ("engine.spec_verify", "verify"),
+])
+async def test_chaos_degrades_to_plain_decode(tiny, site, label):
+    """error_rate=1.0 on either spec seam: every wave degrades to
+    plain non-speculative decode — bit-exact output, fallbacks
+    counted, nothing proposed."""
+    module, variables, _ = tiny
+    want = ref_greedy(module, variables, REP, 12)
+    faults.configure({site: {"error_rate": 1.0}})
+    eng = make_spec(tiny, k=3, max_slots=1,
+                    name=f"specdec-chaos-{label}")
+    try:
+        got, _ = await eng.complete(REP, max_new_tokens=12)
+        st = eng.stats()["speculative"]
+    finally:
+        await eng.close()
+    assert got == want, f"{site} chaos changed model output"
+    assert st["fallbacks"].get(label, 0) >= 1
+    assert st["waves"] == 0          # no spec wave ever dispatched
+    assert st["proposed_tokens"] == 0
+    assert _counter_value(
+        "kfserving_tpu_specdec_fallbacks_total",
+        model=f"specdec-chaos-{label}",
+        site=label) == st["fallbacks"][label]
+
+
+async def test_chaos_clears_and_speculation_resumes(tiny):
+    """A cleared fault lets the NEXT wave speculate again — the
+    degradation is per-wave, not a latch."""
+    module, variables, _ = tiny
+    want = ref_greedy(module, variables, REP, 10)
+    faults.configure({"engine.spec_draft": {"error_rate": 1.0}})
+    eng = make_spec(tiny, k=3, max_slots=1,
+                    name="specdec-resume")
+    try:
+        got, _ = await eng.complete(REP, max_new_tokens=10)
+        assert got == want
+        assert eng.stats()["speculative"]["waves"] == 0
+        faults.reset()
+        got, _ = await eng.complete(REP, max_new_tokens=10)
+        assert got == want
+        assert eng.stats()["speculative"]["waves"] >= 1
+    finally:
+        await eng.close()
+
+
+# ==================================================== config plumbing
+
+
+async def test_spec_off_is_todays_engine(tiny):
+    """Default config: spec_tokens 0, no speculative stats block, no
+    spec programs — and output identical to the reference (the
+    non-speculative path is untouched, not merely equivalent)."""
+    module, variables, _ = tiny
+    eng = make_paged(tiny, max_slots=1, name="specdec-off")
+    try:
+        assert eng.spec_tokens == 0
+        got, _ = await eng.complete(REP, max_new_tokens=10)
+        st = eng.stats()
+    finally:
+        await eng.close()
+    assert got == ref_greedy(module, variables, REP, 10)
+    assert "speculative" not in st
+
+
+def test_env_twin_enables_ngram_spec(tiny, monkeypatch):
+    monkeypatch.setenv("KFS_SPECDEC_TOKENS", "2")
+    eng = make_paged(tiny, name="specdec-env")
+    assert eng.spec_tokens == 2
+    asyncio.run(eng.close())
+    monkeypatch.setenv("KFS_SPECDEC_TOKENS", "not-a-number")
+    eng = make_paged(tiny, name="specdec-env2")
+    assert eng.spec_tokens == 0
+    asyncio.run(eng.close())
+
+
+def test_negative_spec_tokens_rejected(tiny):
+    from kfserving_tpu.protocol.errors import InvalidInput
+
+    with pytest.raises(InvalidInput):
+        make_paged(tiny, speculative={"tokens": -1})
+
+
+async def test_cache_debug_exposes_acceptance(tiny):
+    """/debug/cache federates per-replica acceptance: the speculative
+    block rides cache_debug() so `kfs cache` surfaces it."""
+    eng = make_spec(tiny, k=3, max_slots=1,
+                    name="specdec-debug")
+    try:
+        await eng.complete(REP, max_new_tokens=10)
+        dbg = eng.cache_debug()
+    finally:
+        await eng.close()
+    assert "speculative" in dbg
+    assert dbg["speculative"]["acceptance_rate"] >= 0.0
+
+
+# =============================================== served-model plumbing
+
+
+@pytest.mark.slow
+async def test_generative_model_registers_pinned_draft(tmp_path):
+    """config.json `speculative.draft`: the draft materializes beside
+    the target, registers with the ResidencyManager as
+    `<name>:draft` PINNED (evicting it would silently slow live
+    streams), the HBM ledger accounts both models, and generate output
+    equals the spec-off model's."""
+    import json as _json
+
+    from kfserving_tpu.engine.hbm import HBMManager
+    from kfserving_tpu.engine.residency import ResidencyManager
+    from kfserving_tpu.predictors.llm import GenerativeModel
+
+    def write_dir(name, extra):
+        d = tmp_path / name
+        d.mkdir()
+        cfg = {
+            "architecture": "decoder_tiny",
+            "arch_kwargs": {"num_layers": 2, "hidden_size": 64,
+                            "num_heads": 2, "intermediate_size": 128,
+                            "max_seq": 64},
+            "max_slots": 2, "max_seq": 64,
+            "prefill_buckets": [16, 32, 64],
+            "max_new_tokens": 8, "tokenizer": "byte",
+            "block_size": 16,
+        }
+        cfg.update(extra)
+        (d / "config.json").write_text(_json.dumps(cfg))
+        return str(d)
+
+    plain = GenerativeModel("specoff", write_dir("specoff", {}))
+    plain.load()
+    hbm = HBMManager(budget_bytes=1 << 30)
+    residency = ResidencyManager(hbm)
+    spec = GenerativeModel(
+        "specon",
+        write_dir("specon", {"speculative": {
+            "tokens": 3,
+            "draft": {"architecture": "decoder_tiny",
+                      "arch_kwargs": {
+                          "num_layers": 2, "hidden_size": 64,
+                          "num_heads": 2, "intermediate_size": 128,
+                          "max_seq": 64},
+                      "window": 16}}}),
+        hbm=hbm, residency=residency)
+    spec.load()
+    try:
+        assert "specon:draft" in residency.registered()
+        assert residency.state_of("specon:draft") == "resident"
+        assert spec._draft_handle.offloadable is False
+        draft_bytes = spec.engine.draft_param_bytes()
+        assert draft_bytes > 0
+        # The admission covered target params + cache + draft params.
+        assert hbm.used_bytes >= draft_bytes
+        body = {"instances": [{"prompt": "speculate!",
+                               "max_tokens": 8}]}
+        a = await plain.predict(dict(body))
+        b = await spec.predict(dict(body))
+        assert (a["predictions"][0]["text"]
+                == b["predictions"][0]["text"])
+        assert spec.engine.stats()["speculative"]["waves"] >= 1
+    finally:
+        await spec.close()
+        spec.unload()
+        await plain.close()
+    # Unload released the pin, the registration, and the HBM claim.
+    assert "specon:draft" not in residency.registered()
+    assert hbm.used_bytes == 0
+
+
+# ==================================================== sanitizer smoke
+
+
+async def test_sanitizer_smoke_spec_decode(monkeypatch, tiny):
+    """Satellite: KFS_SANITIZE=1 over speculative decode.  Post-
+    warmup, spec waves reuse their compiled draft/verify programs and
+    every D2H fetch runs sanctioned off-loop — zero violations."""
+    from kfserving_tpu.reliability import sanitizer
+
+    monkeypatch.setenv("KFS_SANITIZE", "1")
+    sanitizer.reset()
+    eng = make_spec(tiny, k=3, draft=True, max_slots=2,
+                    name="specdec-sanitize")
+    try:
+        # Warmup: run the full steady-state shape set (prefill both
+        # prompts' buckets, spec draft + verify, the feed-resync wave
+        # the prefill->decode handoff takes while a first token is
+        # still in the FIFO).
+        for p in (REP, PLAIN, REP):
+            await eng.complete(p, max_new_tokens=8)
+        sanitizer.declare_warmup_complete(eng.sanitize_source)
+        for p in (PLAIN, REP):
+            await eng.complete(p, max_new_tokens=8)
+        assert eng.stats()["speculative"]["waves"] >= 1
+        assert sanitizer.violations() == {}
+    finally:
+        await eng.close()
+        sanitizer.reset()
